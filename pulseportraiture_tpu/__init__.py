@@ -1,0 +1,23 @@
+"""pulseportraiture_tpu: TPU-native wideband pulsar timing framework.
+
+A ground-up JAX/XLA re-design with the capabilities of the reference
+PulsePortraiture package (wideband TOA/DM measurement by Fourier-domain
+portrait fitting; Gaussian and PCA/spline portrait modeling; alignment,
+averaging and RFI zapping pipelines; PSRFITS I/O) — batched, jit-compiled,
+and sharded over device meshes instead of per-profile host loops.
+
+Layering (bottom-up):
+  io/        PSRFITS + model-file + TOA-file I/O (host)
+  ops/       portrait array math (device, batched)
+  fit/       Fourier-domain fit kernels + batched solvers (device)
+  models/    Gaussian & spline model builders
+  pipelines/ pptoas/ppalign/ppspline/ppgauss/ppzap equivalents
+  parallel/  mesh + sharding of batched fits over TPU slices
+  utils/     records, telescope codes
+  viz/       matplotlib diagnostics (host, optional)
+"""
+
+from . import config  # noqa: F401  (enables x64 on import)
+from .utils.databunch import DataBunch  # noqa: F401
+
+__version__ = "0.1.0"

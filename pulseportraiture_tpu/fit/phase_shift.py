@@ -1,0 +1,122 @@
+"""Batched FFTFIT: 1-D phase-shift fit between data and model profiles.
+
+TPU-native equivalent of the reference's ``fit_phase_shift``
+(/root/reference/pplib.py:2054-2100) and its objective/derivatives
+(/root/reference/pplib.py:1244-1280).
+
+Design: the reference runs ``scipy.optimize.brute`` over an Ns-point phase
+grid with a simplex polish, once per profile, on the host.  Here the grid
+evaluation is a single [Ns, nharm] x [..., nharm] contraction (an MXU
+matmul over batched profiles) followed by a fixed-iteration, fully-batched
+Newton polish using the closed-form first/second derivatives — no host
+round-trips, vmappable over any leading batch shape.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..config import F0_fact
+from ..ops.noise import get_noise
+from ..utils.databunch import DataBunch
+
+__all__ = ["fit_phase_shift", "phase_shift_objective", "cross_spectrum"]
+
+
+def cross_spectrum(data, model, zap_f0=True):
+    """rFFT data & model and form the conjugate cross-spectrum d * conj(m).
+
+    data/model: [..., nbin]; returns (cross [..., nharm], dFFT, mFFT).
+    """
+    dFFT = jnp.fft.rfft(data, axis=-1)
+    mFFT = jnp.fft.rfft(model, axis=-1)
+    if zap_f0:
+        dFFT = dFFT.at[..., 0].multiply(F0_fact)
+        mFFT = mFFT.at[..., 0].multiply(F0_fact)
+    return dFFT * jnp.conj(mFFT), dFFT, mFFT
+
+
+def phase_shift_objective(phase, cross, err):
+    """C(phi) = -Re sum_k cross_k e^{2pi i k phi} / err^2 and derivatives.
+
+    Returns (C, dC, d2C), each shaped like ``phase`` broadcast against the
+    batch dims of ``cross`` [..., nharm].  Equivalent of
+    /root/reference/pplib.py:1244-1280.
+    """
+    nharm = cross.shape[-1]
+    k = jnp.arange(nharm, dtype=jnp.result_type(phase, jnp.float64))
+    frac = (phase[..., None] * k) % 1.0
+    ang = 2.0 * jnp.pi * frac
+    ph = jnp.cos(ang) + 1j * jnp.sin(ang)
+    w = cross * ph
+    inv_err2 = err ** -2.0
+    C = -jnp.real(w.sum(axis=-1)) * inv_err2
+    dC = -jnp.real((2j * jnp.pi * k * w).sum(axis=-1)) * inv_err2
+    d2C = -jnp.real((-4.0 * jnp.pi ** 2 * k ** 2 * w).sum(axis=-1)) * inv_err2
+    return C, dC, d2C
+
+
+@partial(jax.jit, static_argnames=("Ns", "newton_iter"))
+def _fit_phase_shift_core(data, model, err_t, lo, hi, Ns, newton_iter):
+    nbin = data.shape[-1]
+    cross, dFFT, mFFT = cross_spectrum(data, model)
+    err = err_t * jnp.sqrt(nbin / 2.0)
+    inv_err2 = err ** -2.0
+    d = jnp.real(jnp.sum(dFFT * jnp.conj(dFFT), axis=-1)) * inv_err2
+    p = jnp.real(jnp.sum(mFFT * jnp.conj(mFFT), axis=-1)) * inv_err2
+
+    # Grid stage: one batched contraction over the phase grid (MXU-friendly).
+    grid = lo + (hi - lo) * jnp.arange(Ns) / Ns  # [Ns]
+    nharm = cross.shape[-1]
+    k = jnp.arange(nharm, dtype=grid.dtype)
+    ang = 2.0 * jnp.pi * ((grid[:, None] * k[None, :]) % 1.0)
+    ph = jnp.cos(ang) + 1j * jnp.sin(ang)            # [Ns, nharm]
+    Cgrid = -jnp.real(jnp.einsum("...h,gh->...g", cross, ph))
+    phase0 = grid[jnp.argmin(Cgrid, axis=-1)]        # [...]
+
+    # Newton polish with safeguarding: only step where curvature > 0, and
+    # never further than one grid cell.
+    cell = (hi - lo) / Ns
+
+    def newton_step(_, phase):
+        _, dC, d2C = phase_shift_objective(phase, cross, err)
+        step = jnp.where(d2C > 0.0, -dC / jnp.where(d2C > 0.0, d2C, 1.0),
+                         0.0)
+        return phase + jnp.clip(step, -cell, cell)
+
+    phase = jax.lax.fori_loop(0, newton_iter, newton_step, phase0)
+    # wrap onto [-0.5, 0.5)
+    phase = (phase + 0.5) % 1.0 - 0.5
+
+    C, _, d2C = phase_shift_objective(phase, cross, err)
+    scale = -C / p
+    phase_err = jnp.abs(scale * d2C) ** -0.5
+    scale_err = p ** -0.5
+    red_chi2 = (d - (C ** 2 / p)) / (nbin - 2)
+    snr = jnp.sqrt(scale ** 2 * p)
+    return DataBunch(phase=phase, phase_err=phase_err, scale=scale,
+                     scale_err=scale_err, snr=snr, red_chi2=red_chi2)
+
+
+def fit_phase_shift(data, model, noise=None, bounds=(-0.5, 0.5), Ns=100,
+                    newton_iter=6):
+    """Fit the phase of ``data`` with respect to ``model`` (batched FFTFIT).
+
+    data/model: [..., nbin] (any leading batch shape; both broadcast).
+    noise: time-domain noise level per batch element (measured via
+    get_noise if None).  bounds: phase search interval; Ns: grid points.
+
+    Returns a DataBunch with batched fields: phase [rot] in [-0.5, 0.5),
+    phase_err, scale, scale_err, snr, red_chi2.  Positive phase means the
+    data profile lags the model (rotate data by +phase to align), matching
+    /root/reference/pplib.py:2054-2100.
+    """
+    data = jnp.asarray(data)
+    model = jnp.asarray(model)
+    data, model = jnp.broadcast_arrays(data, model)
+    if noise is None:
+        noise = get_noise(data)
+    err_t = jnp.broadcast_to(jnp.asarray(noise), data.shape[:-1])
+    return _fit_phase_shift_core(data, model, err_t, float(bounds[0]),
+                                 float(bounds[1]), int(Ns), int(newton_iter))

@@ -1,0 +1,51 @@
+"""Phase/delay reference-frequency transforms and TOA helpers.
+
+TPU-native equivalent of /root/reference/pplib.py:2577-2648 (``DM_delay``,
+``phase_transform``, ``guess_fit_freq``, ``calculate_TOA``).  The MJD
+arithmetic itself lives in utils.mjd (two-part day/fraction floats in
+place of PSRCHIVE's pr.MJD).
+"""
+
+import jax.numpy as jnp
+
+from ..config import Dconst
+
+__all__ = ["DM_delay", "phase_transform", "guess_fit_freq"]
+
+
+def DM_delay(DM, freq, freq_ref=jnp.inf, P=None):
+    """Dispersive delay [sec] (or [rot] if P given) between freq and
+    freq_ref (reference pplib.py:2577-2590)."""
+    delay = Dconst * DM * (freq ** -2.0 - freq_ref ** -2.0)
+    if P is not None:
+        return delay / P
+    return delay
+
+
+def phase_transform(phi, DM, nu_ref1=jnp.inf, nu_ref2=jnp.inf, P=None,
+                    mod=False):
+    """Transform a delay at nu_ref1 to a delay at nu_ref2.
+
+    mod=True wraps outputs with |phi'| >= 0.5 onto [-0.5, 0.5).
+    Equivalent of /root/reference/pplib.py:2592-2616.
+    """
+    if P is None:
+        P = 1.0
+        mod = False
+    phi_prime = phi + Dconst * DM * (nu_ref2 ** -2.0 - nu_ref1 ** -2.0) / P
+    if mod:
+        phi_prime = jnp.where(jnp.abs(phi_prime) >= 0.5, phi_prime % 1,
+                              phi_prime)
+        phi_prime = jnp.where(phi_prime >= 0.5, phi_prime - 1.0, phi_prime)
+    return phi_prime
+
+
+def guess_fit_freq(freqs, SNRs=None):
+    """SNR*nu^-2-weighted 'center of mass' frequency — a cheap
+    zero-covariance frequency estimate (reference pplib.py:2618-2632)."""
+    freqs = jnp.asarray(freqs)
+    nu0 = (freqs.min() + freqs.max()) * 0.5
+    if SNRs is None:
+        SNRs = jnp.ones_like(freqs)
+    w = SNRs * freqs ** -2
+    return nu0 + jnp.sum((freqs - nu0) * w) / jnp.sum(w)

@@ -1,0 +1,1 @@
+"""Core batched portrait operations (device layer)."""

@@ -1,0 +1,55 @@
+"""Instrumental response Fourier kernels (smearing / binning / averaging).
+
+TPU-native equivalent of /root/reference/pptoaslib.py:112-179
+(``instrumental_response_FT`` / ``instrumental_response_port_FT``).
+"""
+
+import jax.numpy as jnp
+
+from .profiles import gaussian_profile_FT
+
+__all__ = ["instrumental_response_FT", "instrumental_response_port_FT"]
+
+
+def instrumental_response_FT(nbin, wid=0.0, irf_type="rect"):
+    """rFFT of a unit-area instrumental response of width ``wid`` [rot].
+
+    'rect' gives sinc(k*wid); 'gauss' a unit-peak-normalized Gaussian FT.
+    wid=0 returns ones (no effect).  Equivalent of
+    /root/reference/pptoaslib.py:112-143.
+    """
+    nharm = nbin // 2 + 1
+    k = jnp.arange(nharm)
+    if irf_type == "rect":
+        resp = jnp.sinc(k * wid)
+    elif irf_type == "gauss":
+        gp_FT = gaussian_profile_FT(nbin, 0.0, wid, 1.0)
+        resp = gp_FT / gp_FT[0]
+    else:
+        raise ValueError(f"Unrecognized instrumental response type "
+                         f"'{irf_type}'.")
+    return jnp.where(wid == 0.0, jnp.ones(nharm, resp.dtype), resp)
+
+
+def instrumental_response_port_FT(nbin, freqs, DM=0.0, P=1.0, wids=(),
+                                  irf_types=()):
+    """Combined per-channel instrumental response FT: [nchan, nharm].
+
+    Multiplies the constant-width responses in ``wids``/``irf_types`` with
+    the per-channel DM-smearing rectangle of width
+    8.3e-6 * chan_bw * (nu/GHz)**-3 / P [rot] when DM != 0 (Bhat et al.
+    2003).  Equivalent of /root/reference/pptoaslib.py:145-179.
+    """
+    freqs = jnp.asarray(freqs)
+    nchan = freqs.shape[0]
+    nharm = nbin // 2 + 1
+    out = jnp.ones([nchan, nharm],
+                   dtype=jnp.result_type(freqs.dtype, jnp.complex64))
+    for wid, irf_type in zip(wids, irf_types):
+        out = out * instrumental_response_FT(nbin, wid, irf_type)[None, :]
+    if DM:
+        chan_bw = jnp.abs(freqs[1] - freqs[0])
+        smear_wids = 8.3e-6 * chan_bw / (freqs / 1e3) ** 3 / P  # [nchan]
+        k = jnp.arange(nharm)
+        out = out * jnp.sinc(k[None, :] * smear_wids[:, None])
+    return out

@@ -1,0 +1,62 @@
+"""Per-channel portrait normalization.
+
+TPU-native equivalent of /root/reference/pplib.py:2462-2507
+(``normalize_portrait``): methods 'mean', 'max', 'prof', 'rms', 'abs'.
+Zero (all-zero) channels pass through unscaled with norm 1, matching the
+reference's ``port[ichan].any()`` guard, expressed as a mask so the whole
+portrait normalizes in one fused computation.
+"""
+
+import jax.numpy as jnp
+
+__all__ = ["normalize_portrait", "unnormalize_portrait"]
+
+
+def normalize_portrait(port, method="rms", weights=None, return_norms=False,
+                       noise_method="PS"):
+    """Normalize each channel profile of port [..., nchan, nbin].
+
+    'mean': by profile mean; 'max': by maximum; 'prof': by the fitted
+    scale against the (weighted) mean profile; 'rms': by the noise level
+    (get_noise(profile) == 1 after); 'abs': by the vector 2-norm.
+    """
+    from ..fit.phase_shift import fit_phase_shift  # avoid import cycle
+    from .noise import get_noise
+
+    port = jnp.asarray(port)
+    if method == "mean":
+        norms = port.mean(axis=-1)
+    elif method == "max":
+        norms = port.max(axis=-1)
+    elif method == "rms":
+        norms = get_noise(port, method=noise_method)
+    elif method == "abs":
+        norms = jnp.sqrt((port ** 2).sum(axis=-1))
+    elif method == "prof":
+        nonzero = jnp.any(port != 0.0, axis=-1)                  # [..., nchan]
+        if weights is None:
+            w = nonzero.astype(port.dtype)
+        else:
+            w = jnp.asarray(weights) * nonzero
+        wsum = w.sum(axis=-1)
+        mean_prof = ((port * w[..., None]).sum(axis=-2)
+                     / jnp.where(wsum > 0.0, wsum, 1.0)[..., None])
+        norms = fit_phase_shift(port, mean_prof[..., None, :]).scale
+    else:
+        raise ValueError(f"Unknown normalize_portrait method '{method}'.")
+    ok = jnp.any(port != 0.0, axis=-1) & (norms != 0.0)
+    safe = jnp.where(ok, norms, 1.0)
+    norm_port = port / safe[..., None]
+    norm_vals = jnp.where(ok, norms, 1.0)
+    if return_norms:
+        return norm_port, norm_vals
+    return norm_port
+
+
+def unnormalize_portrait(norm_port, norm_vals):
+    """Invert normalize_portrait given the returned norms.
+
+    Equivalent of DataPortrait.unnormalize_portrait
+    (/root/reference/pplib.py:384-398).
+    """
+    return jnp.asarray(norm_port) * jnp.asarray(norm_vals)[..., None]

@@ -1,0 +1,68 @@
+"""Power-law spectrum utilities.
+
+TPU-native equivalent of /root/reference/pplib.py:1048-1096 (``powlaw``,
+``powlaw_integral``, ``powlaw_freqs``) and the ISM helpers
+/root/reference/pplib.py:1176-1202 (``mean_C2N``, ``dDM``).
+"""
+
+import jax.numpy as jnp
+
+__all__ = ["powlaw", "powlaw_integral", "powlaw_freqs", "mean_C2N", "dDM"]
+
+
+def powlaw(nu, nu_ref, A, alpha):
+    """F(nu) = A*(nu/nu_ref)**alpha (reference pplib.py:1048-1052)."""
+    return A * (nu / nu_ref) ** alpha
+
+
+def powlaw_integral(nu2, nu1, nu_ref, A, alpha):
+    """Definite integral of A*(nu/nu_ref)**alpha from nu1 to nu2.
+
+    Equivalent of /root/reference/pplib.py:1054-1066.
+    """
+    alpha = jnp.asarray(alpha, dtype=jnp.float64)
+    log_case = A * nu_ref * jnp.log(nu2 / nu1)
+    safe_alpha = jnp.where(alpha == -1.0, 0.0, alpha)
+    C = A * (nu_ref ** -safe_alpha) / (1 + safe_alpha)
+    gen_case = C * ((nu2 ** (1 + safe_alpha)) - (nu1 ** (1 + safe_alpha)))
+    return jnp.where(alpha == -1.0, log_case, gen_case)
+
+
+def powlaw_freqs(lo, hi, N, alpha, mid=False):
+    """Channel edges (or centers) with equal flux per channel for a
+    power-law spectrum of index alpha.
+
+    Equivalent of /root/reference/pplib.py:1068-1096.
+    """
+    alpha = jnp.asarray(alpha, dtype=jnp.float64)
+    log_nus = jnp.exp(jnp.linspace(jnp.log(lo), jnp.log(hi), N + 1))
+    safe_alpha = jnp.where(alpha == -1.0, 0.0, alpha)
+    gen_nus = jnp.power(
+        jnp.linspace(lo ** (1 + safe_alpha), hi ** (1 + safe_alpha), N + 1),
+        (1 + safe_alpha) ** -1)
+    nus = jnp.where(alpha == -1.0, log_nus, gen_nus)
+    if mid:
+        nus = 0.5 * (nus[:-1] + nus[1:])
+    return nus
+
+
+def mean_C2N(nu, D, bw_scint):
+    """Mean turbulence strength C2N [m**-20/3] (Foster, Fairhead & Backer
+    1991); nu [MHz], D [kpc], scintillation bandwidth bw_scint [MHz].
+
+    Equivalent of /root/reference/pplib.py:1176-1187.
+    """
+    return 2e-14 * nu ** (11 / 3.0) * D ** (-11 / 6.0) * \
+        bw_scint ** (-5 / 6.0)
+
+
+def dDM(D, D_screen, nu, bw_scint):
+    """delta-DM [cm**-3 pc] predicted for a frequency-dependent DM.
+
+    D = pulsar distance [kpc], D_screen = Earth-screen distance [kpc],
+    nu [MHz], bw_scint = scintillation bandwidth at nu [MHz].
+    References: Cordes & Shannon (2010); Foster, Fairhead & Backer (1991).
+    Equivalent of /root/reference/pplib.py:1189-1202.
+    """
+    SM = mean_C2N(nu, D, bw_scint) * D  # scattering measure [m**-20/3 kpc]
+    return 10 ** 4.45 * SM * D_screen ** (5 / 6.0) * nu ** (-11 / 6.0)

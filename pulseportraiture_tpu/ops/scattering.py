@@ -1,0 +1,197 @@
+"""Scattering model: tau(nu) power law and analytic Fourier-domain kernels.
+
+TPU-native equivalent of the reference's scattering machinery
+(/root/reference/pplib.py:4053-4101 ``scattering_times``/
+``scattering_profile_FT``/``scattering_portrait_FT``; time-domain legacy
+kernels pplib.py:1098-1174; derivative chain
+/root/reference/pptoaslib.py:246-388).
+
+All kernels are expressed directly in the harmonic domain: convolution with
+the one-sided exponential of timescale tau [rot] is multiplication by
+B_k = 1 / (1 + 2*pi*i*k*tau).  Derivatives with respect to (tau, alpha) use
+the identity dB/dtau = B*(B-1)/tau, which the reference also exploits; we
+evaluate it in the algebraically-safe form -2*pi*i*k*B**2 so tau -> 0 is
+finite and the whole chain stays differentiable under jit (no data-dependent
+branches on tau, unlike the reference's ``if taus.sum()`` host branches).
+"""
+
+import jax.numpy as jnp
+
+__all__ = [
+    "scattering_times",
+    "scattering_times_deriv",
+    "scattering_times_2deriv",
+    "scattering_profile_FT",
+    "scattering_portrait_FT",
+    "scattering_portrait_FT_deriv",
+    "scattering_portrait_FT_2deriv",
+    "abs_scattering_portrait_FT",
+    "abs_scattering_portrait_FT_deriv",
+    "abs_scattering_portrait_FT_2deriv",
+    "scattering_kernel",
+    "add_scattering",
+]
+
+
+def scattering_times(tau, alpha, freqs, nu_tau):
+    """tau(nu) = tau * (nu/nu_tau)**alpha (reference pplib.py:4053-4059)."""
+    freqs = jnp.asarray(freqs)
+    return tau * (freqs / nu_tau) ** alpha
+
+
+def scattering_times_deriv(tau, freqs, nu_tau, log10_tau, taus):
+    """d taus / d(tau or log10 tau, alpha): shape [2, nchan].
+
+    When ``log10_tau`` the tau parameter is log10(tau) and
+    d taus/d log10(tau) = ln(10)*taus.  Equivalent of
+    /root/reference/pptoaslib.py:246-257, with the tau==0 branch expressed
+    arithmetically ((freqs/nu_tau)**alpha is used directly for dtau).
+    """
+    freqs = jnp.asarray(freqs)
+    if log10_tau:
+        dtau = jnp.log(10.0) * taus
+    else:
+        dtau = jnp.where(tau != 0.0, taus / jnp.where(tau != 0.0, tau, 1.0),
+                         0.0)
+    dalpha = jnp.log(freqs / nu_tau) * taus
+    return jnp.stack([dtau, dalpha])
+
+
+def scattering_times_2deriv(tau, freqs, nu_tau, log10_tau, taus, taus_deriv):
+    """Second derivatives of taus wrt (tau, alpha): shape [2, 2, nchan].
+
+    Equivalent of /root/reference/pptoaslib.py:259-274.
+    """
+    freqs = jnp.asarray(freqs)
+    dtau, dalpha = taus_deriv
+    if log10_tau:
+        d2tau = jnp.log(10.0) * dtau
+        dtaudalpha = jnp.log(10.0) * dalpha
+    else:
+        d2tau = jnp.zeros_like(dtau)
+        dtaudalpha = jnp.where(tau != 0.0,
+                               dalpha / jnp.where(tau != 0.0, tau, 1.0), 0.0)
+    d2alpha = jnp.log(freqs / nu_tau) * dalpha
+    return jnp.stack([jnp.stack([d2tau, dtaudalpha]),
+                      jnp.stack([dtaudalpha, d2alpha])])
+
+
+def scattering_profile_FT(tau, nbin):
+    """Analytic rFFT of the one-sided exponential scattering kernel.
+
+    B_k = (1 + 2*pi*i*k*tau)**-1 with tau in [rot]; tau=0 gives ones.
+    Equivalent of /root/reference/pplib.py:4061-4084.
+    """
+    nharm = nbin // 2 + 1
+    k = jnp.arange(nharm)
+    return (1.0 + 2j * jnp.pi * k * tau) ** -1
+
+
+def scattering_portrait_FT(taus, nbin):
+    """Per-channel scattering FT: [..., nchan, nharm].
+
+    Equivalent of /root/reference/pplib.py:4086-4101 without the host-side
+    ``np.any(taus)`` branch (tau=0 channels already yield ones).
+    """
+    taus = jnp.asarray(taus)
+    nharm = nbin // 2 + 1
+    k = jnp.arange(nharm, dtype=taus.dtype)
+    return (1.0 + 2j * jnp.pi * k * taus[..., None]) ** -1
+
+
+def scattering_portrait_FT_deriv(taus, taus_deriv, scat_port_FT):
+    """d scat_FT / d(tau, alpha): shape [2, ..., nchan, nharm].
+
+    Uses dB/dtaus = B*(B-1)/taus = -2*pi*i*k*B**2 (finite at taus=0),
+    then the chain rule with taus_deriv.  Math equivalent of
+    /root/reference/pptoaslib.py:318-330.
+    """
+    nharm = scat_port_FT.shape[-1]
+    k = jnp.arange(nharm, dtype=jnp.asarray(taus).dtype)
+    dB_dtaus = -2j * jnp.pi * k * scat_port_FT ** 2
+    dtau, dalpha = taus_deriv
+    return jnp.stack([dB_dtaus * dtau[..., None],
+                      dB_dtaus * dalpha[..., None]])
+
+
+def scattering_portrait_FT_2deriv(taus, taus_deriv, taus_2deriv,
+                                  scat_port_FT):
+    """d2 scat_FT / d(tau, alpha)2: shape [2, 2, ..., nchan, nharm].
+
+    With u = -2*pi*i*k: dB/dtaus = u*B**2, d2B/dtaus2 = 2*u**2*B**3, so
+    d2B/dp_i dp_j = 2*u**2*B**3 * dtaus_i*dtaus_j + u*B**2 * d2taus_ij.
+    All terms finite at taus=0.  Math equivalent of
+    /root/reference/pptoaslib.py:332-356.
+    """
+    nharm = scat_port_FT.shape[-1]
+    k = jnp.arange(nharm, dtype=jnp.asarray(taus).dtype)
+    u = -2j * jnp.pi * k
+    B = scat_port_FT
+    dB = u * B ** 2
+    d2B = 2.0 * (u ** 2) * B ** 3
+    dti = taus_deriv[:, None, ..., None]      # [2, 1, ..., nchan, 1]
+    dtj = taus_deriv[None, :, ..., None]      # [1, 2, ..., nchan, 1]
+    d2t = taus_2deriv[..., None]              # [2, 2, ..., nchan, 1]
+    return d2B * dti * dtj + dB * d2t
+
+
+def abs_scattering_portrait_FT(scat_port_FT):
+    """|B|**2 (reference pptoaslib.py:358-363)."""
+    return jnp.abs(scat_port_FT) ** 2
+
+
+def abs_scattering_portrait_FT_deriv(scat_port_FT, scat_port_FT_deriv):
+    """d|B|**2/dp = 2*Re(B * conj(dB/dp)) (reference pptoaslib.py:365-372)."""
+    return 2.0 * jnp.real(scat_port_FT * jnp.conj(scat_port_FT_deriv))
+
+
+def abs_scattering_portrait_FT_2deriv(scat_port_FT, scat_port_FT_deriv,
+                                      scat_port_FT_2deriv):
+    """d2|B|**2/dp_i dp_j = 2*Re(dB_i conj(dB_j) + B conj(d2B_ij)).
+
+    Reference pptoaslib.py:374-388 (which evaluates the same formula
+    entrywise for the 2x2 case).
+    """
+    dBi = scat_port_FT_deriv[:, None]
+    dBj = scat_port_FT_deriv[None, :]
+    return 2.0 * jnp.real(dBi * jnp.conj(dBj)
+                          + scat_port_FT * jnp.conj(scat_port_FT_2deriv))
+
+
+def scattering_kernel(tau, nu_ref, freqs, nbin, P=1.0, alpha=-4.0):
+    """Time-domain one-sided exponential kernels, one per channel.
+
+    tau [sec] at nu_ref; returns [nchan, nbin] kernels normalized to unit
+    sum.  Legacy-path equivalent of /root/reference/pplib.py:1098-1119.
+    """
+    freqs = jnp.asarray(freqs)
+    ts = jnp.arange(nbin) * (P / nbin)
+    taus = scattering_times(tau, alpha, freqs, nu_ref)  # [nchan], in sec
+    taus = jnp.where(taus == 0.0, jnp.finfo(ts.dtype).tiny, taus)
+    kern = jnp.exp(-ts[None, :] / taus[:, None])
+    return kern / kern.sum(axis=-1, keepdims=True)
+
+
+def add_scattering(port, kernel, repeat=3):
+    """Convolve a portrait with a unit-sum time-domain scattering kernel.
+
+    Both port and kernel are tiled ``repeat`` times, the tiled kernel is
+    normalized to unit sum per channel, they are circularly convolved,
+    and the central copy is returned — area-preserving, like the
+    reference (/root/reference/pplib.py:1121-1144).
+    """
+    port = jnp.asarray(port)
+    squeeze = port.ndim == 1
+    port2 = jnp.atleast_2d(port)
+    kernel2 = jnp.broadcast_to(jnp.atleast_2d(jnp.asarray(kernel)),
+                               port2.shape)
+    nbin = port2.shape[-1]
+    mid = repeat // 2
+    tiled_d = jnp.tile(port2, (1, repeat))
+    tiled_k = jnp.tile(kernel2, (1, repeat))
+    tiled_k = tiled_k / tiled_k.sum(axis=-1, keepdims=True)
+    conv = jnp.fft.irfft(jnp.fft.rfft(tiled_d, axis=-1)
+                         * jnp.fft.rfft(tiled_k, axis=-1),
+                         n=repeat * nbin, axis=-1)
+    out = conv[..., mid * nbin:(mid + 1) * nbin]
+    return out[0] if squeeze else out

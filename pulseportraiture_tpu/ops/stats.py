@@ -1,0 +1,68 @@
+"""Weighted statistics helpers.
+
+TPU-native equivalent of /root/reference/pplib.py:686-750
+(``count_crossings``, ``weighted_mean``, ``get_WRMS``, ``get_red_chi2``).
+All functions are mask-based (errs <= 0 excludes a point) so they stay
+dense and vmappable instead of using index compression.
+"""
+
+import jax.numpy as jnp
+
+from .noise import get_noise
+
+__all__ = ["count_crossings", "weighted_mean", "get_WRMS", "get_red_chi2"]
+
+
+def count_crossings(x, x0):
+    """Number of crossings of 1-D array x across threshold x0.
+
+    Equivalent of /root/reference/pplib.py:686-694.
+    """
+    x = jnp.asarray(x)
+    d = x - x0
+    return (jnp.diff(jnp.sign(d)) != 0).sum() - (d == 0).sum()
+
+
+def weighted_mean(data, errs=1.0):
+    """Weighted mean and its standard error; weights are errs**-2.
+
+    Points with errs <= 0 are excluded.  Equivalent of
+    /root/reference/pplib.py:696-709.
+    """
+    data = jnp.asarray(data)
+    errs = jnp.broadcast_to(jnp.asarray(errs, dtype=data.dtype), data.shape)
+    ok = errs > 0.0
+    w = jnp.where(ok, jnp.where(ok, errs, 1.0) ** -2.0, 0.0)
+    wsum = w.sum()
+    mean = (data * w).sum() / wsum
+    return mean, wsum ** -0.5
+
+
+def get_WRMS(data, errs=1.0):
+    """Weighted root-mean-square (reference pplib.py:711-725)."""
+    data = jnp.asarray(data)
+    errs = jnp.broadcast_to(jnp.asarray(errs, dtype=data.dtype), data.shape)
+    ok = errs > 0.0
+    w = jnp.where(ok, jnp.where(ok, errs, 1.0) ** -2.0, 0.0)
+    mean = (data * w).sum() / w.sum()
+    return jnp.sqrt(((data - mean) ** 2 * w).sum() / w.sum())
+
+
+def get_red_chi2(data, model, errs=None, dof=None):
+    """Reduced chi-squared of data vs model.
+
+    data/model: [..., nbin] (1- or 2-D); errs broadcast per channel; if
+    None, estimated with get_noise.  dof defaults to sum(data.shape),
+    matching the reference (pplib.py:727-750).
+    """
+    data = jnp.asarray(data)
+    model = jnp.asarray(model)
+    resids = data - model
+    if errs is None:
+        errs = get_noise(data)
+    errs = jnp.asarray(errs)
+    if dof is None:
+        dof = sum(data.shape)
+    if data.ndim == 1:
+        return jnp.sum((resids / errs) ** 2) / dof
+    return jnp.sum((resids / errs[..., None]) ** 2) / dof

@@ -1,0 +1,146 @@
+"""Synthetic data factory: in-memory fake-pulsar portraits for tests/benches.
+
+TPU-native equivalent of the reference's fixture generators
+``make_fake_pulsar`` (/root/reference/pplib.py:3189-3384) and
+``add_scintillation`` (/root/reference/pplib.py:1146-1174), minus the
+PSRFITS unload (the PSRFITS-backed variant lives in io.archive once the
+writer exists).  All stochastic pieces use explicit JAX PRNG keys so
+fixtures are reproducible and jit-able.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Dconst, scattering_alpha
+from ..ops.fourier import get_bin_centers, rotate_data
+from ..ops.profiles import gen_gaussian_portrait
+from ..ops.scattering import scattering_portrait_FT, scattering_times
+from ..utils.databunch import DataBunch
+
+__all__ = ["add_scintillation", "make_fake_portrait", "make_fake_dataset"]
+
+
+def add_scintillation(port, params=None, key=None, nsin=2, amax=1.0,
+                      wmax=3.0):
+    """Multiply channels by a sum-of-sin^2 fake scintillation pattern.
+
+    params: flat triplets (amp, freq [cycles], phase [cycles]); if None, a
+    PRNG ``key`` draws nsin triplets (amp ~ U[0, amax], freq ~ chi2(wmax),
+    phase ~ U[0, 1]).  Equivalent of /root/reference/pplib.py:1146-1174.
+    """
+    port = jnp.asarray(port)
+    nchan = port.shape[-2]
+    x = jnp.linspace(0.0, jnp.pi, nchan)
+    if params is not None:
+        trip = jnp.asarray(params).reshape(-1, 3)
+        a, w, p = trip[:, 0], trip[:, 1], trip[:, 2]
+    elif key is not None:
+        ka, kw, kp = jax.random.split(key, 3)
+        a = jax.random.uniform(ka, (nsin,), maxval=amax)
+        w = 2.0 * jax.random.gamma(kw, 0.5 * wmax, (nsin,))  # chi2(wmax)
+        p = jax.random.uniform(kp, (nsin,))
+    else:
+        return port
+    pattern = jnp.sum(a[:, None] * jnp.sin(w[:, None] * x[None, :]
+                                           + p[:, None] * jnp.pi) ** 2,
+                      axis=0)
+    return port * pattern[..., :, None]
+
+
+def make_fake_portrait(model_params, nchan, nbin, freqs, P, *,
+                       model_code="000", nu_ref=None,
+                       scattering_index=scattering_alpha,
+                       phase=0.0, DM=0.0, GM=0.0, t_scat=0.0,
+                       scint=False, scint_params=None,
+                       noise_std=0.0, scales=1.0, weights=None, key=None,
+                       nu_dm=jnp.inf):
+    """One synthetic [nchan, nbin] portrait with injected parameters.
+
+    model_params: Gaussian portrait parameter vector (see
+    gen_gaussian_portrait).  phase/DM/GM inject a rotation (phase in [rot]
+    referenced to nu_dm); t_scat [sec] applies scattering when the model
+    itself has none; noise_std adds white noise (scalar or [nchan]);
+    scales multiplies channels (scalar or [nchan]).
+
+    Mirrors the per-subint synthesis loop of the reference's
+    make_fake_pulsar (/root/reference/pplib.py:3330-3384) as a pure
+    function of a PRNG key.
+    """
+    freqs = jnp.asarray(freqs)
+    phases = get_bin_centers(nbin)
+    if nu_ref is None:
+        nu_ref = float(jnp.mean(freqs))
+    port = gen_gaussian_portrait(model_code, model_params, scattering_index,
+                                 phases, freqs, nu_ref)
+    # Inject rotation: negative phase/DM rotates to *later* phases, i.e.
+    # simulates a delayed, dispersed pulse (reference uses
+    # rotate_data(model, -phase, -dDM, ...)).
+    port = rotate_data(port, -phase, -DM, P, freqs, nu_dm)
+    if t_scat:
+        taus = scattering_times(t_scat / P, scattering_index, freqs, nu_ref)
+        sp_FT = scattering_portrait_FT(taus, nbin)
+        port = jnp.fft.irfft(sp_FT * jnp.fft.rfft(port, axis=-1), n=nbin,
+                             axis=-1)
+    if scint is not False:
+        if scint is True:
+            key, kscint = jax.random.split(key)
+            port = add_scintillation(port, key=kscint, nsin=3, amax=1.0,
+                                     wmax=5.0)
+        else:
+            port = add_scintillation(port, params=scint_params)
+    port = port * jnp.broadcast_to(jnp.asarray(scales), (nchan,))[:, None]
+    if key is not None:
+        noise = jnp.broadcast_to(jnp.asarray(noise_std), (nchan,))
+        port = port + noise[:, None] * jax.random.normal(key, (nchan, nbin),
+                                                         dtype=port.dtype)
+    if weights is not None:
+        port = port * jnp.asarray(weights)[:, None]
+    return port
+
+
+def make_fake_dataset(key, model_params, *, nsub=10, nchan=64, nbin=512,
+                      lofreq=1300.0, bw=800.0, P=0.005, model_code="000",
+                      scattering_index=scattering_alpha, nu_ref=None,
+                      phases=None, dDMs=None, DM0=30.0, noise_std=0.1,
+                      t_scat=0.0, scint=False):
+    """A batch of synthetic subints with known injected (phase, dDM).
+
+    Returns a DataBunch patterned on load_data's schema
+    (/root/reference/pplib.py:2809-2820) restricted to the fields the
+    device pipeline consumes: subints [nsub, nchan, nbin], freqs [nchan],
+    weights, noise_stds, Ps, plus the injected truth (phases_inj,
+    dDMs_inj, DM0).  Frequencies are channel centers across [lofreq,
+    lofreq+bw], matching the example workload geometry
+    (/root/reference/examples/example.py:18-28).
+    """
+    chan_bw = bw / nchan
+    freqs = lofreq + chan_bw * (jnp.arange(nchan) + 0.5)
+    if nu_ref is None:
+        nu_ref = float(jnp.mean(freqs))
+    keys = jax.random.split(key, nsub + 2)
+    if phases is None:
+        phases = jax.random.uniform(keys[-1], (nsub,), minval=-0.4,
+                                    maxval=0.4)
+    else:
+        phases = jnp.broadcast_to(jnp.asarray(phases), (nsub,))
+    if dDMs is None:
+        dDMs = jax.random.normal(keys[-2], (nsub,)) * \
+            5e-4 * P / (Dconst * (freqs.min() ** -2 - freqs.max() ** -2))
+    else:
+        dDMs = jnp.broadcast_to(jnp.asarray(dDMs), (nsub,))
+
+    def one(k, phi, ddm):
+        return make_fake_portrait(
+            model_params, nchan, nbin, freqs, P, model_code=model_code,
+            nu_ref=nu_ref, scattering_index=scattering_index, phase=phi,
+            DM=ddm, t_scat=t_scat, scint=scint, noise_std=noise_std, key=k,
+            nu_dm=nu_ref)
+
+    subints = jax.vmap(one)(keys[:nsub], phases, dDMs)
+    return DataBunch(
+        subints=subints, freqs=freqs,
+        weights=jnp.ones((nsub, nchan)),
+        noise_stds=jnp.full((nsub, nchan), noise_std),
+        Ps=jnp.full((nsub,), P), nu_ref=nu_ref, nbin=nbin,
+        phases_inj=phases, dDMs_inj=dDMs, DM0=DM0,
+        model_code=model_code, model_params=jnp.asarray(model_params))

@@ -1,0 +1,1 @@
+"""Utility records and tables."""

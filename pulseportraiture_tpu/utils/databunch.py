@@ -1,0 +1,34 @@
+"""DataBunch: attribute-accessible dict used as the universal result record.
+
+Equivalent of the reference's ``DataBunch`` (/root/reference/pplib.py:125-136).
+Registered as a JAX pytree so fit results can flow through jit/vmap
+boundaries untouched.
+"""
+
+import jax
+
+
+class DataBunch(dict):
+    """dict with attribute access: ``db.a`` is ``db['a']``."""
+
+    def __init__(self, **kwds):
+        dict.__init__(self, kwds)
+        self.__dict__ = self
+
+    def __repr__(self):  # stable ordering for readable printing
+        keys = ", ".join(sorted(self.keys()))
+        return f"DataBunch({keys})"
+
+
+def _flatten(db):
+    keys = sorted(db.keys())
+    return [db[k] for k in keys], keys
+
+
+def _unflatten(keys, values):
+    return DataBunch(**dict(zip(keys, values)))
+
+
+jax.tree_util.register_pytree_node(DataBunch, _flatten, _unflatten)
+
+__all__ = ["DataBunch"]

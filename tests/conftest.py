@@ -1,0 +1,32 @@
+"""Test configuration: force a virtual 8-device CPU platform.
+
+The container's sitecustomize pre-imports jax and registers an 'axon'
+TPU-tunnel platform (JAX_PLATFORMS=axon in the env), so environment
+variables alone don't reach the config — we update the live jax config
+before any backend is initialized.  XLA_FLAGS must still be set before
+the CPU client is created to get 8 virtual devices for sharding tests.
+"""
+
+import os
+
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+assert jax.devices()[0].platform == "cpu"
+assert len(jax.devices()) == 8, jax.devices()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

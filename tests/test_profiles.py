@@ -1,0 +1,120 @@
+"""Tests for ops.profiles (Gaussian generation + evolution laws)."""
+
+import numpy as np
+
+from pulseportraiture_tpu.ops import profiles as pf
+from pulseportraiture_tpu.ops.fourier import get_bin_centers
+
+
+def np_wrapped_gaussian(nbin, loc, wid):
+    """Oracle: peak-1 wrapped Gaussian like the reference's
+    gaussian_profile (pplib.py:770-825)."""
+    sigma = wid / (2 * np.sqrt(2 * np.log(2)))
+    mean = loc % 1.0
+    locval = np.linspace(0.5 / nbin, 1 - 0.5 / nbin, nbin)
+    if mean < 0.5:
+        locval = np.where(locval > mean + 0.5, locval - 1.0, locval)
+    else:
+        locval = np.where(locval < mean - 0.5, locval + 1.0, locval)
+    zs = (locval - mean) / sigma
+    retval = np.where(np.abs(zs) < 20.0,
+                      np.exp(-0.5 * zs ** 2) / (sigma * np.sqrt(2 * np.pi)),
+                      0.0)
+    z = (locval[retval.argmax()] - loc) / sigma
+    fact = np.exp(-0.5 * z ** 2) / retval[retval.argmax()]
+    return fact * retval
+
+
+def test_gaussian_profile_matches_oracle():
+    for loc, wid in [(0.3, 0.05), (0.02, 0.1), (0.97, 0.03), (0.5, 0.25)]:
+        got = np.asarray(pf.gaussian_profile(256, loc, wid))
+        want = np_wrapped_gaussian(256, loc, wid)
+        np.testing.assert_allclose(got, want, atol=1e-10,
+                                   err_msg=f"loc={loc} wid={wid}")
+
+
+def test_gaussian_profile_zero_width():
+    assert np.all(np.asarray(pf.gaussian_profile(64, 0.5, 0.0)) == 0.0)
+    assert np.all(np.asarray(pf.gaussian_profile(64, 0.5, -0.1)) == 0.0)
+
+
+def test_gaussian_profile_peak_is_one():
+    prof = np.asarray(pf.gaussian_profile(512, 0.5, 0.1))
+    np.testing.assert_allclose(prof.max(), 1.0, rtol=1e-3)
+
+
+def test_gen_gaussian_profile_dc_and_sum():
+    # two components + DC, no scattering
+    params = [0.1, 0.0, 0.3, 0.05, 1.0, 0.6, 0.1, 0.5]
+    got = np.asarray(pf.gen_gaussian_profile(params, 256))
+    want = 0.1 + np_wrapped_gaussian(256, 0.3, 0.05) * 1.0 \
+        + np_wrapped_gaussian(256, 0.6, 0.1) * 0.5
+    np.testing.assert_allclose(got, want, atol=1e-10)
+
+
+def test_gen_gaussian_profile_scattering_conserves_flux():
+    params = [0.0, 12.0, 0.3, 0.05, 1.0]
+    prof = np.asarray(pf.gen_gaussian_profile(params, 256))
+    unscat = np.asarray(pf.gen_gaussian_profile([0.0, 0.0, 0.3, 0.05, 1.0],
+                                                256))
+    np.testing.assert_allclose(prof.sum(), unscat.sum(), rtol=1e-8)
+    assert prof.max() < unscat.max()  # scattering broadens
+
+
+def test_evolution_laws():
+    freqs = np.linspace(1300.0, 1700.0, 16)
+    par = np.array([0.5, 0.2])
+    idx = np.array([-0.3, 0.4])
+    pl = np.asarray(pf.power_law_evolution(freqs, 1500.0, par, idx))
+    np.testing.assert_allclose(pl, par * (freqs[:, None] / 1500.0) ** idx,
+                               rtol=1e-12)
+    lin = np.asarray(pf.linear_evolution(freqs, 1500.0, par, idx))
+    np.testing.assert_allclose(lin, par + idx * (freqs[:, None] - 1500.0),
+                               rtol=1e-12)
+
+
+def test_gen_gaussian_portrait_at_nu_ref():
+    # At nu_ref the portrait channel equals the reference profile.
+    freqs = np.array([1400.0, 1500.0, 1600.0])
+    nbin = 128
+    phases = np.asarray(get_bin_centers(nbin))
+    # params: dc, tau, (loc0, dloc, wid0, dwid, amp0, damp)
+    params = np.array([0.05, 0.0, 0.4, -0.1, 0.06, 0.2, 1.0, -1.5])
+    port = np.asarray(pf.gen_gaussian_portrait("000", params, -4.0, phases,
+                                               freqs, 1500.0))
+    ref_prof = np.asarray(pf.gen_gaussian_profile(
+        [0.05, 0.0, 0.4, 0.06, 1.0], nbin))
+    np.testing.assert_allclose(port[1], ref_prof, atol=1e-9)
+    # power-law evolution: loc at 1400 = 0.4*(1400/1500)**-0.1
+    prof0 = np.asarray(pf.gen_gaussian_profile(
+        [0.05, 0.0, 0.4 * (1400 / 1500.) ** -0.1,
+         0.06 * (1400 / 1500.) ** 0.2, 1.0 * (1400 / 1500.) ** -1.5], nbin))
+    np.testing.assert_allclose(port[0], prof0, atol=1e-9)
+
+
+def test_gaussian_portrait_FT_matches_time_domain():
+    freqs = np.linspace(1300.0, 1700.0, 8)
+    nbin = 256
+    phases = np.asarray(get_bin_centers(nbin))
+    params = np.array([0.0, 5.0, 0.4, -0.1, 0.06, 0.2, 1.0, -1.5])
+    port = np.asarray(pf.gen_gaussian_portrait("000", params, -4.0, phases,
+                                               freqs, 1500.0))
+    port_FT = np.asarray(pf.gaussian_portrait_FT("000", params, -4.0, nbin,
+                                                 freqs, 1500.0))
+    np.testing.assert_allclose(port_FT, np.fft.rfft(port, axis=-1),
+                               atol=1e-8)
+
+
+def test_gaussian_profile_FT_gaussian_shape():
+    # FT magnitude of a Gaussian is a Gaussian: |F(k)| =
+    # amp*sigma*sqrt(2pi)*nbin*exp(-2 pi^2 sigma^2 k^2) for moderate widths
+    nbin, loc, wid, amp = 512, 0.37, 0.04, 1.7
+    got = np.asarray(pf.gaussian_profile_FT(nbin, loc, wid, amp))
+    sigma = wid / (2 * np.sqrt(2 * np.log(2)))
+    k = np.arange(nbin // 2 + 1)
+    want_mag = amp * sigma * np.sqrt(2 * np.pi) * nbin * \
+        np.exp(-2 * np.pi ** 2 * sigma ** 2 * k ** 2)
+    np.testing.assert_allclose(np.abs(got)[:40], want_mag[:40], rtol=1e-5)
+    # phase factor: exp(-2j pi k loc) relative to bin-center sampling
+    np.testing.assert_allclose(
+        np.angle(got[1] * np.exp(2j * np.pi * loc)), 0.0, atol=1e-3)

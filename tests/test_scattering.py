@@ -1,0 +1,140 @@
+"""Tests for ops.scattering: analytic kernels and their derivative chain."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pulseportraiture_tpu.ops import scattering as sc
+
+
+def test_scattering_times():
+    freqs = np.linspace(1300.0, 1700.0, 8)
+    got = np.asarray(sc.scattering_times(0.01, -4.0, freqs, 1500.0))
+    np.testing.assert_allclose(got, 0.01 * (freqs / 1500.0) ** -4.0,
+                               rtol=1e-13)
+
+
+def test_scattering_profile_FT_formula():
+    nbin, tau = 128, 0.02
+    got = np.asarray(sc.scattering_profile_FT(tau, nbin))
+    k = np.arange(nbin // 2 + 1)
+    np.testing.assert_allclose(got, 1.0 / (1.0 + 2j * np.pi * k * tau),
+                               rtol=1e-13)
+    ones = np.asarray(sc.scattering_profile_FT(0.0, nbin))
+    np.testing.assert_allclose(ones, np.ones(nbin // 2 + 1), rtol=0)
+
+
+def test_scattering_portrait_FT_zero_tau():
+    taus = np.zeros(4)
+    got = np.asarray(sc.scattering_portrait_FT(taus, 64))
+    np.testing.assert_allclose(got, np.ones((4, 33)), rtol=0)
+
+
+def _chain(tau, alpha, freqs, nu_tau, nbin, log10_tau=True):
+    """Recompute the full scattering FT chain for given (tau, alpha)."""
+    t = 10 ** tau if log10_tau else tau
+    taus = sc.scattering_times(t, alpha, freqs, nu_tau)
+    return sc.scattering_portrait_FT(taus, nbin)
+
+
+def test_scattering_FT_deriv_vs_autodiff():
+    freqs = jnp.linspace(1300.0, 1700.0, 4)
+    nu_tau, nbin = 1500.0, 64
+    tau_p, alpha = -2.0, -4.0  # log10 space
+    t = 10 ** tau_p
+    taus = sc.scattering_times(t, alpha, freqs, nu_tau)
+    taus_d = sc.scattering_times_deriv(t, freqs, nu_tau, True, taus)
+    B = sc.scattering_portrait_FT(taus, nbin)
+    got = np.asarray(sc.scattering_portrait_FT_deriv(taus, taus_d, B))
+
+    jac_tau = jax.jacfwd(lambda x: jnp.real(_chain(x, alpha, freqs, nu_tau,
+                                                   nbin)))(tau_p) + \
+        1j * jax.jacfwd(lambda x: jnp.imag(_chain(x, alpha, freqs, nu_tau,
+                                                  nbin)))(tau_p)
+    jac_alpha = jax.jacfwd(lambda a: jnp.real(_chain(tau_p, a, freqs, nu_tau,
+                                                     nbin)))(alpha) + \
+        1j * jax.jacfwd(lambda a: jnp.imag(_chain(tau_p, a, freqs, nu_tau,
+                                                  nbin)))(alpha)
+    np.testing.assert_allclose(got[0], np.asarray(jac_tau), atol=1e-10)
+    np.testing.assert_allclose(got[1], np.asarray(jac_alpha), atol=1e-10)
+
+
+def test_scattering_FT_2deriv_vs_autodiff():
+    freqs = jnp.linspace(1300.0, 1700.0, 3)
+    nu_tau, nbin = 1500.0, 32
+    tau_p, alpha = -1.5, -3.5
+    t = 10 ** tau_p
+    taus = sc.scattering_times(t, freqs / freqs * alpha * 0 + alpha, freqs,
+                               nu_tau) * 0 + \
+        sc.scattering_times(t, alpha, freqs, nu_tau)
+    taus_d = sc.scattering_times_deriv(t, freqs, nu_tau, True, taus)
+    taus_2d = sc.scattering_times_2deriv(t, freqs, nu_tau, True, taus,
+                                         taus_d)
+    B = sc.scattering_portrait_FT(taus, nbin)
+    got = np.asarray(sc.scattering_portrait_FT_2deriv(taus, taus_d, taus_2d,
+                                                      B))
+
+    def chain_ri(params, part):
+        out = _chain(params[0], params[1], freqs, nu_tau, nbin)
+        return jnp.real(out) if part == 0 else jnp.imag(out)
+
+    p0 = jnp.array([tau_p, alpha])
+    hess = np.asarray(jax.jacfwd(jax.jacfwd(lambda p: chain_ri(p, 0)))(p0)) \
+        + 1j * np.asarray(jax.jacfwd(jax.jacfwd(
+            lambda p: chain_ri(p, 1)))(p0))
+    # hess comes out [nchan, nharm, 2, 2]; ours is [2, 2, nchan, nharm]
+    hess = np.moveaxis(hess, (2, 3), (0, 1))
+    np.testing.assert_allclose(got, hess, atol=1e-9)
+
+
+def test_abs_scattering_derivs_vs_autodiff():
+    freqs = jnp.linspace(1300.0, 1700.0, 3)
+    nu_tau, nbin = 1500.0, 32
+    tau_p, alpha = -1.8, -4.2
+    t = 10 ** tau_p
+    taus = sc.scattering_times(t, alpha, freqs, nu_tau)
+    taus_d = sc.scattering_times_deriv(t, freqs, nu_tau, True, taus)
+    taus_2d = sc.scattering_times_2deriv(t, freqs, nu_tau, True, taus,
+                                         taus_d)
+    B = sc.scattering_portrait_FT(taus, nbin)
+    dB = sc.scattering_portrait_FT_deriv(taus, taus_d, B)
+    d2B = sc.scattering_portrait_FT_2deriv(taus, taus_d, taus_2d, B)
+    got_d = np.asarray(sc.abs_scattering_portrait_FT_deriv(B, dB))
+    got_2d = np.asarray(sc.abs_scattering_portrait_FT_2deriv(B, dB, d2B))
+
+    def absB(p):
+        return jnp.abs(_chain(p[0], p[1], freqs, nu_tau, nbin)) ** 2
+
+    p0 = jnp.array([tau_p, alpha])
+    jac = np.moveaxis(np.asarray(jax.jacfwd(absB)(p0)), 2, 0)
+    hess = np.moveaxis(np.asarray(jax.jacfwd(jax.jacfwd(absB))(p0)),
+                       (2, 3), (0, 1))
+    np.testing.assert_allclose(got_d, jac, atol=1e-9)
+    np.testing.assert_allclose(got_2d, hess, atol=1e-9)
+
+
+def test_time_domain_kernel_matches_FT_for_long_profile(rng):
+    # circular convolution with the sampled exponential approximates the
+    # analytic FT kernel when tau << 1 rot
+    nbin, tau = 2048, 0.01
+    prof = np.zeros(nbin)
+    prof[100] = 1.0
+    sp_FT = np.asarray(sc.scattering_profile_FT(tau, nbin))
+    scattered = np.fft.irfft(sp_FT * np.fft.rfft(prof), nbin)
+    # peak moves later & decays as exp(-t/tau)
+    tail = scattered[110:300]
+    ts = (np.arange(110, 300) - 100) / nbin
+    fit = np.polyfit(ts, np.log(np.abs(tail) + 1e-30), 1)
+    np.testing.assert_allclose(-1.0 / fit[0], tau, rtol=0.2)
+
+
+def test_add_scattering_area_preserving(rng):
+    from pulseportraiture_tpu.ops.profiles import gaussian_profile
+    nbin = 256
+    prof = np.asarray(gaussian_profile(nbin, 0.3, 0.05))
+    kern = np.asarray(sc.scattering_kernel(0.001, 1500.0,
+                                           np.array([1500.0]), nbin,
+                                           P=0.005, alpha=-4.0))
+    out = np.asarray(sc.add_scattering(prof, kern[0]))
+    np.testing.assert_allclose(out.sum(), prof.sum(), rtol=1e-6)
+    assert out.max() < prof.max()
