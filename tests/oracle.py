@@ -1,0 +1,70 @@
+"""NumPy/SciPy oracle for the 5-parameter portrait fit.
+
+An independent, straightforward implementation of the same statistical
+model the reference uses (pptoaslib.py:525-731, 928-1096): data_FT ~
+a_n B_n m_FT phasor with a_n maximized analytically.  Used to validate
+the JAX kernels at float64; written from the math, driven by
+scipy.optimize like the reference.
+"""
+
+import numpy as np
+import scipy.optimize as opt
+
+Dconst = 0.000241 ** -1
+
+
+def oracle_moments(params, dFFT, mFFT, errs_FT, P, freqs, nu_DM, nu_GM,
+                   nu_tau, log10_tau):
+    phi, DM, GM, tau_p, alpha = params
+    tau = 10 ** tau_p if log10_tau else tau_p
+    nharm = dFFT.shape[-1]
+    nbin = 2 * (nharm - 1)
+    k = np.arange(nharm)
+    shifts = phi + Dconst * DM * (freqs ** -2 - nu_DM ** -2) / P \
+        + Dconst ** 2 * GM * (freqs ** -4 - nu_GM ** -4) / P
+    phsr = np.exp(2j * np.pi * np.outer(shifts, k))
+    taus = tau * (freqs / nu_tau) ** alpha
+    B = 1.0 / (1.0 + 2j * np.pi * k[None, :] * taus[:, None])
+    C = np.real(np.sum(dFFT * np.conj(mFFT) * np.conj(B) * phsr,
+                       axis=-1)) / errs_FT ** 2
+    S = np.sum(np.abs(B) ** 2 * np.abs(mFFT) ** 2, axis=-1) / errs_FT ** 2
+    return C, S
+
+
+def oracle_objective(params, dFFT, mFFT, errs_FT, P, freqs, nu_DM, nu_GM,
+                     nu_tau, log10_tau):
+    C, S = oracle_moments(params, dFFT, mFFT, errs_FT, P, freqs, nu_DM,
+                          nu_GM, nu_tau, log10_tau)
+    return -np.sum(C ** 2 / S)
+
+
+def oracle_fit(data_port, model_port, init_params, P, freqs,
+               fit_flags=(1, 1, 0, 0, 0), log10_tau=True, noise=None,
+               nu_fits=None):
+    """Minimize the oracle objective with scipy (Nelder-Mead + polish)."""
+    nbin = data_port.shape[-1]
+    dFFT = np.fft.rfft(data_port, axis=-1)
+    dFFT[:, 0] = 0.0
+    mFFT = np.fft.rfft(model_port, axis=-1)
+    mFFT[:, 0] = 0.0
+    if noise is None:
+        noise = np.ones(len(freqs))
+    errs_FT = np.asarray(noise) * np.sqrt(nbin / 2.0)
+    nu = np.mean(freqs) if nu_fits is None else nu_fits
+    flags = np.asarray(fit_flags, bool)
+    x0 = np.asarray(init_params, float)
+
+    def fun(xfit):
+        x = x0.copy()
+        x[flags] = xfit
+        return oracle_objective(x, dFFT, mFFT, errs_FT, P, freqs, nu, nu,
+                                nu, log10_tau)
+
+    res = opt.minimize(fun, x0[flags], method="Nelder-Mead",
+                       options={"xatol": 1e-12, "fatol": 1e-14,
+                                "maxiter": 20000, "maxfev": 20000})
+    res = opt.minimize(fun, res.x, method="Powell",
+                       options={"xtol": 1e-12, "ftol": 1e-14})
+    x = x0.copy()
+    x[flags] = res.x
+    return x, res.fun

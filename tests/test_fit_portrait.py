@@ -1,0 +1,232 @@
+"""Tests for the batched 5-parameter portrait fit kernel."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.config import Dconst
+from pulseportraiture_tpu.fit import portrait as fp
+from pulseportraiture_tpu.ops.fourier import get_bin_centers, rotate_data
+from pulseportraiture_tpu.ops.profiles import gen_gaussian_portrait
+from pulseportraiture_tpu.ops.scattering import (scattering_portrait_FT,
+                                                 scattering_times)
+from oracle import oracle_fit, oracle_objective
+
+NBIN = 256
+NCHAN = 16
+P0 = 0.005
+FREQS = np.linspace(1300.0, 1700.0, NCHAN) + 12.5
+MODEL_PARAMS = np.array([0.0, 0.0, 0.35, -0.05, 0.05, 0.1, 1.0, -1.2])
+
+
+def make_model():
+    phases = np.asarray(get_bin_centers(NBIN))
+    return np.asarray(gen_gaussian_portrait("000", MODEL_PARAMS, -4.0,
+                                            phases, FREQS, 1500.0))
+
+
+def make_data(phi=0.0, dDM=0.0, tau=0.0, alpha=-4.0, noise=0.0, seed=0):
+    """Rotated/scattered/noisy copy of the model portrait."""
+    model = make_model()
+    port = np.asarray(rotate_data(model, -phi, -dDM, P0, FREQS,
+                                  np.mean(FREQS)))
+    if tau > 0.0:
+        taus = np.asarray(scattering_times(tau, alpha, FREQS,
+                                           np.mean(FREQS)))
+        B = np.asarray(scattering_portrait_FT(taus, NBIN))
+        port = np.fft.irfft(B * np.fft.rfft(port, axis=-1), NBIN, axis=-1)
+    if noise > 0.0:
+        rng = np.random.default_rng(seed)
+        port = port + rng.normal(0.0, noise, port.shape)
+    return model, port
+
+
+def _prep(data, model, noise):
+    dFFT = jnp.fft.rfft(jnp.asarray(data), axis=-1).at[:, 0].multiply(0)
+    mFFT = jnp.fft.rfft(jnp.asarray(model), axis=-1).at[:, 0].multiply(0)
+    errs_FT = jnp.full(NCHAN, noise) * jnp.sqrt(NBIN / 2.0)
+    return dFFT * jnp.conj(mFFT), jnp.abs(mFFT) ** 2, errs_FT ** -2.0
+
+
+def test_objective_matches_oracle():
+    model, data = make_data(phi=0.05, dDM=1e-3, tau=0.003, noise=0.01)
+    cross, abs_m2, inv_err2 = _prep(data, model, 0.01)
+    params = jnp.asarray([0.03, 5e-4, 0.0, np.log10(2e-3), -4.0])
+    nu = float(np.mean(FREQS))
+    got = float(fp.portrait_objective(params, cross, abs_m2, inv_err2,
+                                      jnp.asarray(FREQS), P0, nu, nu, nu,
+                                      True, NBIN))
+    dFFT = np.fft.rfft(data, axis=-1)
+    dFFT[:, 0] = 0.0
+    mFFT = np.fft.rfft(model, axis=-1)
+    mFFT[:, 0] = 0.0
+    want = oracle_objective(np.asarray(params), dFFT, mFFT,
+                            np.full(NCHAN, 0.01) * np.sqrt(NBIN / 2.0),
+                            P0, FREQS, nu, nu, nu, True)
+    np.testing.assert_allclose(got, want, rtol=1e-10)
+
+
+def test_grad_hess_match_autodiff():
+    model, data = make_data(phi=0.05, dDM=1e-3, tau=0.003, noise=0.01)
+    cross, abs_m2, inv_err2 = _prep(data, model, 0.01)
+    nu = float(np.mean(FREQS))
+    params = jnp.asarray([0.03, 5e-4, 1e-8, np.log10(2e-3), -3.8])
+
+    def obj(p):
+        return fp.portrait_objective(p, cross, abs_m2, inv_err2,
+                                     jnp.asarray(FREQS), P0, nu, nu, nu,
+                                     True, NBIN)
+
+    f, g, H = fp.portrait_grad_hess(params, cross, abs_m2, inv_err2,
+                                    jnp.asarray(FREQS), P0, nu, nu, nu,
+                                    (1, 1, 1, 1, 1), True, NBIN)
+    np.testing.assert_allclose(float(f), float(obj(params)), rtol=1e-12)
+    g_ad = jax.grad(obj)(params)
+    H_ad = jax.hessian(obj)(params)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ad), rtol=1e-7,
+                               atol=1e-10 * float(jnp.abs(g_ad).max()))
+    np.testing.assert_allclose(np.asarray(H), np.asarray(H_ad), rtol=1e-6,
+                               atol=1e-9 * float(jnp.abs(H_ad).max()))
+
+
+def test_recover_phase_dm_noiseless():
+    phi_inj, dDM_inj = 0.123, 2.3e-3
+    model, data = make_data(phi=phi_inj, dDM=dDM_inj)
+    out = fp.fit_portrait_full(data, model, [0.1, 0.0, 0.0, 0.0, 0.0], P0,
+                               FREQS, errs=np.full(NCHAN, 1e-3),
+                               fit_flags=(1, 1, 0, 0, 0), log10_tau=False)
+    # DM must be exact; phi is referenced to nu_zero
+    np.testing.assert_allclose(float(out.DM), dDM_inj, atol=1e-9)
+    # transform phi back to the injection reference (mean freq)
+    nu0 = np.mean(FREQS)
+    phi_at_nu0 = float(out.phi) + Dconst * float(out.DM) / P0 * \
+        (nu0 ** -2.0 - float(out.nu_DM) ** -2.0)
+    err = (phi_at_nu0 - phi_inj + 0.5) % 1.0 - 0.5
+    assert abs(err) < 1e-8, err
+    assert int(out.return_code) in (1, 2)
+
+
+def test_recover_full_five_param():
+    phi_inj, dDM_inj, tau_inj, alpha_inj = 0.07, 1.1e-3, 0.004, -4.2
+    model, data = make_data(phi=phi_inj, dDM=dDM_inj, tau=tau_inj,
+                            alpha=alpha_inj, noise=0.002, seed=3)
+    out = fp.fit_portrait_full(
+        data, model, [0.0, 0.0, 0.0, np.log10(1e-3), -4.0], P0, FREQS,
+        errs=np.full(NCHAN, 2e-3), fit_flags=(1, 1, 0, 1, 1),
+        log10_tau=True, max_iter=100)
+    np.testing.assert_allclose(float(out.DM), dDM_inj,
+                               atol=5 * float(out.DM_err))
+    # compare tau at the injection reference frequency
+    tau_at_nu0 = 10 ** float(out.tau) * (np.mean(FREQS)
+                                         / float(out.nu_tau)
+                                         ) ** float(out.alpha)
+    np.testing.assert_allclose(tau_at_nu0, tau_inj, rtol=0.05)
+    np.testing.assert_allclose(float(out.alpha), alpha_inj, atol=0.2)
+
+
+def test_matches_scipy_oracle_minimum():
+    model, data = make_data(phi=0.08, dDM=1.5e-3, noise=0.01, seed=5)
+    noise = np.full(NCHAN, 0.01)
+    out = fp.fit_portrait_full(data, model, [0.05, 0.0, 0.0, 0.0, 0.0],
+                               P0, FREQS, errs=noise,
+                               fit_flags=(1, 1, 0, 0, 0), log10_tau=False)
+    x_or, f_or = oracle_fit(data, model, [0.05, 0.0, 0.0, 0.0, 0.0], P0,
+                            FREQS, fit_flags=(1, 1, 0, 0, 0),
+                            log10_tau=False, noise=noise)
+    # Our minimizer should find at least as good a minimum, and the same
+    # (phi, DM) up to the oracle's convergence tolerance.
+    nu0 = np.mean(FREQS)
+    phi_at_nu0 = float(out.phi) + Dconst * float(out.DM) / P0 * \
+        (nu0 ** -2.0 - float(out.nu_DM) ** -2.0)
+    assert abs(phi_at_nu0 - x_or[0]) < 1e-6
+    assert abs(float(out.DM) - x_or[1]) < 1e-6
+    f_ours = float(out.chi2) - float(
+        np.sum(np.abs(np.fft.rfft(data, axis=-1)[:, 1:]) ** 2
+               / (0.01 ** 2 * NBIN / 2.0)))
+    assert f_ours <= f_or + 1e-6 * abs(f_or)
+
+
+def test_batched_fit_recovers_per_subint(rng):
+    nsub = 8
+    phis = rng.uniform(-0.3, 0.3, nsub)
+    dDMs = rng.uniform(-2e-3, 2e-3, nsub)
+    model = make_model()
+    datas = np.stack([
+        np.asarray(rotate_data(model, -phis[i], -dDMs[i], P0, FREQS,
+                               np.mean(FREQS)))
+        + rng.normal(0, 0.005, model.shape) for i in range(nsub)])
+    # seed the phase like the pipeline does: FFTFIT on band-avg profiles
+    from pulseportraiture_tpu.fit.phase_shift import fit_phase_shift
+    guess = fit_phase_shift(datas.mean(axis=1), model.mean(axis=0)[None],
+                            noise=np.full(nsub, 0.005))
+    init = np.zeros((nsub, 5))
+    init[:, 0] = np.asarray(guess.phase)
+    out = fp.fit_portrait_full_batch(
+        datas, model[None], init, P0, FREQS,
+        errs=np.full((nsub, NCHAN), 0.005), fit_flags=(1, 1, 0, 0, 0),
+        log10_tau=False)
+    assert out.phi.shape == (nsub,)
+    np.testing.assert_allclose(np.asarray(out.DM), dDMs,
+                               atol=6 * np.asarray(out.DM_err).max())
+    nu0 = np.mean(FREQS)
+    phi_at_nu0 = np.asarray(out.phi) + Dconst * np.asarray(out.DM) / P0 * \
+        (nu0 ** -2.0 - np.asarray(out.nu_DM) ** -2.0)
+    err = (phi_at_nu0 - phis + 0.5) % 1.0 - 0.5
+    assert np.abs(err).max() < 5e-5
+
+
+def test_nu_zero_decorrelates_phi_dm():
+    # at nu_out = nu_zero the reported phi/DM covariance should be ~0
+    model, data = make_data(phi=0.1, dDM=1e-3, noise=0.01, seed=2)
+    out = fp.fit_portrait_full(data, model, np.zeros(5), P0, FREQS,
+                               errs=np.full(NCHAN, 0.01),
+                               fit_flags=(1, 1, 0, 0, 0), log10_tau=False)
+    cov = np.asarray(out.covariance_matrix)
+    rho = cov[0, 1] / np.sqrt(cov[0, 0] * cov[1, 1])
+    assert abs(rho) < 0.05, rho
+
+
+def test_error_calibration_phase_dm(rng):
+    # empirical scatter of fitted params across noise realizations should
+    # match the reported 1-sigma errors
+    ntrial = 24
+    model = make_model()
+    phi_inj, dDM_inj, noise = 0.05, 5e-4, 0.02
+    base = np.asarray(rotate_data(model, -phi_inj, -dDM_inj, P0, FREQS,
+                                  np.mean(FREQS)))
+    datas = base[None] + rng.normal(0, noise, (ntrial,) + base.shape)
+    out = fp.fit_portrait_full_batch(
+        datas, model[None], np.zeros(5), P0, FREQS,
+        errs=np.full((ntrial, NCHAN), noise), fit_flags=(1, 1, 0, 0, 0),
+        log10_tau=False)
+    emp_dm = np.asarray(out.DM).std()
+    rep_dm = np.median(np.asarray(out.DM_err))
+    assert 0.4 < emp_dm / rep_dm < 2.5, (emp_dm, rep_dm)
+    emp_phi = np.asarray(out.phi).std()
+    rep_phi = np.median(np.asarray(out.phi_err))
+    assert 0.4 < emp_phi / rep_phi < 2.5, (emp_phi, rep_phi)
+
+
+def test_red_chi2_near_unity(rng):
+    model, data = make_data(phi=0.02, dDM=3e-4, noise=0.03, seed=11)
+    out = fp.fit_portrait_full(data, model, np.zeros(5), P0, FREQS,
+                               errs=np.full(NCHAN, 0.03),
+                               fit_flags=(1, 1, 0, 0, 0), log10_tau=False)
+    assert 0.8 < float(out.red_chi2) < 1.2, float(out.red_chi2)
+
+
+def test_two_param_wrapper():
+    model, data = make_data(phi=0.11, dDM=8e-4)
+    out = fp.fit_portrait(data, model, [0.1, 0.0], P0, FREQS,
+                          errs=np.full(NCHAN, 1e-3))
+    np.testing.assert_allclose(float(out.DM), 8e-4, atol=1e-8)
+    assert "phase" in out and "covariance" in out
+
+
+def test_get_scales_recovers_amplitudes(rng):
+    model = make_model()
+    amps = rng.uniform(0.5, 2.0, NCHAN)
+    data = model * amps[:, None]
+    scales = np.asarray(fp.get_scales(data, model, 0.0, 0.0, P0, FREQS))
+    np.testing.assert_allclose(scales, amps, rtol=1e-10)
