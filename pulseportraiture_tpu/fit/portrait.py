@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import F0_fact
+from ..config import Dconst, F0_fact
 from ..ops.noise import get_noise
 from ..ops.scattering import (
     abs_scattering_portrait_FT_2deriv,
@@ -50,7 +50,6 @@ from ..ops.scattering import (
     scattering_times_2deriv,
     scattering_times_deriv,
 )
-from ..config import Dconst
 from ..utils.databunch import DataBunch
 
 __all__ = ["fit_portrait_full", "fit_portrait_full_batch", "fit_portrait",
@@ -147,7 +146,9 @@ def portrait_objective(params, cross, abs_m2, inv_err2, freqs, P, nu_DM,
     """
     m = _moments(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
                  nu_tau, log10_tau, nbin, order=0)
-    return -jnp.sum(m["C"] ** 2 / m["S"])
+    C, S = m["C"], m["S"]
+    safe_S = jnp.where(S > 0.0, S, 1.0)
+    return -jnp.sum(jnp.where(S > 0.0, C ** 2 / safe_S, 0.0))
 
 
 def portrait_grad_hess(params, cross, abs_m2, inv_err2, freqs, P, nu_DM,
@@ -163,17 +164,23 @@ def portrait_grad_hess(params, cross, abs_m2, inv_err2, freqs, P, nu_DM,
     C, S, dC, dS, d2C, d2S = m["C"], m["S"], m["dC"], m["dS"], m["d2C"], \
         m["d2S"]
     flags = jnp.asarray(fit_flags, dtype=C.dtype)
-    f = -jnp.sum(C ** 2 / S)
-    grad = -jnp.sum(2.0 * C * dC / S - (C ** 2) * dS / S ** 2, axis=-1)
+    ok = S > 0.0  # zero-weight (zapped) channels drop out of all sums
+    S = jnp.where(ok, S, 1.0)
+    C = jnp.where(ok, C, 0.0)
+    f = -jnp.sum(jnp.where(ok, C ** 2 / S, 0.0))
+    grad = -jnp.sum(jnp.where(ok, 2.0 * C * dC / S
+                              - (C ** 2) * dS / S ** 2, 0.0), axis=-1)
     grad = grad * flags
     # Hij_n = -2 (C^2/S) [d2C/C - d2S/(2S) + dC_i dC_j/C^2 + dS_i dS_j/S^2
     #                     - (dC_i dS_j + dS_i dC_j)/(C S)]
-    w = C ** 2 / S
-    Hn = -2.0 * w * (d2C / C - 0.5 * d2S / S
-                     + dC[:, None] * dC[None, :] / C ** 2
-                     + dS[:, None] * dS[None, :] / S ** 2
-                     - (dC[:, None] * dS[None, :]
-                        + dS[:, None] * dC[None, :]) / (C * S))
+    safe_C = jnp.where(C != 0.0, C, 1.0)
+    Hn = -2.0 * (C ** 2 / S) * (d2C / safe_C - 0.5 * d2S / S
+                                + dC[:, None] * dC[None, :] / safe_C ** 2
+                                + dS[:, None] * dS[None, :] / S ** 2
+                                - (dC[:, None] * dS[None, :]
+                                   + dS[:, None] * dC[None, :])
+                                / (safe_C * S))
+    Hn = jnp.where(ok[None, None, :], Hn, 0.0)
     Hn = Hn * flags[:, None, None] * flags[None, :, None]
     H = Hn if per_channel else Hn.sum(axis=-1)
     return f, grad, H
@@ -193,14 +200,20 @@ def _hess_with_scales(params, cross, abs_m2, inv_err2, freqs, P, nu_DM,
     C, S, dC, dS, d2C, d2S = m["C"], m["S"], m["dC"], m["dS"], m["d2C"], \
         m["d2S"]
     flags = jnp.asarray(fit_flags, dtype=C.dtype)
-    scales = C / S
-    Hn = -2.0 * (C ** 2 / S) * (d2C / C - 0.5 * d2S / S)
+    ok = S > 0.0
+    S = jnp.where(ok, S, 1.0)
+    C = jnp.where(ok, C, 0.0)
+    safe_C = jnp.where(C != 0.0, C, 1.0)
+    scales = jnp.where(ok, C / S, 0.0)
+    Hn = -2.0 * (C ** 2 / S) * (d2C / safe_C - 0.5 * d2S / S)
+    Hn = jnp.where(ok[None, None, :], Hn, 0.0)
     Hn = Hn * flags[:, None, None] * flags[None, :, None]
     cross_hess = -2.0 * (dC - scales[None] * dS) * flags[:, None]
-    return Hn.sum(axis=-1), cross_hess, S, C, scales
+    cross_hess = jnp.where(ok[None, :], cross_hess, 0.0)
+    return Hn.sum(axis=-1), cross_hess, S, C, scales, ok
 
 
-def _covariance_with_scales(H5, cross_hess, S, ifit):
+def _covariance_with_scales(H5, cross_hess, S, ifit, ok):
     """Woodbury/block-LDU covariance for (fit params, a_n) jointly.
 
     cov_fit = 2 * inv(A - U diag(1/(2S)) U^T) with A the fitted sub-block
@@ -211,13 +224,14 @@ def _covariance_with_scales(H5, cross_hess, S, ifit):
     """
     A = H5[jnp.ix_(ifit, ifit)]
     U = cross_hess[ifit]                        # [nfit, nchan]
-    Cinv = 1.0 / (2.0 * S)                      # diag entries
+    Cinv = jnp.where(ok, 1.0 / (2.0 * S), 0.0)  # zapped: no contribution
     X = A - (U * Cinv[None, :]) @ U.T
     X_inv = jnp.linalg.inv(X)
     cov_fit = 2.0 * X_inv
     # scale_errs^2 = 2 * (Cinv + Cinv^2 * diag(U^T X_inv U))
     UtXU_diag = jnp.einsum("fn,fg,gn->n", U, X_inv, U)
-    scale_errs = jnp.sqrt(2.0 * (Cinv + Cinv ** 2 * UtXU_diag))
+    scale_errs = jnp.where(
+        ok, jnp.sqrt(2.0 * (Cinv + Cinv ** 2 * UtXU_diag)), jnp.inf)
     return cov_fit, scale_errs
 
 
@@ -474,7 +488,7 @@ def _solve(init_params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
 
 def fit_portrait_full(data_port, model_port, init_params, P, freqs,
                       nu_fits=(None, None, None),
-                      nu_outs=(None, None, None), errs=None,
+                      nu_outs=(None, None, None), errs=None, weights=None,
                       fit_flags=(1, 1, 1, 1, 1), bounds=None,
                       log10_tau=True, option=0, max_iter=50, is_toa=True,
                       quiet=True):
@@ -510,6 +524,12 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
         errs_FT = jnp.asarray(errs) * jnp.sqrt(nbin / 2.0)
     errs_FT = jnp.broadcast_to(errs_FT, (nchan,))
     inv_err2 = errs_FT ** -2.0
+    if weights is not None:
+        # zero-weight (zapped) channels contribute nothing to any sum
+        wmask = jnp.asarray(weights) > 0.0
+        inv_err2 = jnp.where(wmask, inv_err2, 0.0)
+        nchan_ok = wmask.sum()
+        dof = nbin * nchan_ok - (nfit + nchan_ok)
     cross = dFFT * jnp.conj(mFFT)
     abs_m2 = jnp.abs(mFFT) ** 2
     Sd = jnp.sum(jnp.abs(dFFT) ** 2 * inv_err2[:, None])
@@ -569,11 +589,11 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
     params_out = jnp.stack([phi_out, DM_fit, GM_fit, tau_out, alpha_fit])
 
     # Hessian + covariance + scales at the output references.
-    H5, cross_hess, S, C, scales = _hess_with_scales(
+    H5, cross_hess, S, C, scales, ok = _hess_with_scales(
         params_out, cross, abs_m2, inv_err2, freqs, P, nu_out_DM,
         nu_out_GM, nu_out_tau, flags, log10_tau, nbin)
     cov_fit, scale_errs = _covariance_with_scales(H5, cross_hess, S,
-                                                  jnp.asarray(ifit))
+                                                  jnp.asarray(ifit), ok)
     # negative variances (non-PD covariance from a failed fit) surface as
     # NaN, matching the reference's **0.5 behavior — a loud flag, not a
     # plausible-looking error
@@ -600,23 +620,32 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
         nfeval=sol["nfev"], return_code=sol["rc"])
 
 
-@partial(jax.jit, static_argnames=("fit_flags", "nu_fits", "bounds",
-                                   "log10_tau", "max_iter"))
+@partial(jax.jit, static_argnames=("fit_flags", "bounds", "log10_tau",
+                                   "max_iter", "nu_outs_mask"))
 def _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b, errs_b,
-                fit_flags, nu_fits, bounds, log10_tau, max_iter):
-    def one(d, m, x0, p, fq, er):
-        return fit_portrait_full(d, m, x0, p, fq, errs=er,
+                weights_b, nu_fits_b, nu_outs_b, nu_outs_mask, fit_flags,
+                bounds, log10_tau, max_iter):
+    def one(d, m, x0, p, fq, er, w, nf, no):
+        wok = (w > 0.0).astype(fq.dtype)
+        fq_mean = (fq * wok).sum() / jnp.maximum(wok.sum(), 1.0)
+        nu_fits = tuple(jnp.where(jnp.isnan(nf[i]), fq_mean, nf[i])
+                        for i in range(3))
+        nu_outs = tuple(no[i] if nu_outs_mask[i] else None
+                        for i in range(3))
+        return fit_portrait_full(d, m, x0, p, fq, errs=er, weights=w,
                                  fit_flags=fit_flags, nu_fits=nu_fits,
-                                 bounds=bounds, log10_tau=log10_tau,
-                                 max_iter=max_iter)
+                                 nu_outs=nu_outs, bounds=bounds,
+                                 log10_tau=log10_tau, max_iter=max_iter)
 
     return jax.vmap(one)(data_ports, model_ports, init_b, Ps_b, freqs_b,
-                         errs_b)
+                         errs_b, weights_b, nu_fits_b, nu_outs_b)
 
 
 def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
-                            freqs, errs=None, fit_flags=(1, 1, 0, 0, 0),
-                            nu_fits=(None, None, None), bounds=None,
+                            freqs, errs=None, weights=None,
+                            fit_flags=(1, 1, 0, 0, 0),
+                            nu_fits=(None, None, None),
+                            nu_outs=(None, None, None), bounds=None,
                             log10_tau=True, max_iter=50):
     """vmapped+jitted fit over a batch of subints: data [B, nchan, nbin].
 
@@ -641,14 +670,44 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
     else:
         errs_b = jnp.broadcast_to(jnp.asarray(errs),
                                   data_ports.shape[:-1])
+    if weights is None:
+        weights_b = jnp.ones(data_ports.shape[:-1])
+    else:
+        weights_b = jnp.broadcast_to(jnp.asarray(weights),
+                                     data_ports.shape[:-1])
     bounds_t = None if bounds is None else tuple(
         (None if b[0] is None else float(b[0]),
          None if b[1] is None else float(b[1])) for b in bounds)
-    nu_fits_t = tuple(None if nf is None else float(nf) for nf in nu_fits)
+    if nu_fits is None or (isinstance(nu_fits, tuple)
+                           and all(nf is None for nf in nu_fits)):
+        nu_fits_b = jnp.full((B, 3), jnp.nan)
+    elif isinstance(nu_fits, tuple):
+        nu_fits_b = jnp.broadcast_to(jnp.asarray(
+            [jnp.nan if nf is None else float(nf) for nf in nu_fits]),
+            (B, 3))
+    else:
+        nu_fits_b = jnp.broadcast_to(jnp.asarray(nu_fits, dtype=jnp.float64),
+                                     (B, 3))
     flags_t = tuple(int(bool(fl)) for fl in fit_flags)
+    # nu_outs: None entries -> zero-covariance defaults (mask False);
+    # scalar or [B]-array entries are per-batch output references
+    if nu_outs is None:
+        nu_outs = (None, None, None)
+    if isinstance(nu_outs, (tuple, list)):
+        nu_outs_mask = tuple(nu is not None for nu in nu_outs)
+        cols = [jnp.broadcast_to(
+            jnp.asarray(0.0 if nu is None else nu, dtype=jnp.float64),
+            (B,)) for nu in nu_outs]
+        nu_outs_b = jnp.stack(cols, axis=1)
+    else:
+        nu_outs_mask = (True, True, True)
+        nu_outs_b = jnp.broadcast_to(jnp.asarray(nu_outs,
+                                                 dtype=jnp.float64),
+                                     (B, 3))
     return _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b,
-                       errs_b, flags_t, nu_fits_t, bounds_t,
-                       bool(log10_tau), int(max_iter))
+                       errs_b, weights_b, nu_fits_b, nu_outs_b,
+                       nu_outs_mask, flags_t, bounds_t, bool(log10_tau),
+                       int(max_iter))
 
 
 def get_scales_full(params, data_port, model_port, P, freqs, nu_DM, nu_GM,

@@ -1,0 +1,274 @@
+"""Archive loading with the reference's load_data schema + fixtures.
+
+TPU-native equivalent of /root/reference/pplib.py:2650-2820 (load_data),
+:3039-3075 (unload_new_archive) and :3189-3384 (make_fake_pulsar), with
+the PSRCHIVE dependency replaced by io.psrfits.  The returned DataBunch
+carries the same field names the reference's pipelines consume
+(pplib.py:2809-2820), with ``arch`` holding the in-memory Archive.
+"""
+
+import os
+
+import numpy as np
+
+from ..ops.fourier import get_bin_centers
+from ..ops.noise import get_SNR, get_noise
+from ..utils.databunch import DataBunch
+from ..utils.mjd import MJD
+from ..utils.telescopes import telescope_code_dict
+from .gmodel import read_model
+from .psrfits import Archive, read_archive
+
+__all__ = ["load_data", "unload_new_archive", "make_fake_pulsar",
+           "file_is_type"]
+
+
+def file_is_type(filename):
+    """'FITS' | 'ASCII' | 'data' dispatch without shelling out to `file`.
+
+    Replaces the reference's ``os.popen4('file -L ...')`` sniffing
+    (/root/reference/pplib.py:3021-3037): FITS files start with
+    'SIMPLE  ='; metafiles are small text lists.
+    """
+    with open(filename, "rb") as f:
+        head = f.read(160)
+    if head.startswith(b"SIMPLE"):
+        return "FITS"
+    try:
+        head.decode("ascii")
+        return "ASCII"
+    except UnicodeDecodeError:
+        return "data"
+
+
+def load_data(filename, state=None, dedisperse=False, dededisperse=False,
+              tscrunch=False, pscrunch=False, fscrunch=False,
+              rm_baseline=True, flux_prof=False, refresh_arch=True,
+              return_arch=True, quiet=True, get_SNRs=True,
+              noise_method="PS"):
+    """Load a PSRFITS archive into the canonical DataBunch schema.
+
+    Field-for-field equivalent of the reference's load_data
+    (/root/reference/pplib.py:2650-2820): subints
+    [nsub, npol, nchan, nbin], freqs [nsub, nchan], weights, masks,
+    noise_stds [nsub, npol, nchan], SNRs, ok_isubs, ok_ichans, Ps,
+    epochs, phases, prof, flux_prof, plus observation metadata.
+    """
+    arch = filename if isinstance(filename, Archive) \
+        else read_archive(filename)
+    if refresh_arch:
+        arch = arch.copy()  # manipulations below stay local
+    source = arch.source
+    telescope = arch.telescope
+    try:
+        telescope_code = telescope_code_dict[telescope.upper()][0]
+    except KeyError:
+        telescope_code = telescope
+
+    if state is not None and state != arch.state:
+        arch.convert_state(state)
+    if dedisperse:
+        arch.dedisperse()
+    if dededisperse:
+        arch.dededisperse()
+    DM = arch.DM
+    dmc = arch.dedispersed
+    if rm_baseline:
+        arch.remove_baseline()
+    if tscrunch:
+        arch.tscrunch()
+    nsub = arch.nsub
+    integration_length = float(arch.durations.sum())
+    doppler_factors = arch.doppler_factors.copy()
+    parallactic_angles = arch.parallactic_angles.copy()
+    if pscrunch:
+        arch.pscrunch()
+    state = arch.state
+    npol = arch.npol
+    if fscrunch:
+        arch.fscrunch()
+    nu0 = arch.nu0
+    bw = arch.bw
+    nchan = arch.nchan
+    freqs = arch.freqs.copy()
+    nbin = arch.nbin
+    phases = np.asarray(get_bin_centers(nbin))
+    subints = arch.data.copy()
+    Ps = arch.Ps.copy()
+    if len(Ps) < nsub:  # tscrunch keeps one
+        Ps = np.resize(Ps, nsub)
+    epochs = list(arch.epochs)
+    subtimes = list(arch.durations)
+    weights = arch.weights.copy()
+    weights_norm = np.where(weights == 0.0, 0.0, 1.0)
+
+    noise_stds = np.asarray(get_noise(subints, method=noise_method))
+    ok_isubs = np.compress(weights_norm.mean(axis=1),
+                           range(arch.nsub))
+    ok_ichans = [np.compress(weights_norm[isub], range(nchan))
+                 for isub in range(arch.nsub)]
+    masks = np.einsum("ij,k->ijk", weights_norm, np.ones(nbin))
+    masks = np.einsum("j,ikl->ijkl", np.ones(npol), masks)
+    if get_SNRs:
+        SNRs = np.asarray(get_SNR(subints))
+    else:
+        SNRs = np.zeros([arch.nsub, npol, nchan])
+
+    work = arch.copy()
+    work.pscrunch()
+    if flux_prof:
+        fa = work.copy()
+        fa.dedisperse()
+        fa.tscrunch()
+        flux_profile = fa.data.mean(axis=3)[0][0]
+    else:
+        flux_profile = np.array([])
+    work.dedisperse()
+    work.tscrunch()
+    work.fscrunch()
+    prof = work.data[0, 0, 0]
+    prof_noise = float(np.asarray(get_noise(prof)))
+    prof_SNR = float(np.asarray(get_SNR(prof)))
+
+    return DataBunch(
+        arch=arch if return_arch else None, backend=arch.backend,
+        backend_delay=arch.backend_delay, bw=bw,
+        doppler_factors=doppler_factors, DM=DM, dmc=dmc, epochs=epochs,
+        filename=getattr(arch, "filename", str(filename)),
+        flux_prof=flux_profile, freqs=freqs, frontend=arch.frontend,
+        integration_length=integration_length, masks=masks, nbin=nbin,
+        nchan=nchan, noise_stds=noise_stds, npol=npol, nsub=arch.nsub,
+        nu0=nu0, ok_ichans=ok_ichans, ok_isubs=ok_isubs,
+        parallactic_angles=parallactic_angles, phases=phases, prof=prof,
+        prof_noise=prof_noise, prof_SNR=prof_SNR, Ps=Ps, SNRs=SNRs,
+        source=source, state=state, subints=subints, subtimes=subtimes,
+        telescope=telescope, telescope_code=telescope_code,
+        weights=weights)
+
+
+def unload_new_archive(data, arch, outfile, DM=None, dmc=0, weights=None,
+                       quiet=True):
+    """Write ``data`` into a copy of an existing Archive and unload it.
+
+    Equivalent of /root/reference/pplib.py:3039-3075.
+    ``dmc=0`` stores the archive dedispersed=False (dispersed state).
+    """
+    new = arch.copy() if isinstance(arch, Archive) else \
+        read_archive(arch).copy()
+    new.data = np.asarray(data, dtype=np.float64).reshape(new.data.shape)
+    if DM is not None:
+        new.DM = float(DM)
+    new.dedispersed = bool(dmc)
+    if weights is not None:
+        new.weights = np.asarray(weights, dtype=np.float64)
+    new.unload(outfile, quiet=quiet)
+    return new
+
+
+def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
+                     nsub=1, npol=1, nchan=512, nbin=2048, nu0=1500.0,
+                     bw=800.0, tsub=300.0, phase=0.0, dDM=0.0,
+                     start_MJD=None, weights=None, noise_stds=1.0,
+                     scales=1.0, dedispersed=False, t_scat=0.0,
+                     alpha=-4.0, scint=False, xs=None, Cs=None,
+                     nu_DM=np.inf, state="Stokes", telescope="GBT",
+                     seed=0, quiet=True):
+    """Generate a fake-pulsar PSRFITS archive from a .gmodel file.
+
+    File-producing equivalent of /root/reference/pplib.py:3189-3384 —
+    the array math lives in pipelines.synth; this wraps it with the
+    ephemeris, epochs and PSRFITS unload.  ``seed`` replaces global
+    numpy randomness with an explicit PRNG.
+    """
+    import jax
+
+    from ..config import Dconst
+    from ..ops.fourier import add_DM_nu, rotate_data
+    from ..ops.scattering import scattering_portrait_FT, scattering_times
+    from ..pipelines.synth import add_scintillation
+    from .parfile import read_par
+
+    chanwidth = bw / nchan
+    lofreq = nu0 - bw / 2
+    freqs = np.linspace(lofreq + chanwidth / 2, lofreq + bw - chanwidth / 2,
+                        nchan)
+    phases_arr = np.asarray(get_bin_centers(nbin))
+    noise_stds = np.broadcast_to(np.asarray(noise_stds, dtype=np.float64),
+                                 (nchan,))
+    scales = np.broadcast_to(np.asarray(scales, dtype=np.float64),
+                             (nchan,))
+    par = read_par(ephemeris)
+    P0 = float(par.P0)
+    DM = float(par.get("DM", 0.0))
+    PEPOCH = float(par.get("PEPOCH", 56000.0))
+    if start_MJD is None:
+        start_MJD = MJD.from_mjd(PEPOCH)
+    epochs = [start_MJD.add_seconds(tsub / 2.0 + isub * tsub)
+              for isub in range(nsub)]
+    if weights is None:
+        weights = np.ones([nsub, nchan])
+
+    key = jax.random.key(seed)
+    data = np.zeros([nsub, npol, nchan, nbin])
+    for isub in range(nsub):
+        P = P0
+        _, _, model = read_model(modelfile, phases_arr, freqs, P,
+                                 quiet=True)
+        model = np.asarray(model)
+        if xs is None:
+            rotmodel = model
+        else:
+            ph = phase + Dconst * (DM + dDM) * \
+                (nu_DM ** -2 - nu0 ** -2) / P
+            rotmodel = np.asarray(add_DM_nu(model, -ph, -dDM, P, freqs,
+                                            xs=xs, Cs=Cs, nu_ref=nu_DM))
+        if t_scat:
+            taus = np.asarray(scattering_times(t_scat / P, alpha, freqs,
+                                               nu0))
+            sp_FT = np.asarray(scattering_portrait_FT(taus, nbin))
+            rotmodel = np.fft.irfft(sp_FT * np.fft.rfft(rotmodel, axis=-1),
+                                    nbin, axis=-1)
+        if scint is not False:
+            if scint is True:
+                key, sk = jax.random.split(key)
+                rotmodel = np.asarray(add_scintillation(rotmodel, key=sk,
+                                                        nsin=3, amax=1.0,
+                                                        wmax=5.0))
+            else:
+                rotmodel = np.asarray(add_scintillation(rotmodel,
+                                                        params=scint))
+        key, nk = jax.random.split(key)
+        noise = np.asarray(jax.random.normal(nk, (npol, nchan, nbin)))
+        data[isub] = scales[:, None] * rotmodel[None] + \
+            noise * noise_stds[:, None]
+
+    ephem_text = open(ephemeris).read()
+    arch = Archive(data, freqs, weights, np.full(nsub, P0), epochs,
+                   np.full(nsub, tsub), DM=DM,
+                   state=("Intensity" if npol == 1 else state),
+                   dedispersed=True, source=str(par.get("PSR", "FAKE")),
+                   telescope=telescope, nu0=nu0, bw=bw,
+                   ephemeris_text=ephem_text)
+    # The model is built at its intrinsic (aligned) phases = the
+    # dedispersed frame; inject the (phase, dDM) rotation, then store
+    # dispersed or dedispersed as requested.
+    if phase != 0.0 or dDM != 0.0:
+        if xs is None:
+            arch.data = np.asarray(
+                rotate_data(arch.data, -phase, -dDM,
+                            np.full(nsub, P0), freqs, nu0))
+    if not dedispersed:
+        arch.dededisperse()
+    arch.unload(outfile, quiet=quiet)
+    if not quiet:
+        print("Unloaded %s." % outfile)
+    return outfile
+
+
+def parse_metafile(metafile):
+    """List of archive paths from a newline-separated metafile
+    (reference pptoas.py:92-96)."""
+    with open(metafile) as f:
+        return [line.strip() for line in f
+                if line.strip() and not line.startswith("#")
+                and os.path.basename(line.strip()) != ""]

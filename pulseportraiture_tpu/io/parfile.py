@@ -1,0 +1,85 @@
+"""Minimal pulsar-ephemeris (.par) reader.
+
+Replacement for the optional external ``parfile`` module the reference
+uses (/root/reference/pplib.py:3271-3302 falls back to manual parsing of
+PSR/PSRJ, RAJ, DECJ, F0/P0, PEPOCH, DM).  All fields are kept; values
+are typed as float where they parse, with fit-flag and uncertainty
+columns preserved.
+"""
+
+import numpy as np
+
+from ..utils.databunch import DataBunch
+
+__all__ = ["read_par", "write_par"]
+
+_STRING_FIELDS = {"PSR", "PSRJ", "PSRB", "RAJ", "DECJ", "RA", "DEC",
+                  "EPHEM", "CLK", "CLOCK", "UNITS", "TZRSITE", "BINARY",
+                  "TIMEEPH", "T2CMETHOD", "CORRECT_TROPOSPHERE", "PLANET_SHAPIRO",
+                  "DILATEFREQ", "INFO", "NITS", "IBOOT", "DMDATA"}
+
+
+def _parse_value(key, value):
+    if key in _STRING_FIELDS:
+        return value
+    try:
+        return float(value.replace("D", "E").replace("d", "e"))
+    except ValueError:
+        return value
+
+
+def read_par(parfile):
+    """Parse a .par file into a DataBunch.
+
+    Returns fields by name (e.g. par.PSR, par.DM, par.F0), plus derived
+    ``P0`` (from F0 if absent), ``fit_flags`` and ``uncertainties``
+    dicts for lines carrying extra columns.
+    """
+    fields = {}
+    fit_flags = {}
+    uncertainties = {}
+    with open(parfile) as f:
+        for line in f:
+            toks = line.split()
+            if not toks or toks[0].startswith("#"):
+                continue
+            key = toks[0]
+            if len(toks) < 2:
+                continue
+            fields[key] = _parse_value(key, toks[1])
+            if len(toks) >= 3:
+                try:
+                    fit_flags[key] = int(toks[2])
+                except ValueError:
+                    pass
+            if len(toks) >= 4:
+                try:
+                    uncertainties[key] = float(toks[3])
+                except ValueError:
+                    pass
+    if "P0" not in fields and "F0" in fields:
+        fields["P0"] = 1.0 / np.float64(fields["F0"])
+    if "F0" not in fields and "P0" in fields:
+        fields["F0"] = 1.0 / np.float64(fields["P0"])
+    if "PSR" not in fields and "PSRJ" in fields:
+        fields["PSR"] = fields["PSRJ"]
+    return DataBunch(fit_flags=fit_flags, uncertainties=uncertainties,
+                     **fields)
+
+
+def write_par(parfile, fields, fit_flags=None, quiet=True):
+    """Write a simple .par file from a mapping of field -> value."""
+    fit_flags = fit_flags or {}
+    with open(parfile, "w") as f:
+        for key, value in fields.items():
+            if key in ("fit_flags", "uncertainties"):
+                continue
+            if isinstance(value, float):
+                line = "%-12s %.15g" % (key, value)
+            else:
+                line = "%-12s %s" % (key, value)
+            if key in fit_flags:
+                line += " %d" % fit_flags[key]
+            f.write(line + "\n")
+    if not quiet:
+        print("%s written." % parfile)

@@ -1,0 +1,87 @@
+"""Spline portrait model container: npz-based, with legacy pickle compat.
+
+The reference pickles ``[modelname, source, datafile, mean_prof, eigvec,
+tck]`` into a ``.spl`` file (/root/reference/ppspline.py:206-230,
+pplib.py:2961-3019).  Pickle is fragile and unsafe as an interchange
+format; the native container here is a plain ``.npz`` holding the same
+contents, while ``read_spline_model`` transparently loads either format
+(legacy pickles read-only).
+"""
+
+import pickle
+
+import numpy as np
+
+from ..ops.splines import gen_spline_portrait, splev
+
+__all__ = ["write_spline_model", "read_spline_model",
+           "get_spline_model_coords"]
+
+
+def write_spline_model(modelfile, modelname, source, datafile, mean_prof,
+                       eigvec, tck, quiet=True):
+    """Write a spline model as .npz (tck = (t, c, k); c [ndim, ncoef])."""
+    t, c, k = tck
+    # np.savez appends '.npz' to bare paths; write through a file object
+    # so the model lands at exactly ``modelfile`` (.spl convention kept).
+    with open(modelfile, "wb") as f:
+        np.savez(
+            f,
+            modelname=np.str_(modelname), source=np.str_(source),
+            datafile=np.str_(datafile),
+            mean_prof=np.asarray(mean_prof, dtype=np.float64),
+            eigvec=np.asarray(eigvec, dtype=np.float64),
+            tck_t=np.asarray(t, dtype=np.float64),
+            tck_c=np.asarray(c, dtype=np.float64),
+            tck_k=np.int64(k))
+    if not quiet:
+        print("%s written." % modelfile)
+
+
+def _load_container(modelfile):
+    """Return (modelname, source, datafile, mean_prof, eigvec, tck) from
+    either the npz container or a legacy reference pickle."""
+    try:
+        with np.load(modelfile, allow_pickle=False) as z:
+            return (str(z["modelname"]), str(z["source"]),
+                    str(z["datafile"]), z["mean_prof"], z["eigvec"],
+                    (z["tck_t"], z["tck_c"], int(z["tck_k"])))
+    except (ValueError, OSError, KeyError):
+        with open(modelfile, "rb") as f:
+            modelname, source, datafile, mean_prof, eigvec, tck = \
+                pickle.load(f, encoding="latin1")
+        t, c, k = tck
+        return (modelname, source, datafile, np.asarray(mean_prof),
+                np.asarray(eigvec), (np.asarray(t), np.asarray(c), int(k)))
+
+
+def read_spline_model(modelfile, freqs=None, nbin=None, quiet=True):
+    """Read a spline model; optionally build the portrait at ``freqs``.
+
+    Read-only call returns the 6-tuple contents; otherwise returns
+    (modelname, port [nchan, nbin]).  Equivalent of
+    /root/reference/pplib.py:2961-2993.
+    """
+    contents = _load_container(modelfile)
+    if freqs is None:
+        return contents
+    modelname, _, _, mean_prof, eigvec, tck = contents
+    port = gen_spline_portrait(mean_prof, np.asarray(freqs), eigvec, tck,
+                               nbin)
+    return (modelname, port)
+
+
+def get_spline_model_coords(modelfile, nfreq=1000, lo_freq=None,
+                            hi_freq=None):
+    """Spline-curve coordinates sampled over frequency.
+
+    Equivalent of /root/reference/pplib.py:2995-3019 (without the pickle
+    side-dump; callers can np.savez the return).
+    """
+    _, _, _, _, _, tck = _load_container(modelfile)
+    t = np.asarray(tck[0])
+    lo = t.min() if lo_freq is None else lo_freq
+    hi = t.max() if hi_freq is None else hi_freq
+    model_freqs = np.linspace(lo, hi, nfreq)
+    proj_port = np.asarray(splev(model_freqs, tck)).T
+    return model_freqs, proj_port

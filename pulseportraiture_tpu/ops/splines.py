@@ -1,0 +1,102 @@
+"""B-spline evaluation (de Boor) in JAX + spline portrait generation.
+
+The reference evaluates its PCA/B-spline portrait models with FITPACK's
+``si.splev`` inside ``gen_spline_portrait`` (/root/reference/pplib.py:
+932-956) — a host-side Fortran call in the middle of the TOA hot path.
+Here spline *construction* stays on the host (scipy, model-build time,
+see models/spline), but *evaluation* is a vmappable de Boor recursion so
+model generation inside fit loops runs on device.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["splev", "gen_spline_portrait", "fft_resample"]
+
+
+def _deboor(x, t, c, k):
+    """de Boor evaluation of a 1-D B-spline at points x.
+
+    t: knots [n+k+1], c: coefficients [n], k: degree (static int).
+    Outside [t[k], t[n]] the end polynomial is extrapolated (matching
+    splev's ext=0 default).
+    """
+    t = jnp.asarray(t)
+    c = jnp.asarray(c)
+    # FITPACK zero-pads c to len(t); only len(t)-k-1 coefficients are real
+    n = t.shape[0] - k - 1
+    # interval index i: t[i] <= x < t[i+1], clamped to [k, n-1]
+    i = jnp.clip(jnp.searchsorted(t, x, side="right") - 1, k, n - 1)
+
+    # d[j] = c[i - k + j] for j = 0..k
+    def gather(j):
+        return c[i - k + j]
+
+    d = [gather(j) for j in range(k + 1)]
+    for r in range(1, k + 1):
+        for j in range(k, r - 1, -1):
+            denom = t[i + j - r + 1] - t[i - k + j]
+            alpha = jnp.where(denom != 0.0, (x - t[i - k + j])
+                              / jnp.where(denom != 0.0, denom, 1.0), 0.0)
+            d[j] = (1.0 - alpha) * d[j - 1] + alpha * d[j]
+    return d[k]
+
+
+def splev(x, tck):
+    """Evaluate a (possibly parametric) spline like scipy's si.splev.
+
+    tck = (t, c, k) with c either a single coefficient array (scalar
+    spline) or a list/2-D array of per-dimension coefficient arrays
+    (parametric curve, as produced by si.splprep).  Returns an array
+    shaped [ndim, len(x)] for parametric input, else [len(x)].
+    """
+    t, c, k = tck
+    x = jnp.atleast_1d(jnp.asarray(x))
+    if isinstance(c, (list, tuple)) or (hasattr(c, "ndim")
+                                        and np.ndim(c) == 2):
+        return jnp.stack([_deboor(x, t, jnp.asarray(ci), int(k))
+                          for ci in c])
+    return _deboor(x, t, jnp.asarray(c), int(k))
+
+
+def fft_resample(port, nbin):
+    """Fourier resampling along the last axis (scipy.signal.resample
+    semantics for real input)."""
+    port = jnp.asarray(port)
+    n = port.shape[-1]
+    X = jnp.fft.rfft(port, axis=-1)
+    nh_out = nbin // 2 + 1
+    if nbin < n:
+        Xr = X[..., :nh_out]
+        # halve the new Nyquist bin if it aliases (even nbin)
+        if nbin % 2 == 0:
+            Xr = Xr.at[..., -1].set(jnp.real(Xr[..., -1]))
+    else:
+        pad = [(0, 0)] * (port.ndim - 1) + [(0, nh_out - X.shape[-1])]
+        Xr = jnp.pad(X, pad)
+    return jnp.fft.irfft(Xr, n=nbin, axis=-1) * (nbin / n)
+
+
+def gen_spline_portrait(mean_prof, freqs, eigvec, tck, nbin=None):
+    """Portrait from mean profile + eigenprofiles + B-spline coefficients.
+
+    proj = splev(freqs, tck) gives the eigenbasis coordinates vs
+    frequency; port = proj . eigvec^T + mean_prof.  Optional nbin
+    resampling applies the half-bin shift correction the reference notes
+    for ss.resample (/root/reference/pplib.py:932-956).
+    """
+    from .fourier import rotate_data  # local import to avoid cycle
+
+    mean_prof = jnp.asarray(mean_prof)
+    freqs = jnp.atleast_1d(jnp.asarray(freqs))
+    eigvec = jnp.asarray(eigvec)
+    if eigvec.shape[1] == 0:
+        port = jnp.tile(mean_prof, (freqs.shape[0], 1))
+    else:
+        proj_port = splev(freqs, tck).T          # [nchan, neig]
+        port = proj_port @ eigvec.T + mean_prof
+    if nbin is not None and nbin != mean_prof.shape[-1]:
+        shift = 0.5 * (1.0 / nbin - 1.0 / mean_prof.shape[-1])
+        port = fft_resample(port, nbin)
+        port = rotate_data(port, shift)
+    return port

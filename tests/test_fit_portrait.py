@@ -230,3 +230,27 @@ def test_get_scales_recovers_amplitudes(rng):
     data = model * amps[:, None]
     scales = np.asarray(fp.get_scales(data, model, 0.0, 0.0, P0, FREQS))
     np.testing.assert_allclose(scales, amps, rtol=1e-10)
+
+
+def test_zapped_channels_masked(rng):
+    # zero-weight channels must not affect the fit and must not NaN
+    model, data = make_data(phi=0.09, dDM=1.2e-3, noise=0.01, seed=7)
+    data_corrupt = data.copy()
+    data_corrupt[[3, 9]] = 1e6 * rng.normal(size=(2, NBIN))  # RFI blast
+    w = np.ones(NCHAN)
+    w[[3, 9]] = 0.0
+    out = fp.fit_portrait_full(data_corrupt, model,
+                               [0.08, 0.0, 0.0, 0.0, 0.0], P0, FREQS,
+                               errs=np.full(NCHAN, 0.01), weights=w,
+                               fit_flags=(1, 1, 0, 0, 0), log10_tau=False)
+    clean = fp.fit_portrait_full(data, model, [0.08, 0.0, 0.0, 0.0, 0.0],
+                                 P0, FREQS, errs=np.full(NCHAN, 0.01),
+                                 fit_flags=(1, 1, 0, 0, 0),
+                                 log10_tau=False)
+    assert np.isfinite(float(out.phi)) and np.isfinite(float(out.DM_err))
+    # masked fit should agree with the clean fit to within the errors
+    np.testing.assert_allclose(float(out.DM), 1.2e-3,
+                               atol=5 * float(out.DM_err))
+    assert np.asarray(out.scales)[3] == 0.0
+    assert not np.isfinite(np.asarray(out.scale_errs)[3])
+    assert 0.5 < float(out.red_chi2) < 2.0

@@ -1,0 +1,246 @@
+"""Round-trip tests for the I/O layer: PSRFITS, gmodel, spline model,
+tim files, par files, MJD."""
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.io import archive as ar
+from pulseportraiture_tpu.io import gmodel as gm
+from pulseportraiture_tpu.io import parfile as pf
+from pulseportraiture_tpu.io import splmodel as sm
+from pulseportraiture_tpu.io import timfile as tf
+from pulseportraiture_tpu.io.psrfits import Archive, read_archive
+from pulseportraiture_tpu.utils.mjd import MJD
+
+MODEL_PARAMS = np.array([0.01, 5e-5, 0.35, -0.05, 0.05, 0.1, 1.0, -1.2])
+
+
+@pytest.fixture
+def gmodel_file(tmp_path):
+    path = str(tmp_path / "test.gmodel")
+    flags = np.zeros(8, dtype=int)
+    flags[[2, 6]] = 1
+    gm.write_model(path, "fake", "000", 1500.0, MODEL_PARAMS, flags,
+                   -4.0, 0, quiet=True)
+    return path
+
+
+@pytest.fixture
+def par_file(tmp_path):
+    path = str(tmp_path / "test.par")
+    with open(path, "w") as f:
+        f.write("PSR      J0000+0000\nRAJ      00:00:00.0\n"
+                "DECJ     00:00:00.0\nF0       200.0\nPEPOCH   56000.0\n"
+                "DM       30.0\nDMDATA   1\n")
+    return path
+
+
+def test_mjd_precision():
+    m = MJD(55000, 43200.123456789012)
+    assert m.intday() == 55000
+    np.testing.assert_allclose(m.fracday(), 43200.123456789012 / 86400,
+                               rtol=1e-15)
+    m2 = m.add_seconds(86400.5)
+    assert m2.day == 55001
+    np.testing.assert_allclose(m2.secs, 43200.623456789012, rtol=1e-15)
+    # subtraction returns seconds at ns precision
+    np.testing.assert_allclose(m2 - m, 86400.5, atol=1e-9)
+    assert str(MJD(55000, 0.0)).startswith("55000.000000")
+
+
+def test_gmodel_roundtrip(gmodel_file):
+    (name, code, nu_ref, ngauss, params, fit_flags, alpha,
+     fit_alpha) = gm.read_model(gmodel_file)
+    assert name == "fake" and code == "000" and ngauss == 1
+    np.testing.assert_allclose(nu_ref, 1500.0)
+    np.testing.assert_allclose(params, MODEL_PARAMS, atol=1e-8)
+    assert fit_flags[2] == 1 and fit_flags[3] == 0
+    np.testing.assert_allclose(alpha, -4.0)
+
+
+def test_gmodel_build_portrait(gmodel_file):
+    freqs = np.linspace(1300, 1700, 8)
+    phases = np.linspace(1 / 128, 1 - 1 / 128, 64)
+    name, ngauss, model = gm.read_model(gmodel_file, phases, freqs, P=0.005)
+    assert model.shape == (8, 64)
+    assert float(np.max(np.asarray(model))) > 0.5
+
+
+def test_reference_example_gmodel_parses():
+    (name, code, nu_ref, ngauss, params, fit_flags, alpha,
+     fit_alpha) = gm.read_model("/root/reference/examples/example.gmodel")
+    assert ngauss >= 1
+    assert len(params) == 2 + 6 * ngauss
+
+
+def test_par_roundtrip(par_file):
+    par = pf.read_par(par_file)
+    assert par.PSR == "J0000+0000"
+    np.testing.assert_allclose(par.F0, 200.0)
+    np.testing.assert_allclose(par.P0, 0.005)
+    np.testing.assert_allclose(par.DM, 30.0)
+
+
+def test_spline_model_roundtrip(tmp_path):
+    import scipy.interpolate as si
+    path = str(tmp_path / "model.spl")
+    freqs = np.linspace(1300.0, 1700.0, 32)
+    coords = np.stack([np.sin(freqs / 200.0), np.cos(freqs / 300.0)])
+    (t, c, k), _ = si.splprep(coords, u=freqs, k=3, s=0)
+    mean_prof = np.random.default_rng(0).normal(size=64)
+    eigvec = np.random.default_rng(1).normal(size=(64, 2))
+    sm.write_spline_model(path, "m1", "src", "data.fits", mean_prof,
+                          eigvec, (t, np.asarray(c), k))
+    name, source, datafile, mp, ev, tck = sm.read_spline_model(path)
+    assert (name, source, datafile) == ("m1", "src", "data.fits")
+    np.testing.assert_allclose(mp, mean_prof)
+    np.testing.assert_allclose(ev, eigvec)
+    # build a portrait through the JAX de Boor path
+    name2, port = sm.read_spline_model(path, freqs=freqs)
+    want = np.asarray(si.splev(freqs, (t, list(c), k))).T @ eigvec.T \
+        + mean_prof
+    np.testing.assert_allclose(np.asarray(port), want, atol=1e-8)
+
+
+def test_jax_splev_matches_scipy():
+    import scipy.interpolate as si
+    from pulseportraiture_tpu.ops.splines import splev
+    x = np.linspace(0.0, 10.0, 30)
+    y = np.sin(x) + 0.1 * x
+    tck = si.splrep(x, y, k=3, s=0.01)
+    xs = np.linspace(0.5, 9.5, 101)
+    got = np.asarray(splev(xs, tck))
+    want = si.splev(xs, tck)
+    np.testing.assert_allclose(got, want, atol=1e-10)
+    # extrapolation beyond the knots matches ext=0 behavior
+    xs_out = np.array([-0.5, 10.5])
+    np.testing.assert_allclose(np.asarray(splev(xs_out, tck)),
+                               si.splev(xs_out, tck), atol=1e-8)
+
+
+def test_toa_write_and_filter(tmp_path):
+    toas = [
+        tf.TOA("a.fits", 1400.0, MJD(55000, 1000.123456), 1.5, "GBT",
+               "gbt", DM=30.0001234, DM_error=1e-4,
+               flags={"snr": 50.0, "subint": 0, "be": "GUPPI"}),
+        tf.TOA("a.fits", 1500.0, MJD(55000, 2000.0), 3.0, "GBT", "gbt",
+               DM=30.0, DM_error=2e-4, flags={"snr": 8.0, "subint": 1}),
+    ]
+    kept = tf.filter_TOAs(toas, "snr", 20.0, ">=")
+    assert len(kept) == 1 and kept[0].flags["subint"] == 0
+    out = str(tmp_path / "toas.tim")
+    tf.write_TOAs(toas, outfile=out, append=False)
+    lines = open(out).read().strip().split("\n")
+    assert len(lines) == 2
+    assert "-pp_dm 30.0001234" in lines[0]
+    assert "-pp_dme" in lines[0]
+    assert "-be GUPPI" in lines[0]
+    assert lines[0].startswith("a.fits 1400.00000000 55000.")
+    # princeton line
+    line = tf.write_princeton_TOA(55000, 0.5, 1.5, 1400.0, 0.001,
+                                  outfile=str(tmp_path / "p.tim"))
+    assert "55000.5" in line
+
+
+def _fake_archive(nsub=3, npol=1, nchan=8, nbin=64, seed=0):
+    rng = np.random.default_rng(seed)
+    freqs = np.linspace(1300.0, 1700.0, nchan)
+    prof = np.exp(-0.5 * ((np.arange(nbin) / nbin - 0.4) / 0.05) ** 2)
+    data = np.tile(prof, (nsub, npol, nchan, 1)) * \
+        rng.uniform(0.5, 2.0, (nsub, npol, nchan))[..., None]
+    data += rng.normal(0, 0.01, data.shape)
+    weights = np.ones((nsub, nchan))
+    weights[:, 2] = 0.0
+    epochs = [MJD(55000, 100.0 + 30.0 * i) for i in range(nsub)]
+    return Archive(data, freqs, weights, np.full(nsub, 0.005), epochs,
+                   np.full(nsub, 30.0), DM=25.0, state="Intensity",
+                   dedispersed=True, source="J0000+0000", telescope="GBT",
+                   ephemeris_text="F0 200.0\nDM 25.0\n")
+
+
+def test_psrfits_roundtrip(tmp_path):
+    arch = _fake_archive()
+    path = str(tmp_path / "test.fits")
+    arch.unload(path)
+    back = read_archive(path)
+    assert back.data.shape == arch.data.shape
+    # int16 quantization: relative error bounded by span/2^15
+    span = arch.data.max() - arch.data.min()
+    np.testing.assert_allclose(back.data, arch.data, atol=span / 30000)
+    np.testing.assert_allclose(back.freqs, arch.freqs, rtol=1e-12)
+    np.testing.assert_allclose(back.weights, arch.weights)
+    np.testing.assert_allclose(back.Ps, arch.Ps, rtol=1e-12)
+    assert back.source == "J0000+0000"
+    assert back.telescope == "GBT"
+    assert back.dedispersed is True
+    np.testing.assert_allclose(back.DM, 25.0)
+    assert abs(back.epochs[0] - arch.epochs[0]) < 1e-6  # seconds
+    assert "F0 200.0" in back.ephemeris_text
+
+
+def test_archive_dedisperse_roundtrip(tmp_path):
+    arch = _fake_archive()
+    orig = arch.data.copy()
+    arch.dededisperse()
+    assert not np.allclose(arch.data, orig)  # channels smeared apart
+    arch.dedisperse()
+    # fractional rotation of real data is slightly lossy at the Nyquist
+    # harmonic (same as PSRCHIVE/the reference); noise floor is 0.01
+    np.testing.assert_allclose(arch.data, orig, atol=5e-3)
+
+
+def test_load_data_schema(tmp_path):
+    arch = _fake_archive()
+    path = str(tmp_path / "test.fits")
+    arch.unload(path)
+    d = ar.load_data(path, dedisperse=True, pscrunch=True,
+                     rm_baseline=True, flux_prof=True)
+    assert d.subints.shape == (3, 1, 8, 64)
+    assert d.freqs.shape == (3, 8)
+    assert d.noise_stds.shape == (3, 1, 8)
+    assert d.SNRs.shape == (3, 1, 8)
+    assert list(d.ok_isubs) == [0, 1, 2]
+    for oc in d.ok_ichans:
+        assert 2 not in oc
+    assert d.masks.shape == (3, 1, 8, 64)
+    assert d.masks[0, 0, 2].sum() == 0.0
+    np.testing.assert_allclose(d.Ps, 0.005)
+    assert d.telescope_code == "gbt"
+    assert d.nbin == 64 and d.nchan == 8 and d.npol == 1
+    assert d.prof.shape == (64,)
+    assert d.flux_prof.shape == (8,)
+    assert d.dmc is True
+
+
+def test_make_fake_pulsar_and_load(tmp_path, gmodel_file, par_file):
+    out = str(tmp_path / "fake.fits")
+    ar.make_fake_pulsar(gmodel_file, par_file, out, nsub=2, npol=1,
+                        nchan=16, nbin=128, nu0=1500.0, bw=400.0,
+                        tsub=60.0, phase=0.05, dDM=1e-3,
+                        noise_stds=0.05, dedispersed=False)
+    d = ar.load_data(out, dedisperse=False, pscrunch=True)
+    assert d.subints.shape == (2, 1, 16, 128)
+    assert d.dmc is False
+    np.testing.assert_allclose(d.DM, 30.0)
+    np.testing.assert_allclose(d.Ps, 0.005)
+    # stored dispersed: dedispersing should raise the band-avg peak
+    d2 = ar.load_data(out, dedisperse=True, pscrunch=True)
+    peak_disp = d.subints[0, 0].mean(axis=0).max()
+    peak_dedisp = d2.subints[0, 0].mean(axis=0).max()
+    assert peak_dedisp > peak_disp
+
+
+def test_file_is_type(tmp_path, gmodel_file):
+    arch = _fake_archive()
+    path = str(tmp_path / "t.fits")
+    arch.unload(path)
+    assert ar.file_is_type(path) == "FITS"
+    assert ar.file_is_type(gmodel_file) == "ASCII"
+
+
+def test_mjd_midnight_rollover_formatting():
+    m = MJD(55000, 86399.999999999999)
+    day, frac = m.format_parts(15)
+    s = str(m)
+    assert s.startswith("55001.000") or s.startswith("55000.999"), s
+    assert not s.startswith("55000.000"), s

@@ -1,0 +1,147 @@
+"""End-to-end pipeline test: fake archives -> GetTOAs -> injected truth.
+
+Patterned on the reference's de-facto test, examples/example.py:29-150
+(synthetic archives with known injected phase/dDM, full pipeline, diff
+fitted vs injected).
+"""
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.io.archive import load_data, make_fake_pulsar
+from pulseportraiture_tpu.io.gmodel import write_model
+from pulseportraiture_tpu.pipelines.toas import GetTOAs
+
+MODEL_PARAMS = np.array([0.0, 0.0, 0.35, -0.05, 0.05, 0.1, 1.0, -1.2])
+
+
+@pytest.fixture(scope="module")
+def fixture_dir(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("toas")
+    gmodel = str(tmp / "fake.gmodel")
+    write_model(gmodel, "fake", "000", 1500.0, MODEL_PARAMS,
+                np.zeros(8, int), -4.0, 0, quiet=True)
+    par = str(tmp / "fake.par")
+    with open(par, "w") as f:
+        f.write("PSR      J0000+0000\nRAJ      04:37:00.0\n"
+                "DECJ     -47:15:00.0\nF0       200.0\n"
+                "PEPOCH   56000.0\nDM       30.0\n")
+    return tmp, gmodel, par
+
+
+@pytest.fixture(scope="module")
+def fake_archives(fixture_dir):
+    tmp, gmodel, par = fixture_dir
+    rng = np.random.default_rng(17)
+    files, phases, dDMs = [], [], []
+    for i in range(3):
+        phase = float(rng.uniform(-0.3, 0.3))
+        dDM = float(rng.normal(0.0, 2e-3))
+        out = str(tmp / f"fake_{i}.fits")
+        make_fake_pulsar(gmodel, par, out, nsub=4, npol=1, nchan=32,
+                         nbin=256, nu0=1500.0, bw=800.0, tsub=60.0,
+                         phase=phase, dDM=dDM, noise_stds=0.02,
+                         dedispersed=False, seed=100 + i, quiet=True)
+        files.append(out)
+        phases.append(phase)
+        dDMs.append(dDM)
+    return files, phases, dDMs, gmodel
+
+
+def test_get_toas_recovers_injected_dDM(fake_archives):
+    files, phases, dDMs, gmodel = fake_archives
+    gt = GetTOAs(files, gmodel, quiet=True)
+    gt.get_TOAs(bary=False)
+    assert len(gt.TOA_list) == 12  # 3 archives x 4 subints
+    for iarch in range(3):
+        # fitted DM - DM0 should recover the injected dDM
+        got = gt.DeltaDM_means[iarch]
+        err = gt.DeltaDM_errs[iarch]
+        assert abs(got - dDMs[iarch]) < max(5 * err, 5e-5), \
+            (iarch, got, dDMs[iarch], err)
+        np.testing.assert_allclose(gt.DM0s[iarch], 30.0)
+        ok = gt.ok_isubs[iarch]
+        assert 0.5 < np.median(gt.red_chi2s[iarch][ok]) < 1.5
+
+
+def test_toa_epochs_and_flags(fake_archives):
+    files, phases, dDMs, gmodel = fake_archives
+    gt = GetTOAs(files[:1], gmodel, quiet=True)
+    gt.get_TOAs(bary=False, print_phase=True,
+                addtnl_toa_flags={"pta": "TEST"})
+    toa = gt.TOA_list[0]
+    assert toa.DM is not None and toa.DM_error is not None
+    assert abs(toa.DM - 30.0) < 0.01
+    for flag in ("be", "fe", "f", "nbin", "nch", "nchx", "bw", "chbw",
+                 "subint", "tobs", "fratio", "tmplt", "snr", "gof", "phs",
+                 "phs_err", "pta"):
+        assert flag in toa.flags, flag
+    assert toa.flags["nbin"] == 256
+    assert toa.flags["nch"] == 32
+    assert toa.flags["pta"] == "TEST"
+    assert toa.flags["snr"] > 50
+    # TOA epoch should be within one pulse period of the subint epoch
+    d = load_data(files[0], quiet=True)
+    assert abs(toa.MJD - d.epochs[0]) < 2 * 0.005  # seconds
+
+
+def test_write_tim(fake_archives, tmp_path):
+    files, phases, dDMs, gmodel = fake_archives
+    gt = GetTOAs(files[:1], gmodel, quiet=True)
+    gt.get_TOAs(bary=False)
+    out = str(tmp_path / "toas.tim")
+    gt.write_TOAs(outfile=out, append=False)
+    lines = open(out).read().strip().split("\n")
+    assert len(lines) == 4
+    assert all("-pp_dm" in line for line in lines)
+
+
+def test_tscrunch_mode(fake_archives):
+    files, phases, dDMs, gmodel = fake_archives
+    gt = GetTOAs(files[:1], gmodel, quiet=True)
+    gt.get_TOAs(tscrunch=True, bary=False)
+    assert len(gt.TOA_list) == 1
+
+
+def test_zap_channels_clean_data(fake_archives):
+    files, phases, dDMs, gmodel = fake_archives
+    gt = GetTOAs(files[:1], gmodel, quiet=True)
+    gt.get_TOAs(bary=False)
+    zaps = gt.get_channels_to_zap(SNR_threshold=0.0, rchi2_threshold=2.0)
+    # clean synthetic data: no channels should be flagged
+    flagged = sum(len(b) for b in zaps[0])
+    assert flagged <= 2, zaps[0]
+
+
+def test_spline_model_pipeline(fake_archives, tmp_path):
+    # build a trivial spline model (flat eigen-space) and fit with it
+    import scipy.interpolate as si
+    from pulseportraiture_tpu.io.splmodel import write_spline_model
+    from pulseportraiture_tpu.ops.profiles import gen_gaussian_profile
+
+    files, phases, dDMs, gmodel = fake_archives
+    d = load_data(files[0], dedisperse=True, pscrunch=True, quiet=True)
+    # mean profile from the data; no frequency evolution (0 eigvec)
+    prof = d.prof
+    path = str(tmp_path / "model.spl")
+    freqs = d.freqs[0]
+    coords = np.zeros((1, len(freqs)))
+    tck, _ = si.splprep(coords, u=freqs, k=1, s=0)
+    write_spline_model(path, "m", "src", files[0], prof,
+                       np.zeros((len(prof), 1)), (tck[0],
+                                                  np.asarray(tck[1]),
+                                                  tck[2]))
+    gt = GetTOAs(files[:1], path, quiet=True)
+    gt.get_TOAs(bary=False)
+    assert len(gt.TOA_list) == 4
+    ok = gt.ok_isubs[0]
+    assert np.all(np.asarray(gt.snrs[0])[ok] > 20)
+
+
+def test_nu_refs_honored(fake_archives):
+    files, phases, dDMs, gmodel = fake_archives
+    gt = GetTOAs(files[:1], gmodel, quiet=True)
+    gt.get_TOAs(bary=False, nu_refs=(1400.0, 1400.0))
+    ok = gt.ok_isubs[0]
+    np.testing.assert_allclose(gt.nu_refs[0][ok][:, 0], 1400.0)
+    assert all(abs(t.frequency - 1400.0) < 1e-9 for t in gt.TOA_list)
