@@ -1,0 +1,144 @@
+"""Benchmark: batched wideband TOA+DM fitting throughput.
+
+North-star config (BASELINE.md): 1000 subints x 512 channels x 2048
+bins, phase+DM joint fit, single chip, target < 60 s with ~ns-level
+residuals vs the injected truth.  Prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+vs_baseline is measured throughput / target throughput (1000 fits/60 s);
+> 1 beats the north-star target.  The fit batch is processed in chunks
+sized to HBM; every chunk reuses one compiled executable.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from pulseportraiture_tpu.config import Dconst
+    from pulseportraiture_tpu.fit.phase_shift import fit_phase_shift
+    from pulseportraiture_tpu.fit.portrait import fit_portrait_full_batch
+    from pulseportraiture_tpu.ops.fourier import get_bin_centers, rotate_data
+    from pulseportraiture_tpu.ops.profiles import gen_gaussian_portrait
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    if on_accel:
+        nsub, nchan, nbin, chunk = 1000, 512, 2048, 125
+    else:  # CPU smoke config (first-slice scale from BASELINE.md)
+        nsub, nchan, nbin, chunk = 64, 128, 1024, 32
+    P0 = 0.005
+    noise = 0.05
+    dtype = jnp.float32 if on_accel else jnp.float64
+
+    model_params = np.array([0.0, 0.0, 0.35, -0.05, 0.05, 0.1, 1.0, -1.2],
+                            dtype=np.float32 if on_accel else np.float64)
+    freqs = np.linspace(1300.0, 1700.0, nchan).astype(model_params.dtype) \
+        + np.float32(400.0 / nchan / 2)
+    phases = np.asarray(get_bin_centers(nbin)).astype(model_params.dtype)
+    model = jnp.asarray(gen_gaussian_portrait("000", model_params, -4.0,
+                                              phases, freqs, 1500.0),
+                        dtype)
+
+    rng = np.random.default_rng(0)
+    phis_inj = rng.uniform(-0.4, 0.4, nsub)
+    dDMs_inj = rng.uniform(-2e-3, 2e-3, nsub)
+    freqs_j = jnp.asarray(freqs, jnp.float64)
+
+    def make_chunk(i0, i1, key):
+        ph = jnp.asarray(phis_inj[i0:i1])
+        dm = jnp.asarray(dDMs_inj[i0:i1])
+        base = jax.vmap(
+            lambda p, d: rotate_data(model, -p, -d, P0, freqs_j,
+                                     float(freqs.mean())))(ph, dm)
+        noise_arr = noise * jax.random.normal(key, base.shape, dtype)
+        return (base + noise_arr).astype(dtype)
+
+    # generate all chunks up front (device arrays)
+    keys = jax.random.split(jax.random.key(1), (nsub + chunk - 1) // chunk)
+    chunks = []
+    for ci, i0 in enumerate(range(0, nsub, chunk)):
+        i1 = min(i0 + chunk, nsub)
+        chunks.append(make_chunk(i0, i1, keys[ci]))
+    jax.block_until_ready(chunks)
+
+    errs = jnp.full((chunk, nchan), noise, dtype)
+    Ps = jnp.full((chunk,), P0, jnp.float64)
+    freqs_b = jnp.broadcast_to(freqs_j, (chunk, nchan))
+    model_b = jnp.broadcast_to(model, (chunk, nchan, nbin))
+
+    def fit_chunk(data, init):
+        out = fit_portrait_full_batch(
+            data, model_b, init, Ps, freqs_b, errs=errs,
+            fit_flags=(1, 1, 0, 0, 0), log10_tau=False, max_iter=30)
+        return out
+
+    # warm-up compile on the first chunk (guess + fit)
+    def guess_phase(data):
+        prof = data.mean(axis=1)
+        mprof = jnp.broadcast_to(model.mean(axis=0), prof.shape)
+        return fit_phase_shift(prof, mprof,
+                               noise=jnp.full(data.shape[0], noise,
+                                              dtype)).phase
+
+    g0 = jax.block_until_ready(guess_phase(chunks[0]))
+    init0 = jnp.zeros((chunk, 5), jnp.float64).at[:, 0].set(g0)
+    jax.block_until_ready(fit_chunk(chunks[0], init0).phi)
+
+    # timed run over all chunks (seed + fit, end to end on device)
+    t0 = time.time()
+    phis, DMs, phi_errs = [], [], []
+    nus = []
+    for data in chunks:
+        g = guess_phase(data)
+        init = jnp.zeros((data.shape[0], 5), jnp.float64).at[:, 0].set(g)
+        out = fit_chunk(data, init)
+        phis.append(out.phi)
+        DMs.append(out.DM)
+        phi_errs.append(out.phi_err)
+        nus.append(out.nu_DM)
+    jax.block_until_ready(phis)
+    duration = time.time() - t0
+
+    # accuracy vs injections: transform fitted phi back to the injection
+    # reference frequency and compare [ns]
+    phi = np.concatenate([np.asarray(p) for p in phis])
+    DM = np.concatenate([np.asarray(d) for d in DMs])
+    nu_ref = np.concatenate([np.asarray(n) for n in nus])
+    phi_err = np.concatenate([np.asarray(e) for e in phi_errs])
+    nu0 = freqs.mean()
+    phi_at_nu0 = phi + Dconst * DM / P0 * (nu0 ** -2.0 - nu_ref ** -2.0)
+    resid = (phi_at_nu0 - phis_inj + 0.5) % 1.0 - 0.5
+    resid_ns = resid * P0 * 1e9
+    # noise-normalized: |residual| / reported error (should be ~1)
+    zscore = np.median(np.abs(resid) / phi_err)
+
+    toas_per_sec = nsub / duration
+    target = 1000.0 / 60.0  # north-star: 1000 fits in 60 s
+    result = {
+        "metric": f"wideband TOA+DM fits/sec ({nsub}x{nchan}x{nbin}, "
+                  f"{platform})",
+        "value": round(toas_per_sec, 3),
+        "unit": "TOAs/sec",
+        "vs_baseline": round(toas_per_sec / target, 3),
+        "extra": {
+            "duration_sec": round(duration, 3),
+            "median_abs_resid_ns": round(float(np.median(np.abs(
+                resid_ns))), 3),
+            "median_resid_over_err": round(float(zscore), 3),
+            "median_reported_err_ns": round(float(np.median(phi_err)
+                                                  * P0 * 1e9), 3),
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,15 +45,19 @@ def phase_shift_objective(phase, cross, err):
     /root/reference/pplib.py:1244-1280.
     """
     nharm = cross.shape[-1]
-    k = jnp.arange(nharm, dtype=jnp.result_type(phase, jnp.float64))
-    frac = (phase[..., None] * k) % 1.0
+    real_dtype = cross.real.dtype
+    k = jnp.arange(nharm, dtype=jnp.float64)
+    frac = ((phase[..., None] * k) % 1.0).astype(real_dtype)
     ang = 2.0 * jnp.pi * frac
-    ph = jnp.cos(ang) + 1j * jnp.sin(ang)
+    ph = jax.lax.complex(jnp.cos(ang), jnp.sin(ang))
     w = cross * ph
+    kr = k.astype(real_dtype)
     inv_err2 = err ** -2.0
+    # Re(2 pi i k w) = -2 pi k Im(w): real arithmetic only (TPU-safe)
     C = -jnp.real(w.sum(axis=-1)) * inv_err2
-    dC = -jnp.real((2j * jnp.pi * k * w).sum(axis=-1)) * inv_err2
-    d2C = -jnp.real((-4.0 * jnp.pi ** 2 * k ** 2 * w).sum(axis=-1)) * inv_err2
+    dC = (2.0 * jnp.pi) * (kr * jnp.imag(w)).sum(axis=-1) * inv_err2
+    d2C = (4.0 * jnp.pi ** 2) * (kr ** 2 * jnp.real(w)).sum(axis=-1) \
+        * inv_err2
     return C, dC, d2C
 
 
@@ -67,11 +71,13 @@ def _fit_phase_shift_core(data, model, err_t, lo, hi, Ns, newton_iter):
     p = jnp.real(jnp.sum(mFFT * jnp.conj(mFFT), axis=-1)) * inv_err2
 
     # Grid stage: one batched contraction over the phase grid (MXU-friendly).
-    grid = lo + (hi - lo) * jnp.arange(Ns) / Ns  # [Ns]
+    grid = lo + (hi - lo) * jnp.arange(Ns, dtype=jnp.float64) / Ns
     nharm = cross.shape[-1]
-    k = jnp.arange(nharm, dtype=grid.dtype)
-    ang = 2.0 * jnp.pi * ((grid[:, None] * k[None, :]) % 1.0)
-    ph = jnp.cos(ang) + 1j * jnp.sin(ang)            # [Ns, nharm]
+    k = jnp.arange(nharm, dtype=jnp.float64)
+    ang = (2.0 * jnp.pi
+           * ((grid[:, None] * k[None, :]) % 1.0)).astype(
+               cross.real.dtype)
+    ph = jax.lax.complex(jnp.cos(ang), jnp.sin(ang))  # [Ns, nharm]
     Cgrid = -jnp.real(jnp.einsum("...h,gh->...g", cross, ph))
     phase0 = grid[jnp.argmin(Cgrid, axis=-1)]        # [...]
 

@@ -51,6 +51,7 @@ from ..ops.scattering import (
     scattering_times_deriv,
 )
 from ..utils.databunch import DataBunch
+from .smallsolve import inv_refined, solve_refined
 
 __all__ = ["fit_portrait_full", "fit_portrait_full_batch", "fit_portrait",
            "get_scales_full", "get_scales", "portrait_objective",
@@ -66,31 +67,65 @@ def _phase_shift_derivs(freqs, nu_DM, nu_GM, P):
 
 
 def _moments(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
-             nu_tau, log10_tau, nbin, order=2):
+             nu_tau, log10_tau, nbin, order=2, scat=True):
     """Per-channel moments of the objective at ``params``.
 
     cross = data_FT * conj(model_FT) [nchan, nharm]; abs_m2 = |model_FT|^2.
     Returns a dict with C, S (order>=0); dC, dS [5, nchan] (order>=1);
     d2C, d2S [5, 5, nchan] (order>=2).  All harmonic reductions happen
     here so XLA fuses phasor construction into the sums.
+
+    ``scat=False`` (static) elides the whole scattering kernel and its
+    derivative chain (B = 1): the phase+DM-only fit then touches no
+    [.., nchan, nharm] temporaries beyond the fused core product —
+    the memory/FLOP fast path for the most common configuration.
     """
     phi, DM, GM, tau_p, alpha = (params[0], params[1], params[2], params[3],
                                  params[4])
     tau = 10 ** tau_p if log10_tau else tau_p
     nharm = cross.shape[-1]
-    k = jnp.arange(nharm, dtype=cross.real.dtype)
+    real_dtype = cross.real.dtype
+    k64 = jnp.arange(nharm, dtype=jnp.float64)
+    k = k64.astype(real_dtype)
 
+    # phase reduction in f64 (k*shift spans thousands of rotations), trig
+    # in the data's real dtype — complex128-free so the kernel runs on TPU
     shifts = phi + Dconst * DM * (freqs ** -2 - nu_DM ** -2) / P \
         + (Dconst ** 2) * GM * (freqs ** -4 - nu_GM ** -4) / P
-    frac = (shifts[:, None] * k) % 1.0
+    frac = ((shifts[:, None] * k64) % 1.0).astype(real_dtype)
     ang = 2.0 * jnp.pi * frac
-    phsr = jnp.cos(ang) + 1j * jnp.sin(ang)
+    phsr = jax.lax.complex(jnp.cos(ang), jnp.sin(ang))
 
-    taus = scattering_times(tau, alpha, freqs, nu_tau)
+    nchan = cross.shape[0]
+    tpk = 2.0 * jnp.pi * k
+    if not scat:
+        # fast path: B == 1 identically; no scattering temporaries
+        core = cross * phsr                      # [nchan, nharm]
+        C = jnp.sum(jnp.real(core), axis=-1) * inv_err2
+        S = jnp.sum(abs_m2, axis=-1) * inv_err2
+        out = {"C": C, "S": S}
+        if order < 1:
+            return out
+        pd = _phase_shift_derivs(freqs, nu_DM, nu_GM, P)
+        T1 = -jnp.sum(tpk * jnp.imag(core), axis=-1) * inv_err2
+        dC = jnp.concatenate([T1[None] * pd,
+                              jnp.zeros((2, nchan), C.dtype)])
+        dS = jnp.zeros((5, nchan), C.dtype)
+        out.update(dC=dC, dS=dS)
+        if order < 2:
+            return out
+        T2 = -jnp.sum(tpk ** 2 * jnp.real(core), axis=-1) * inv_err2
+        d2C = jnp.zeros((5, 5, nchan), dtype=C.dtype)
+        d2C = d2C.at[:3, :3].set(T2[None, None] * pd[:, None]
+                                 * pd[None, :])
+        out.update(d2C=d2C, d2S=jnp.zeros((5, 5, nchan), C.dtype))
+        return out
+
+    # scattering chain in the data's real dtype (complex128-free on TPU)
+    taus = scattering_times(tau, alpha, freqs, nu_tau).astype(real_dtype)
     B = scattering_portrait_FT(taus, nbin)
 
     core = cross * jnp.conj(B) * phsr           # [nchan, nharm]
-    tpk = 2.0 * jnp.pi * k
     C = jnp.sum(jnp.real(core), axis=-1) * inv_err2
     S = jnp.sum(jnp.abs(B) ** 2 * abs_m2, axis=-1) * inv_err2
     out = {"C": C, "S": S, "taus": taus, "B": B}
@@ -98,11 +133,13 @@ def _moments(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
         return out
 
     pd = _phase_shift_derivs(freqs, nu_DM, nu_GM, P)        # [3, nchan]
-    taus_d = scattering_times_deriv(tau, freqs, nu_tau, log10_tau, taus)
+    taus_d = scattering_times_deriv(tau, freqs, nu_tau, log10_tau,
+                                    taus).astype(real_dtype)
     dB = scattering_portrait_FT_deriv(taus, taus_d, B)      # [2, nc, nh]
     absB_d = abs_scattering_portrait_FT_deriv(B, dB)        # [2, nc, nh]
 
-    T1 = jnp.sum(jnp.real(1j * tpk * core), axis=-1) * inv_err2
+    # Re(i*t*z) = -t*Im(z): harmonic-weighted moments via real arithmetic
+    T1 = -jnp.sum(tpk * jnp.imag(core), axis=-1) * inv_err2
     U = jnp.sum(jnp.real(cross[None] * jnp.conj(dB) * phsr[None]),
                 axis=-1) * inv_err2                          # [2, nchan]
     dC = jnp.concatenate([T1[None] * pd, U])                 # [5, nchan]
@@ -112,18 +149,17 @@ def _moments(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
     if order < 2:
         return out
 
-    taus_2d = scattering_times_2deriv(tau, freqs, nu_tau, log10_tau, taus,
-                                      taus_d)
+    taus_2d = scattering_times_2deriv(tau, freqs, nu_tau, log10_tau,
+                                      taus, taus_d).astype(real_dtype)
     d2B = scattering_portrait_FT_2deriv(taus, taus_d, taus_2d, B)
     absB_2d = abs_scattering_portrait_FT_2deriv(B, dB, d2B)
 
-    T2 = jnp.sum(jnp.real((1j * tpk) ** 2 * core), axis=-1) * inv_err2
-    V = jnp.sum(jnp.real(1j * tpk * cross[None] * jnp.conj(dB)
-                         * phsr[None]), axis=-1) * inv_err2   # [2, nchan]
+    # Re((i t)^2 z) = -t^2 Re(z); Re(i t z) = -t Im(z)
+    T2 = -jnp.sum(tpk ** 2 * jnp.real(core), axis=-1) * inv_err2
+    V = -jnp.sum(tpk * jnp.imag(cross[None] * jnp.conj(dB)
+                                * phsr[None]), axis=-1) * inv_err2
     W = jnp.sum(jnp.real(cross[None, None] * jnp.conj(d2B)
-                         * phsr[None, None]), axis=-1) * inv_err2  # [2,2,nc]
-
-    nchan = cross.shape[0]
+                         * phsr[None, None]), axis=-1) * inv_err2
     d2C = jnp.zeros((5, 5, nchan), dtype=C.dtype)
     d2C = d2C.at[:3, :3].set(T2[None, None] * pd[:, None] * pd[None, :])
     cross_CV = pd[:, None] * V[None]                          # [3, 2, nc]
@@ -139,13 +175,13 @@ def _moments(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
 
 
 def portrait_objective(params, cross, abs_m2, inv_err2, freqs, P, nu_DM,
-                       nu_GM, nu_tau, log10_tau, nbin):
+                       nu_GM, nu_tau, log10_tau, nbin, scat=True):
     """f = -sum_n C_n^2/S_n (chi^2 minus the constant data term Sd).
 
     Math equivalent of /root/reference/pptoaslib.py:525-542.
     """
     m = _moments(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
-                 nu_tau, log10_tau, nbin, order=0)
+                 nu_tau, log10_tau, nbin, order=0, scat=scat)
     C, S = m["C"], m["S"]
     safe_S = jnp.where(S > 0.0, S, 1.0)
     return -jnp.sum(jnp.where(S > 0.0, C ** 2 / safe_S, 0.0))
@@ -153,14 +189,16 @@ def portrait_objective(params, cross, abs_m2, inv_err2, freqs, P, nu_DM,
 
 def portrait_grad_hess(params, cross, abs_m2, inv_err2, freqs, P, nu_DM,
                        nu_GM, nu_tau, fit_flags, log10_tau, nbin,
-                       per_channel=False):
+                       per_channel=False, scat=None):
     """(f, gradient [5], Hessian [5,5]) of the objective, flags-masked.
 
     Math equivalent of /root/reference/pptoaslib.py:544-643; computed in
     one fused pass instead of three separate scipy callbacks.
     """
+    if scat is None:
+        scat = bool(fit_flags[3] or fit_flags[4])
     m = _moments(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
-                 nu_tau, log10_tau, nbin, order=2)
+                 nu_tau, log10_tau, nbin, order=2, scat=scat)
     C, S, dC, dS, d2C, d2S = m["C"], m["S"], m["dC"], m["dS"], m["d2C"], \
         m["d2S"]
     flags = jnp.asarray(fit_flags, dtype=C.dtype)
@@ -187,7 +225,8 @@ def portrait_grad_hess(params, cross, abs_m2, inv_err2, freqs, P, nu_DM,
 
 
 def _hess_with_scales(params, cross, abs_m2, inv_err2, freqs, P, nu_DM,
-                      nu_GM, nu_tau, fit_flags, log10_tau, nbin):
+                      nu_GM, nu_tau, fit_flags, log10_tau, nbin,
+                      scat=None):
     """Hessian blocks including per-channel amplitude params a_n.
 
     Returns (H5 [5,5] summed, cross_hess [5, nchan], S, C, scales).
@@ -195,8 +234,10 @@ def _hess_with_scales(params, cross, abs_m2, inv_err2, freqs, P, nu_DM,
     carried by the a_n block).  Math equivalent of
     /root/reference/pptoaslib.py:645-731.
     """
+    if scat is None:
+        scat = bool(fit_flags[3] or fit_flags[4])
     m = _moments(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
-                 nu_tau, log10_tau, nbin, order=2)
+                 nu_tau, log10_tau, nbin, order=2, scat=scat)
     C, S, dC, dS, d2C, d2S = m["C"], m["S"], m["dC"], m["dS"], m["d2C"], \
         m["d2S"]
     flags = jnp.asarray(fit_flags, dtype=C.dtype)
@@ -226,7 +267,7 @@ def _covariance_with_scales(H5, cross_hess, S, ifit, ok):
     U = cross_hess[ifit]                        # [nfit, nchan]
     Cinv = jnp.where(ok, 1.0 / (2.0 * S), 0.0)  # zapped: no contribution
     X = A - (U * Cinv[None, :]) @ U.T
-    X_inv = jnp.linalg.inv(X)
+    X_inv = inv_refined(X)
     cov_fit = 2.0 * X_inv
     # scale_errs^2 = 2 * (Cinv + Cinv^2 * diag(U^T X_inv U))
     UtXU_diag = jnp.einsum("fn,fg,gn->n", U, X_inv, U)
@@ -262,6 +303,14 @@ def _closest_root(roots, target, fallback):
     return jnp.where(jnp.any(~jnp.isnan(roots)), best, fallback)
 
 
+def _guarded_pow(ratio, expn, fallback):
+    """ratio**expn where ratio > 0, else ``fallback`` — degraded data can
+    flip the sign of the zero-covariance ratio; degrade to the fit
+    reference frequency instead of propagating NaN into the TOA."""
+    ok = ratio > 0.0
+    return jnp.where(ok, jnp.where(ok, ratio, 1.0) ** expn, fallback)
+
+
 def get_nu_zeros(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
                  nu_tau, fit_flags, log10_tau, nbin, option=0):
     """Zero-covariance reference frequencies (nu_DM, nu_GM, nu_tau).
@@ -285,10 +334,12 @@ def get_nu_zeros(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
 
     if flags == (1, 1, 0, 0, 0):
         H21_n = Hn[0, 1] / pd[1]
-        nu_zero_DM = (jnp.sum(freqs ** -2 * H21_n) / jnp.sum(H21_n)) ** -0.5
+        nu_zero_DM = _guarded_pow(
+            jnp.sum(freqs ** -2 * H21_n) / jnp.sum(H21_n), -0.5, nu_DM)
     elif flags == (1, 0, 1, 0, 0):
         H21_n = Hn[0, 2] / pd[2]
-        nu_zero_GM = (jnp.sum(freqs ** -4 * H21_n) / jnp.sum(H21_n)) ** -0.25
+        nu_zero_GM = _guarded_pow(
+            jnp.sum(freqs ** -4 * H21_n) / jnp.sum(H21_n), -0.25, nu_GM)
     elif flags == (0, 0, 0, 1, 1):
         H21_n = Hn[3, 4] / (taus_d[1] / taus)
         nu_zero_tau = jnp.exp(jnp.sum(jnp.log(freqs) * H21_n)
@@ -301,7 +352,7 @@ def get_nu_zeros(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
         numer = H13 * jnp.sum(freqs ** -2 * H23_n) \
             - H33 * jnp.sum(freqs ** -2 * H21_n)
         denom = H13 * jnp.sum(H23_n) - H33 * jnp.sum(H21_n)
-        nu_zero_DM = (numer / denom) ** -0.5
+        nu_zero_DM = _guarded_pow(numer / denom, -0.5, nu_DM)
     elif flags == (1, 1, 1, 0, 0):
         Hij = Hn.sum(axis=-1)
         if option == 0:
@@ -346,7 +397,7 @@ def get_nu_zeros(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
         denom = (H34 * H34 - H33 * H44) * jnp.sum(H21_n) + \
             (H13 * H44 - H14 * H34) * jnp.sum(H23_n) + \
             (H14 * H33 - H13 * H34) * jnp.sum(H24_n)
-        nu_zero_DM = (numer / denom) ** -0.5
+        nu_zero_DM = _guarded_pow(numer / denom, -0.5, nu_DM)
         numer = (H13 * H22 - H12 * H23) * jnp.sum(jnp.log(freqs) * H41_n) + \
             (H11 * H23 - H12 * H13) * jnp.sum(jnp.log(freqs) * H42_n) + \
             (H12 * H12 - H11 * H22) * jnp.sum(jnp.log(freqs) * H43_n)
@@ -436,14 +487,17 @@ def _solve(init_params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
     eye = jnp.eye(5, dtype=flags.dtype)
     unfit = eye * (1.0 - flags)[None, :]
 
+    scat = bool(fit_flags[3] or fit_flags[4])
+
     def fgH(x):
         return portrait_grad_hess(x, cross, abs_m2, inv_err2, freqs, P,
                                   nu_DM, nu_GM, nu_tau, fit_flags,
-                                  log10_tau, nbin)
+                                  log10_tau, nbin, scat=scat)
 
     def fval(x):
         return portrait_objective(x, cross, abs_m2, inv_err2, freqs, P,
-                                  nu_DM, nu_GM, nu_tau, log10_tau, nbin)
+                                  nu_DM, nu_GM, nu_tau, log10_tau, nbin,
+                                  scat=scat)
 
     f0, g0, H0 = fgH(init_params)
     state = dict(x=init_params, f=f0, g=g0, H=H0,
@@ -462,7 +516,7 @@ def _solve(init_params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
         x, f, g, H, mu = s["x"], s["f"], s["g"], s["H"], s["mu"]
         scale_d = jnp.maximum(jnp.abs(jnp.diagonal(H)), 1e-30)
         A = H + mu * jnp.diag(scale_d) + unfit
-        step = -jnp.linalg.solve(A, g)
+        step = -solve_refined(A, g)
         trial = jnp.clip(x + step, lo, hi)
         f_trial = fval(trial)
         accept = f_trial < f

@@ -1,0 +1,107 @@
+"""Unrolled symmetric solves for tiny (n<=8) systems, TPU-f64-safe.
+
+TPU's LuDecomposition/LAPACK custom calls only implement f32/c64; the
+fit kernels need f64 5x5 Newton solves and covariance inversions.  For
+fixed tiny n, Cholesky factorization unrolled into scalar elementwise
+ops compiles on any backend in any real dtype, vmaps cleanly, and is
+faster than a general LU at this size anyway.
+
+A non-positive-definite input yields NaNs (sqrt of a negative pivot) —
+deliberate: the Levenberg loop rejects NaN trial steps and raises its
+damping, and NaN covariance flags a failed fit (reference behavior).
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["chol_factor", "chol_solve", "solve_sym", "inv_sym",
+           "solve_refined", "inv_refined"]
+
+
+def chol_factor(A):
+    """Lower-triangular Cholesky factor of symmetric A [..., n, n],
+    unrolled over the (static) n."""
+    n = A.shape[-1]
+    L = [[None] * n for _ in range(n)]
+    for i in range(n):
+        for j in range(i + 1):
+            s = A[..., i, j]
+            for p in range(j):
+                s = s - L[i][p] * L[j][p]
+            if i == j:
+                L[i][j] = jnp.sqrt(s)
+            else:
+                L[i][j] = s / L[j][j]
+    rows = [jnp.stack([L[i][j] if j <= i else jnp.zeros_like(A[..., 0, 0])
+                       for j in range(n)], axis=-1) for i in range(n)]
+    return jnp.stack(rows, axis=-2)
+
+
+def chol_solve(L, b):
+    """Solve A x = b given L = chol_factor(A); b [..., n]."""
+    n = L.shape[-1]
+    # forward substitution: L y = b
+    y = [None] * n
+    for i in range(n):
+        s = b[..., i]
+        for p in range(i):
+            s = s - L[..., i, p] * y[p]
+        y[i] = s / L[..., i, i]
+    # back substitution: L^T x = y
+    x = [None] * n
+    for i in reversed(range(n)):
+        s = y[i]
+        for p in range(i + 1, n):
+            s = s - L[..., p, i] * x[p]
+        x[i] = s / L[..., i, i]
+    return jnp.stack(x, axis=-1)
+
+
+def solve_refined(A, b, refinements=2):
+    """General small solve: f32 LU + f64 iterative refinement.
+
+    TPU's LU only implements f32; a f32 solve refined twice in f64
+    (r = b - A x; x += A_f32^-1 r) recovers ~f64 accuracy for
+    well-conditioned systems and stays *finite* (unlike Cholesky) on
+    indefinite A — which the Levenberg loop requires far from the
+    minimum.
+    """
+    A32 = A.astype(jnp.float32)
+    lu, piv = jax.scipy.linalg.lu_factor(A32)
+
+    def solve32(rhs):
+        return jax.scipy.linalg.lu_solve(
+            (lu, piv), rhs.astype(jnp.float32)).astype(A.dtype)
+
+    x = solve32(b)
+    for _ in range(refinements):
+        r = b - jnp.einsum("...ij,...j->...i", A, x)
+        x = x + solve32(r)
+    return x
+
+
+def inv_refined(A, refinements=2):
+    """General small inverse: f32 LU + f64 Newton refinement
+    (X <- X (2 I - A X))."""
+    A32 = A.astype(jnp.float32)
+    X = jnp.linalg.inv(A32).astype(A.dtype)
+    n = A.shape[-1]
+    eye = jnp.eye(n, dtype=A.dtype)
+    for _ in range(refinements):
+        X = X @ (2.0 * eye - A @ X)
+    return X
+
+
+def solve_sym(A, b):
+    """x = A^-1 b for symmetric (positive-definite) A [..., n, n]."""
+    return chol_solve(chol_factor(A), b)
+
+
+def inv_sym(A):
+    """Inverse of symmetric (positive-definite) A [..., n, n]."""
+    n = A.shape[-1]
+    L = chol_factor(A)
+    eye = jnp.eye(n, dtype=A.dtype)
+    cols = [chol_solve(L, jnp.broadcast_to(eye[i], A.shape[:-2] + (n,)))
+            for i in range(n)]
+    return jnp.stack(cols, axis=-1)

@@ -12,6 +12,7 @@ parameter sets batches model evaluation inside the Levenberg-Marquardt
 model fitter.
 """
 
+import jax
 import jax.numpy as jnp
 
 from .fourier import get_bin_centers
@@ -57,7 +58,8 @@ def gaussian_profile(nbin, loc, wid, norm=False):
     is scaled so its maximum sampled value is exp(-0.5*z_peak^2) for the
     bin nearest loc.
     """
-    locval = get_bin_centers(nbin)
+    locval = get_bin_centers(nbin).astype(
+        jnp.result_type(jnp.asarray(loc).dtype, jnp.float32))
     mean = loc % 1.0
     # wrap bin coordinates to within half a rotation of the mean
     locval = jnp.where(locval - mean > 0.5, locval - 1.0, locval)
@@ -92,7 +94,8 @@ def gen_gaussian_profile(params, nbin):
                        for loc, wid, amp in comps])
     model = dc + profs.sum(axis=0)
     k = jnp.arange(nbin // 2 + 1, dtype=params.dtype)
-    sp_FT = (1.0 + 2j * jnp.pi * k * (tau / nbin)) ** -1
+    x = 2.0 * jnp.pi * k * (tau / nbin)
+    sp_FT = jax.lax.complex(1.0 / (1.0 + x * x), -x / (1.0 + x * x))
     scattered = jnp.fft.irfft(sp_FT * jnp.fft.rfft(model), n=nbin)
     return jnp.where(tau != 0.0, scattered, model)
 
@@ -164,8 +167,10 @@ def gen_gaussian_portrait(model_code, params, scattering_index, phases,
     amps = evolve_parameter(freqs, nu_ref, comps[:, 4], comps[:, 5],
                             model_code[2])
 
-    # Vectorized wrapped-Gaussian evaluation over [nchan, ngauss, nbin].
-    locval = get_bin_centers(nbin)
+    # Vectorized wrapped-Gaussian evaluation over [nchan, ngauss, nbin];
+    # bin centers follow the parameter dtype so an f32 call stays
+    # complex128-free through the scattering FFT (TPU-safe)
+    locval = get_bin_centers(nbin).astype(params.dtype)
     mean = locs % 1.0
     x = locval[None, None, :] - mean[..., None]
     x = jnp.where(x > 0.5, x - 1.0, x)
@@ -177,7 +182,8 @@ def gen_gaussian_portrait(model_code, params, scattering_index, phases,
     comps_prof = jnp.where((wids > 0.0)[..., None], comps_prof, 0.0)
     gport = dc + jnp.sum(amps[..., None] * comps_prof, axis=1)
 
-    taus = scattering_times(tau / nbin, scattering_index, freqs, nu_ref)
+    taus = scattering_times(tau / nbin, scattering_index, freqs,
+                            nu_ref).astype(params.dtype)
     sp_FT = scattering_portrait_FT(taus, nbin)
     scattered = jnp.fft.irfft(sp_FT * jnp.fft.rfft(gport, axis=-1), n=nbin,
                               axis=-1)
@@ -208,8 +214,10 @@ def gaussian_profile_FT(nbin, loc, wid, amp):
     convention.
     """
     prof = amp * gaussian_profile(nbin, loc, wid, norm=False)
-    k = jnp.arange(nbin // 2 + 1)
-    return jnp.fft.rfft(prof) * jnp.exp(-1j * jnp.pi * k / nbin)
+    k = jnp.arange(nbin // 2 + 1, dtype=prof.dtype)
+    ang = jnp.pi * k / nbin
+    return jnp.fft.rfft(prof) * jax.lax.complex(jnp.cos(ang),
+                                                -jnp.sin(ang))
 
 
 def gaussian_portrait_FT(model_code, params, scattering_index, nbin, freqs,

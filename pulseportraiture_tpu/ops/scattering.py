@@ -15,6 +15,7 @@ finite and the whole chain stays differentiable under jit (no data-dependent
 branches on tau, unlike the reference's ``if taus.sum()`` host branches).
 """
 
+import jax
 import jax.numpy as jnp
 
 __all__ = [
@@ -83,8 +84,12 @@ def scattering_profile_FT(tau, nbin):
     Equivalent of /root/reference/pplib.py:4061-4084.
     """
     nharm = nbin // 2 + 1
-    k = jnp.arange(nharm)
-    return (1.0 + 2j * jnp.pi * k * tau) ** -1
+    k = jnp.arange(nharm, dtype=jnp.asarray(tau).dtype)
+    # 1/(1+ix) = (1-ix)/(1+x^2), expressed in real ops + lax.complex so
+    # no complex128 scalar constants reach the device (TPU-safe)
+    x = 2.0 * jnp.pi * k * tau
+    denom = 1.0 + x * x
+    return jax.lax.complex(1.0 / denom, -x / denom)
 
 
 def scattering_portrait_FT(taus, nbin):
@@ -96,7 +101,9 @@ def scattering_portrait_FT(taus, nbin):
     taus = jnp.asarray(taus)
     nharm = nbin // 2 + 1
     k = jnp.arange(nharm, dtype=taus.dtype)
-    return (1.0 + 2j * jnp.pi * k * taus[..., None]) ** -1
+    x = 2.0 * jnp.pi * k * taus[..., None]
+    denom = 1.0 + x * x
+    return jax.lax.complex(1.0 / denom, -x / denom)
 
 
 def scattering_portrait_FT_deriv(taus, taus_deriv, scat_port_FT):
@@ -108,7 +115,9 @@ def scattering_portrait_FT_deriv(taus, taus_deriv, scat_port_FT):
     """
     nharm = scat_port_FT.shape[-1]
     k = jnp.arange(nharm, dtype=jnp.asarray(taus).dtype)
-    dB_dtaus = -2j * jnp.pi * k * scat_port_FT ** 2
+    # -2*pi*i*k as a same-dtype complex array (no weak c128 scalars)
+    mjk = jax.lax.complex(jnp.zeros_like(k), -2.0 * jnp.pi * k)
+    dB_dtaus = mjk * scat_port_FT ** 2
     dtau, dalpha = taus_deriv
     return jnp.stack([dB_dtaus * dtau[..., None],
                       dB_dtaus * dalpha[..., None]])
@@ -125,7 +134,7 @@ def scattering_portrait_FT_2deriv(taus, taus_deriv, taus_2deriv,
     """
     nharm = scat_port_FT.shape[-1]
     k = jnp.arange(nharm, dtype=jnp.asarray(taus).dtype)
-    u = -2j * jnp.pi * k
+    u = jax.lax.complex(jnp.zeros_like(k), -2.0 * jnp.pi * k)
     B = scat_port_FT
     dB = u * B ** 2
     d2B = 2.0 * (u ** 2) * B ** 3

@@ -1,0 +1,63 @@
+"""Device mesh + sharding helpers for scale-out fits.
+
+The reference has no parallelism layer at all (SURVEY.md §2.10); its
+scaling story is users launching independent processes.  Here the
+embarrassing (subint x channel) independence of the fits becomes an
+explicit two-axis device mesh:
+
+* 'subint' — data parallelism over the fit batch (archives x subints,
+  or pulsars x epochs for IPTA sweeps).  No cross-device communication.
+* 'chan'   — model parallelism over frequency channels.  The chi-squared
+  channel reductions become XLA all-reduces over ICI, inserted by GSPMD
+  from the sharding annotations (no hand-written collectives).
+
+On a single host this maps onto one slice's chips; multi-host layouts
+put 'subint' on DCN and keep 'chan' inside a slice so the per-iteration
+psum rides ICI.
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "shard_batch", "batch_sharding", "P"]
+
+
+def make_mesh(n_subint=None, n_chan=1, devices=None):
+    """Mesh with axes ('subint', 'chan').
+
+    Defaults to all devices on the subint (data) axis; set n_chan > 1 to
+    split the channel reductions across devices as well.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n_subint is None:
+        n_subint = n // n_chan
+    if n_subint * n_chan != n:
+        raise ValueError(f"mesh {n_subint}x{n_chan} != {n} devices")
+    dev_array = np.asarray(devices).reshape(n_subint, n_chan)
+    return Mesh(dev_array, axis_names=("subint", "chan"))
+
+
+def batch_sharding(mesh, with_chan_axis=True):
+    """NamedSharding for a [B, nchan, nbin] fit batch on ``mesh``."""
+    spec = P("subint", "chan" if with_chan_axis else None, None)
+    return NamedSharding(mesh, spec)
+
+
+def shard_batch(mesh, data_ports, model_ports=None, errs=None,
+                weights=None):
+    """Place fit-batch arrays on the mesh (batch over 'subint', channels
+    over 'chan'); scalars/metadata stay replicated."""
+    sh3 = batch_sharding(mesh)
+    sh2 = NamedSharding(mesh, P("subint", "chan"))
+    out = [jax.device_put(data_ports, sh3)]
+    if model_ports is not None:
+        out.append(jax.device_put(model_ports, sh3))
+    if errs is not None:
+        out.append(jax.device_put(errs, sh2))
+    if weights is not None:
+        out.append(jax.device_put(weights, sh2))
+    return tuple(out) if len(out) > 1 else out[0]

@@ -1,0 +1,89 @@
+"""Sharded batched portrait fits over a device mesh.
+
+The batched 5-parameter fit is already one jitted XLA program
+(fit/portrait.py); scaling it out is a matter of *sharding its inputs*
+on a ('subint', 'chan') mesh and letting GSPMD partition the program —
+the per-channel moment reductions become all-reduces over the 'chan'
+axis, and the per-subint solver state stays local to its 'subint' shard.
+This replaces nothing in the reference (it has no distributed layer,
+SURVEY.md §2.10/5.8); it is the scaling design the TPU port adds.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..fit.portrait import fit_portrait_full_batch
+from .mesh import batch_sharding, make_mesh
+
+__all__ = ["sharded_fit_portrait_batch", "ipta_sweep_fit"]
+
+
+def sharded_fit_portrait_batch(mesh, data_ports, model_ports, init_params,
+                               Ps, freqs, errs=None, weights=None,
+                               fit_flags=(1, 1, 0, 0, 0), nu_fits=None,
+                               nu_outs=None, bounds=None, log10_tau=False,
+                               max_iter=50):
+    """Run fit_portrait_full_batch with inputs sharded on ``mesh``.
+
+    data_ports [B, nchan, nbin] is split over ('subint', 'chan'); the
+    batch size B must divide by the mesh's subint axis and nchan by its
+    chan axis.  Outputs follow the inputs' sharding (per-subint results
+    live on the subint shards).
+    """
+    sh3 = batch_sharding(mesh)
+    sh2 = NamedSharding(mesh, P("subint", "chan"))
+    sh1 = NamedSharding(mesh, P("subint"))
+    B = data_ports.shape[0]
+    data_ports = jax.device_put(jnp.asarray(data_ports), sh3)
+    model_ports = jax.device_put(
+        jnp.broadcast_to(jnp.asarray(model_ports), data_ports.shape), sh3)
+    init_params = jax.device_put(
+        jnp.broadcast_to(jnp.asarray(init_params, jnp.float64), (B, 5)),
+        sh1)
+    Ps = jax.device_put(jnp.broadcast_to(jnp.asarray(Ps), (B,)), sh1)
+    freqs = jnp.asarray(freqs)
+    if freqs.ndim == 1:
+        freqs = jnp.broadcast_to(freqs, (B, freqs.shape[0]))
+    freqs = jax.device_put(freqs, sh2)
+    if errs is not None:
+        errs = jax.device_put(
+            jnp.broadcast_to(jnp.asarray(errs), data_ports.shape[:-1]),
+            sh2)
+    if weights is not None:
+        weights = jax.device_put(
+            jnp.broadcast_to(jnp.asarray(weights), data_ports.shape[:-1]),
+            sh2)
+    with mesh:
+        return fit_portrait_full_batch(
+            data_ports, model_ports, init_params, Ps, freqs, errs=errs,
+            weights=weights, fit_flags=fit_flags, nu_fits=nu_fits,
+            nu_outs=nu_outs, bounds=bounds, log10_tau=log10_tau,
+            max_iter=max_iter)
+
+
+def ipta_sweep_fit(data_ports, model_ports, init_params, Ps, freqs,
+                   errs=None, weights=None, fit_flags=(1, 1, 0, 0, 0),
+                   n_chan_shards=1, **kw):
+    """IPTA-scale sweep: [npulsar*nepoch, nchan, nbin] batch sharded over
+    all available devices (BASELINE.md '20 pulsars x 10 epochs' config).
+
+    Flattens any leading (pulsar, epoch) structure into the subint axis;
+    callers reshape the stacked outputs back.
+    """
+    mesh = make_mesh(n_chan=n_chan_shards)
+    data = jnp.asarray(data_ports)
+    lead = data.shape[:-2]
+    B = int(jnp.prod(jnp.asarray(lead)))
+    data = data.reshape((B,) + data.shape[-2:])
+    model = jnp.broadcast_to(jnp.asarray(model_ports), data.shape)
+    out = sharded_fit_portrait_batch(
+        mesh, data, model,
+        jnp.broadcast_to(jnp.asarray(init_params, jnp.float64), (B, 5)),
+        jnp.broadcast_to(jnp.asarray(Ps), (B,)),
+        jnp.asarray(freqs), errs=None if errs is None else
+        jnp.asarray(errs).reshape(B, -1),
+        weights=None if weights is None else
+        jnp.asarray(weights).reshape(B, -1),
+        fit_flags=fit_flags, **kw)
+    return out

@@ -1,0 +1,226 @@
+"""Iterative align-and-average pipeline (ppalign equivalent).
+
+TPU-native re-design of the reference's ``align_archives``
+(/root/reference/ppalign.py:54-243): per iteration, each archive's
+subintegrations are phase/DM-fit against the running template *in one
+batched device call* and accumulated with scales/noise weighting; the
+weighted average becomes the next iteration's template.  The subprocess
+wrappers around PSRCHIVE's psradd/psrsmooth are replaced with native
+equivalents (average_archives, and models.wavelet smoothing).
+"""
+
+import numpy as np
+
+from ..fit.phase_shift import fit_phase_shift
+from ..fit.portrait import fit_portrait_full_batch
+from ..fit.transforms import guess_fit_freq
+from ..io.archive import load_data, parse_metafile
+from ..ops.fourier import rotate_data
+from ..ops.normalize import normalize_portrait
+from ..ops.profiles import gaussian_profile
+
+__all__ = ["align_archives", "average_archives"]
+
+
+def average_archives(datafiles, outfile, palign=False, tscrunch=True,
+                     quiet=True):
+    """Native psradd equivalent: load archives, optionally phase-align on
+    their band-average profiles (psradd -P analog), and average them into
+    one archive written to ``outfile``.
+
+    Replaces the subprocess wrapper /root/reference/ppalign.py:21-38.
+    """
+    if isinstance(datafiles, str):
+        datafiles = parse_metafile(datafiles)
+    total = None
+    template_arch = None
+    nused = 0
+    ref_prof = None
+    for f in datafiles:
+        try:
+            d = load_data(f, dedisperse=True, tscrunch=True, pscrunch=True,
+                          rm_baseline=True, quiet=True)
+        except (OSError, ValueError, RuntimeError):
+            continue
+        port = (d.masks * d.subints)[0, 0]
+        if palign:
+            prof = port.mean(axis=0)
+            if ref_prof is None:
+                ref_prof = prof
+            else:
+                shift = float(np.asarray(
+                    fit_phase_shift(prof, ref_prof, Ns=d.nbin).phase))
+                port = np.asarray(rotate_data(port, shift))
+        if total is None:
+            total = np.zeros_like(port)
+            template_arch = d.arch
+        if port.shape == total.shape:
+            total += port
+            nused += 1
+    if nused == 0:
+        raise ValueError("No loadable archives to average.")
+    avg = total / nused
+    arch = template_arch.copy()
+    arch.tscrunch()
+    arch.pscrunch()
+    arch.data = avg[None, None]
+    arch.unload(outfile, quiet=quiet)
+    return outfile
+
+
+def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
+                   pscrunch=True, SNR_cutoff=0.0, outfile=None, norm=None,
+                   rot_phase=0.0, place=None, niter=1, quiet=True,
+                   max_iter=30):
+    """Iteratively align + average archives against a template.
+
+    metafile: metafile path or list of archive paths; initial_guess: a
+    PSRFITS archive giving the starting template.  Behavior follows
+    /root/reference/ppalign.py:54-243: per subint, (phase, DM) is fit
+    against the template, subints are rotated and accumulated weighted
+    by scales/noise**2, the average becomes the next template; the
+    output archive gets DM=0 and dmc=0.
+
+    Returns (outfile, aligned_port [npol, nchan, nbin], total_weights).
+    """
+    if isinstance(metafile, str):
+        datafiles = parse_metafile(metafile)
+        if outfile is None:
+            outfile = metafile + ".algnd.fits"
+    else:
+        datafiles = list(metafile)
+        if outfile is None:
+            outfile = "aligned.fits"
+    state = "Intensity" if pscrunch else "Stokes"
+    npol = 1 if pscrunch else 4
+
+    model_data = load_data(initial_guess, state=state, dedisperse=True,
+                           tscrunch=True, pscrunch=pscrunch,
+                           rm_baseline=True, refresh_arch=True,
+                           return_arch=True, quiet=True)
+    nchan, nbin = model_data.nchan, model_data.nbin
+    model_port = (model_data.masks * model_data.subints)[0, 0]
+
+    skip_these = set()
+    aligned_port = np.zeros((npol, nchan, nbin))
+    total_weights = np.zeros((nchan, nbin))
+    for count in range(1, niter + 1):
+        if not quiet:
+            print(f"Doing iteration {count}...")
+        aligned_port[:] = 0.0
+        total_weights[:] = 0.0
+        use_files = [f for f in datafiles if f not in skip_these]
+        for datafile in use_files:
+            try:
+                d = load_data(datafile, state=state, dedisperse=False,
+                              tscrunch=tscrunch, pscrunch=pscrunch,
+                              rm_baseline=True, refresh_arch=False,
+                              return_arch=False, quiet=True)
+            except (OSError, ValueError, RuntimeError):
+                skip_these.add(datafile)
+                continue
+            if d.nbin != nbin:
+                skip_these.add(datafile)
+                continue
+            if d.prof_SNR < SNR_cutoff:
+                skip_these.add(datafile)
+                continue
+            same_freqs = d.freqs.shape[-1] == nchan and \
+                np.allclose(d.freqs[0], model_data.freqs[0])
+            ok = np.asarray(d.ok_isubs)
+            if not len(ok):
+                continue
+            B = len(ok)
+            wok = (d.weights[ok] > 0.0).astype(float)
+            # mask channels missing from the template too
+            model_mask = np.zeros(nchan)
+            model_mask[model_data.ok_ichans[0]] = 1.0
+            if same_freqs:
+                model_b = np.broadcast_to(model_port,
+                                          (B, nchan, nbin)).copy()
+                wok = wok * model_mask[None, :]
+                chan_map = None
+            else:
+                # nearest-frequency template channels (ppalign.py:165-172)
+                chan_map = np.argmin(np.abs(
+                    model_data.freqs[0][None, :]
+                    - d.freqs[0][:, None]), axis=1)
+                model_b = np.broadcast_to(model_port[chan_map],
+                                          (B, d.nchan, nbin)).copy()
+            ports = d.subints[ok, 0]
+            freqs_b = d.freqs[ok]
+            errs_b = d.noise_stds[ok, 0]
+            SNRs_b = d.SNRs[ok, 0]
+            Ps_b = d.Ps[ok]
+            DM_guess = d.DM
+
+            nu_fit = np.array([
+                float(np.asarray(guess_fit_freq(freqs_b[i][wok[i] > 0],
+                                                SNRs_b[i][wok[i] > 0])))
+                for i in range(B)])
+            rot = np.stack([
+                np.asarray(rotate_data(ports[i], 0.0, DM_guess,
+                                       float(Ps_b[i]), freqs_b[i],
+                                       nu_fit[i])) for i in range(B)])
+            rot_profs = (rot * wok[..., None]).sum(1) / \
+                np.maximum(wok.sum(-1), 1.0)[:, None]
+            model_profs = (model_b * wok[..., None]).sum(1) / \
+                np.maximum(wok.sum(-1), 1.0)[:, None]
+            g = fit_phase_shift(rot_profs, model_profs,
+                                noise=np.median(errs_b, axis=-1), Ns=nbin)
+            init = np.zeros((B, 5))
+            init[:, 0] = np.asarray(g.phase)
+            init[:, 1] = DM_guess
+            out = fit_portrait_full_batch(
+                ports, model_b, init, Ps_b, freqs_b, errs=errs_b,
+                weights=wok, fit_flags=(1, int(bool(fit_dm)), 0, 0, 0),
+                nu_fits=np.stack([nu_fit] * 3, axis=1),
+                log10_tau=False, max_iter=max_iter)
+            phases_f = np.asarray(out.phi)
+            DMs_f = np.asarray(out.DM)
+            nu_refs_f = np.asarray(out.nu_DM)
+            scales_f = np.asarray(out.scales)
+
+            full = d.subints[ok]  # [B, npol, nchan, nbin]
+            for j in range(B):
+                okc = wok[j] > 0
+                w = np.outer(scales_f[j][okc] / errs_b[j][okc] ** 2,
+                             np.ones(nbin))
+                rotated = np.asarray(rotate_data(
+                    full[j][:, okc], phases_f[j], DMs_f[j],
+                    float(Ps_b[j]), freqs_b[j][okc], nu_refs_f[j]))
+                tchan = np.flatnonzero(okc) if chan_map is None \
+                    else chan_map[okc]
+                for ipol in range(npol):
+                    aligned_port[ipol, tchan] += w * rotated[ipol]
+                total_weights[tchan] += w
+        nz = total_weights > 0
+        for ipol in range(npol):
+            aligned_port[ipol][nz] /= total_weights[nz]
+        model_port = aligned_port[0]
+
+    if norm in ("mean", "max", "prof", "rms", "abs"):
+        for ipol in range(npol):
+            aligned_port[ipol] = np.asarray(
+                normalize_portrait(aligned_port[ipol], norm))
+    if rot_phase:
+        aligned_port = np.asarray(rotate_data(aligned_port, rot_phase))
+    if place is not None:
+        prof = aligned_port[0].mean(axis=0)
+        delta = prof.max() * np.asarray(
+            gaussian_profile(nbin, place, 0.0001))
+        phase = float(np.asarray(fit_phase_shift(prof, delta,
+                                                 Ns=nbin).phase))
+        aligned_port = np.asarray(rotate_data(aligned_port, phase))
+
+    arch = model_data.arch.copy()
+    arch.tscrunch()
+    if pscrunch:
+        arch.pscrunch()
+    arch.DM = 0.0
+    arch.dedispersed = False
+    arch.data = np.asarray(aligned_port)[None]
+    arch.weights = np.where(total_weights.sum(axis=-1) > 0.0, 1.0,
+                            0.0)[None, :]
+    arch.unload(outfile, quiet=quiet)
+    return outfile, aligned_port, total_weights
