@@ -20,6 +20,8 @@ precision (cf. the reference's direct ``exp(2j*pi*outer(...))``,
 /root/reference/pptoaslib.py:233-238, which relies on float64 throughout).
 """
 
+import functools
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
@@ -89,6 +91,44 @@ def complex_dtype_for(real_dtype):
     return jnp.result_type(real_dtype, jnp.complex64)
 
 
+@functools.lru_cache(maxsize=None)
+def backend_supports_complex128():
+    """True when the default JAX backend can compile complex128.
+
+    TPUs cannot ("Element type C128 is not supported"); CPUs and GPUs can.
+    Cached per-process — the default backend does not change mid-run.
+    """
+    try:
+        return jax.default_backend() != "tpu"
+    except RuntimeError:  # pragma: no cover - backend init failure
+        return True
+
+
+def fft_real_dtype(dtype):
+    """Widest real dtype whose complex counterpart compiles on the default
+    backend: float64 stays float64 on CPU/GPU but becomes float32 on TPU.
+
+    This is the device boundary of the numerics contract: *solver state*
+    (phases, DMs, chi-squared sums, mod-1 phasor arguments) stays float64
+    everywhere, while arrays that flow through rfft/lax.complex are clamped
+    here so no f64 path ever materializes complex128 on TPU.
+    """
+    dtype = jnp.dtype(dtype)
+    if not jnp.issubdtype(dtype, jnp.floating):
+        dtype = jnp.dtype(solver_dtype)
+    if dtype == jnp.float64 and not backend_supports_complex128():
+        return jnp.dtype(jnp.float32)
+    return dtype
+
+
+def as_fft_operand(x):
+    """Cast a real array for use in rfft/complex ops (see fft_real_dtype)."""
+    x = jnp.asarray(x)
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        return x
+    return x.astype(fft_real_dtype(x.dtype))
+
+
 __all__ = [
     "Dconst",
     "Dconst_exact",
@@ -104,4 +144,7 @@ __all__ = [
     "data_dtype",
     "default_float",
     "complex_dtype_for",
+    "backend_supports_complex128",
+    "fft_real_dtype",
+    "as_fft_operand",
 ]

@@ -17,7 +17,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from ..config import F0_fact
+from ..config import F0_fact, as_fft_operand
 from ..ops.noise import get_noise
 from ..utils.databunch import DataBunch
 
@@ -29,8 +29,8 @@ def cross_spectrum(data, model, zap_f0=True):
 
     data/model: [..., nbin]; returns (cross [..., nharm], dFFT, mFFT).
     """
-    dFFT = jnp.fft.rfft(data, axis=-1)
-    mFFT = jnp.fft.rfft(model, axis=-1)
+    dFFT = jnp.fft.rfft(as_fft_operand(data), axis=-1)
+    mFFT = jnp.fft.rfft(as_fft_operand(model), axis=-1)
     if zap_f0:
         dFFT = dFFT.at[..., 0].multiply(F0_fact)
         mFFT = mFFT.at[..., 0].multiply(F0_fact)

@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import Dconst, F0_fact
+from ..config import Dconst, F0_fact, as_fft_operand
 from ..ops.noise import get_noise
 from ..ops.scattering import (
     abs_scattering_portrait_FT_2deriv,
@@ -106,7 +106,9 @@ def _moments(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
         out = {"C": C, "S": S}
         if order < 1:
             return out
-        pd = _phase_shift_derivs(freqs, nu_DM, nu_GM, P)
+        # cast to the objective dtype so the Hessian scatter below never
+        # mixes f64 products into an f32 array (future-error in JAX)
+        pd = _phase_shift_derivs(freqs, nu_DM, nu_GM, P).astype(C.dtype)
         T1 = -jnp.sum(tpk * jnp.imag(core), axis=-1) * inv_err2
         dC = jnp.concatenate([T1[None] * pd,
                               jnp.zeros((2, nchan), C.dtype)])
@@ -132,7 +134,7 @@ def _moments(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
     if order < 1:
         return out
 
-    pd = _phase_shift_derivs(freqs, nu_DM, nu_GM, P)        # [3, nchan]
+    pd = _phase_shift_derivs(freqs, nu_DM, nu_GM, P).astype(C.dtype)
     taus_d = scattering_times_deriv(tau, freqs, nu_tau, log10_tau,
                                     taus).astype(real_dtype)
     dB = scattering_portrait_FT_deriv(taus, taus_d, B)      # [2, nc, nh]
@@ -312,7 +314,7 @@ def _guarded_pow(ratio, expn, fallback):
 
 
 def get_nu_zeros(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
-                 nu_tau, fit_flags, log10_tau, nbin, option=0):
+                 nu_tau, fit_flags, log10_tau, nbin, option=0, scat=None):
     """Zero-covariance reference frequencies (nu_DM, nu_GM, nu_tau).
 
     Closed forms per static fit_flags combination, math equivalent of
@@ -323,7 +325,7 @@ def get_nu_zeros(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
     flags = tuple(int(bool(fl)) for fl in fit_flags)
     _, _, Hn = portrait_grad_hess(params, cross, abs_m2, inv_err2, freqs, P,
                                   nu_DM, nu_GM, nu_tau, flags, log10_tau,
-                                  nbin, per_channel=True)
+                                  nbin, per_channel=True, scat=scat)
     pd = _phase_shift_derivs(freqs, nu_DM, nu_GM, P)
     tau = 10 ** params[3] if log10_tau else params[3]
     taus = scattering_times(tau, params[4], freqs, nu_tau)
@@ -466,15 +468,35 @@ def get_nu_zeros(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
         # pptoaslib.py:893-901).
         return get_nu_zeros(params, cross, abs_m2, inv_err2, freqs, P,
                             nu_DM, nu_GM, nu_tau, (1, 1, 0, 1, 1),
-                            log10_tau, nbin, option)
+                            log10_tau, nbin, option, scat=scat)
     # any other combination: keep the fit frequencies
     return [nu_zero_DM, nu_zero_GM, nu_zero_tau]
 
 
+def _scat_hint(fit_flags, init_params, log10_tau):
+    """Static decision: may the scattering kernel B differ from 1?
+
+    True when tau/alpha are fitted, or when a *fixed* tau is (or cannot be
+    proven) nonzero — a fixed nonzero tau must still apply B at its value
+    (the reference always does, pptoaslib.py:525-542).  Only a statically
+    zero tau (0 linear, -inf log10) takes the B==1 fast path.
+    """
+    if fit_flags[3] or fit_flags[4]:
+        return True
+    try:
+        tau0 = np.asarray(init_params)[..., 3]
+    except (TypeError, jax.errors.TracerArrayConversionError):
+        return True  # traced init: cannot prove tau == 0, keep the chain
+    if log10_tau:
+        return not np.all(np.isneginf(tau0))
+    return bool(np.any(tau0 != 0.0))
+
+
 @partial(jax.jit, static_argnames=("fit_flags", "log10_tau", "nbin",
-                                   "max_iter"))
+                                   "max_iter", "scat"))
 def _solve(init_params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
-           nu_tau, fit_flags, log10_tau, nbin, lo, hi, max_iter=50):
+           nu_tau, fit_flags, log10_tau, nbin, lo, hi, max_iter=50,
+           scat=None):
     """Bounded Levenberg-damped Newton minimization of the objective.
 
     Per-fit state advances in lockstep under vmap; convergence is
@@ -487,7 +509,8 @@ def _solve(init_params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
     eye = jnp.eye(5, dtype=flags.dtype)
     unfit = eye * (1.0 - flags)[None, :]
 
-    scat = bool(fit_flags[3] or fit_flags[4])
+    if scat is None:
+        scat = bool(fit_flags[3] or fit_flags[4])
 
     def fgH(x):
         return portrait_grad_hess(x, cross, abs_m2, inv_err2, freqs, P,
@@ -545,7 +568,7 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
                       nu_outs=(None, None, None), errs=None, weights=None,
                       fit_flags=(1, 1, 1, 1, 1), bounds=None,
                       log10_tau=True, option=0, max_iter=50, is_toa=True,
-                      quiet=True):
+                      quiet=True, scat=None):
     """Fit (phi, DM, GM, tau, alpha) between one data and model portrait.
 
     Behavioral equivalent of /root/reference/pptoaslib.py:928-1096,
@@ -566,12 +589,16 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
     nbin = data_port.shape[-1]
     nchan = freqs.shape[0]
     flags = tuple(int(bool(fl)) for fl in fit_flags)
+    if scat is None:
+        scat = _scat_hint(flags, init_params, log10_tau)
     ifit = np.flatnonzero(np.asarray(flags))
     nfit = len(ifit)
     dof = data_port.size - (nfit + nchan)
 
-    dFFT = jnp.fft.rfft(data_port, axis=-1).at[..., 0].multiply(F0_fact)
-    mFFT = jnp.fft.rfft(model_port, axis=-1).at[..., 0].multiply(F0_fact)
+    dFFT = jnp.fft.rfft(as_fft_operand(data_port),
+                        axis=-1).at[..., 0].multiply(F0_fact)
+    mFFT = jnp.fft.rfft(as_fft_operand(model_port),
+                        axis=-1).at[..., 0].multiply(F0_fact)
     if errs is None:
         errs_FT = get_noise(data_port) * jnp.sqrt(nbin / 2.0)
     else:
@@ -603,7 +630,7 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
     sol = _solve(jnp.asarray(init_params, dtype=jnp.float64), cross,
                  abs_m2, inv_err2, freqs, P, nu_fit_DM, nu_fit_GM,
                  nu_fit_tau, flags, log10_tau, nbin, lo, hi,
-                 max_iter=max_iter)
+                 max_iter=max_iter, scat=scat)
     params_fit = sol["x"]
     phi_fit, DM_fit, GM_fit, tau_fit, alpha_fit = [params_fit[i]
                                                    for i in range(5)]
@@ -613,7 +640,7 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
     if not all(nu is not None for nu in nu_outs):
         nz = get_nu_zeros(params_fit, cross, abs_m2, inv_err2, freqs, P,
                           nu_fit_DM, nu_fit_GM, nu_fit_tau, flags,
-                          log10_tau, nbin, option=option)
+                          log10_tau, nbin, option=option, scat=scat)
         if nu_out_DM is None:
             nu_out_DM = nz[0]
         if nu_out_GM is None:
@@ -645,7 +672,7 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
     # Hessian + covariance + scales at the output references.
     H5, cross_hess, S, C, scales, ok = _hess_with_scales(
         params_out, cross, abs_m2, inv_err2, freqs, P, nu_out_DM,
-        nu_out_GM, nu_out_tau, flags, log10_tau, nbin)
+        nu_out_GM, nu_out_tau, flags, log10_tau, nbin, scat=scat)
     cov_fit, scale_errs = _covariance_with_scales(H5, cross_hess, S,
                                                   jnp.asarray(ifit), ok)
     # negative variances (non-PD covariance from a failed fit) surface as
@@ -675,10 +702,10 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
 
 
 @partial(jax.jit, static_argnames=("fit_flags", "bounds", "log10_tau",
-                                   "max_iter", "nu_outs_mask"))
+                                   "max_iter", "nu_outs_mask", "scat"))
 def _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b, errs_b,
                 weights_b, nu_fits_b, nu_outs_b, nu_outs_mask, fit_flags,
-                bounds, log10_tau, max_iter):
+                bounds, log10_tau, max_iter, scat):
     def one(d, m, x0, p, fq, er, w, nf, no):
         wok = (w > 0.0).astype(fq.dtype)
         fq_mean = (fq * wok).sum() / jnp.maximum(wok.sum(), 1.0)
@@ -689,7 +716,8 @@ def _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b, errs_b,
         return fit_portrait_full(d, m, x0, p, fq, errs=er, weights=w,
                                  fit_flags=fit_flags, nu_fits=nu_fits,
                                  nu_outs=nu_outs, bounds=bounds,
-                                 log10_tau=log10_tau, max_iter=max_iter)
+                                 log10_tau=log10_tau, max_iter=max_iter,
+                                 scat=scat)
 
     return jax.vmap(one)(data_ports, model_ports, init_b, Ps_b, freqs_b,
                          errs_b, weights_b, nu_fits_b, nu_outs_b)
@@ -743,6 +771,9 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
         nu_fits_b = jnp.broadcast_to(jnp.asarray(nu_fits, dtype=jnp.float64),
                                      (B, 3))
     flags_t = tuple(int(bool(fl)) for fl in fit_flags)
+    # static scattering hint from the *concrete* batch inits (under vmap
+    # the per-fit init is traced and could not prove tau == 0)
+    scat = _scat_hint(flags_t, init_params, log10_tau)
     # nu_outs: None entries -> zero-covariance defaults (mask False);
     # scalar or [B]-array entries are per-batch output references
     if nu_outs is None:
@@ -761,7 +792,7 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
     return _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b,
                        errs_b, weights_b, nu_fits_b, nu_outs_b,
                        nu_outs_mask, flags_t, bounds_t, bool(log10_tau),
-                       int(max_iter))
+                       int(max_iter), scat)
 
 
 def get_scales_full(params, data_port, model_port, P, freqs, nu_DM, nu_GM,
@@ -772,8 +803,9 @@ def get_scales_full(params, data_port, model_port, P, freqs, nu_DM, nu_GM,
     """
     data_port = jnp.asarray(data_port)
     nbin = data_port.shape[-1]
-    dFFT = jnp.fft.rfft(data_port, axis=-1).at[..., 0].multiply(F0_fact)
-    mFFT = jnp.fft.rfft(jnp.asarray(model_port),
+    dFFT = jnp.fft.rfft(as_fft_operand(data_port),
+                        axis=-1).at[..., 0].multiply(F0_fact)
+    mFFT = jnp.fft.rfft(as_fft_operand(model_port),
                         axis=-1).at[..., 0].multiply(F0_fact)
     cross = dFFT * jnp.conj(mFFT)
     abs_m2 = jnp.abs(mFFT) ** 2

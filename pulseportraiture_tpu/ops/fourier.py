@@ -26,7 +26,7 @@ Design notes (TPU-first, not a translation):
 import jax
 import jax.numpy as jnp
 
-from ..config import Dconst, F0_fact
+from ..config import Dconst, F0_fact, as_fft_operand, fft_real_dtype
 
 __all__ = [
     "nharm_for",
@@ -57,7 +57,7 @@ def rfft_portrait(port, zap_f0=True):
     is excluded from Fourier fits; reference pplib.py:64-66 and
     pptoaslib.py:976-979).
     """
-    port_FT = jnp.fft.rfft(port, axis=-1)
+    port_FT = jnp.fft.rfft(as_fft_operand(port), axis=-1)
     if zap_f0:
         port_FT = port_FT.at[..., 0].multiply(F0_fact)
     return port_FT
@@ -125,7 +125,10 @@ def phasor(shifts, nharm, sign=+1.0, dtype=None):
     frac = (shifts[..., None] * k) % 1.0
     if dtype is not None:
         real_dtype = jnp.finfo(dtype).dtype
-        frac = frac.astype(real_dtype)
+    else:
+        real_dtype = jnp.float64
+    # clamp so the complex result compiles on the backend (c64 on TPU)
+    frac = frac.astype(fft_real_dtype(real_dtype))
     ang = (2.0 * jnp.pi * sign) * frac
     return jax.lax.complex(jnp.cos(ang), jnp.sin(ang))
 
@@ -150,7 +153,8 @@ def rotate_portrait_full(port, phi, DM, GM, freqs, nu_DM=jnp.inf,
     """
     if P is None:
         P = 1.0
-    port_FT = jnp.fft.rfft(port, axis=-1)
+    port = jnp.asarray(port)
+    port_FT = jnp.fft.rfft(as_fft_operand(port), axis=-1)
     shifts = phase_shifts(phi, DM, GM, freqs, nu_DM, nu_GM, P, mod=False)
     return jnp.fft.irfft(apply_phasor(port_FT, shifts), n=port.shape[-1],
                          axis=-1)
@@ -183,7 +187,7 @@ def rotate_data(data, phase=0.0, DM=0.0, Ps=None, freqs=None,
         D = Dconst * DM / P
         shifts = phase + D * (freqs ** -2 - nu_ref ** -2)
         shifts = jnp.broadcast_to(shifts, data.shape[:-1])
-    data_FT = jnp.fft.rfft(data, axis=-1)
+    data_FT = jnp.fft.rfft(as_fft_operand(data), axis=-1)
     return jnp.fft.irfft(apply_phasor(data_FT, shifts), n=data.shape[-1],
                          axis=-1)
 
@@ -194,8 +198,9 @@ def rotate_profile(profile, phase=0.0):
     Equivalent of /root/reference/pplib.py:2548-2559.
     """
     profile = jnp.asarray(profile)
-    prof_FT = jnp.fft.rfft(profile)
-    prof_FT = prof_FT * phasor(jnp.asarray(phase), prof_FT.shape[-1])[..., :]
+    prof_FT = jnp.fft.rfft(as_fft_operand(profile))
+    prof_FT = prof_FT * phasor(jnp.asarray(phase), prof_FT.shape[-1],
+                               dtype=prof_FT.dtype)[..., :]
     return jnp.fft.irfft(prof_FT, n=profile.shape[-1])
 
 
@@ -233,7 +238,7 @@ def add_DM_nu(port, phase=0.0, DM=None, P=None, freqs=None, xs=(-2.0,),
             coefs[:, None] * (freqs[None, :] ** exps[:, None]
                               - nu_ref ** exps[:, None]), axis=0)
         shifts = phase + (Dconst * DM / P) * freq_term
-    port_FT = jnp.fft.rfft(port, axis=-1)
+    port_FT = jnp.fft.rfft(as_fft_operand(port), axis=-1)
     return jnp.fft.irfft(apply_phasor(port_FT, shifts), n=port.shape[-1],
                          axis=-1)
 

@@ -6,6 +6,7 @@ TPU-native equivalent of /root/reference/pptoaslib.py:112-179
 
 import jax.numpy as jnp
 
+from ..config import complex_dtype_for, fft_real_dtype
 from .profiles import gaussian_profile_FT
 
 __all__ = ["instrumental_response_FT", "instrumental_response_port_FT"]
@@ -19,9 +20,9 @@ def instrumental_response_FT(nbin, wid=0.0, irf_type="rect"):
     /root/reference/pptoaslib.py:112-143.
     """
     nharm = nbin // 2 + 1
-    k = jnp.arange(nharm)
+    k = jnp.arange(nharm, dtype=fft_real_dtype(jnp.float64))
     if irf_type == "rect":
-        resp = jnp.sinc(k * wid)
+        resp = jnp.sinc(k * jnp.asarray(wid, k.dtype))
     elif irf_type == "gauss":
         gp_FT = gaussian_profile_FT(nbin, 0.0, wid, 1.0)
         resp = gp_FT / gp_FT[0]
@@ -44,12 +45,14 @@ def instrumental_response_port_FT(nbin, freqs, DM=0.0, P=1.0, wids=(),
     nchan = freqs.shape[0]
     nharm = nbin // 2 + 1
     out = jnp.ones([nchan, nharm],
-                   dtype=jnp.result_type(freqs.dtype, jnp.complex64))
+                   dtype=complex_dtype_for(fft_real_dtype(freqs.dtype)))
     for wid, irf_type in zip(wids, irf_types):
         out = out * instrumental_response_FT(nbin, wid, irf_type)[None, :]
     if DM:
         chan_bw = jnp.abs(freqs[1] - freqs[0])
         smear_wids = 8.3e-6 * chan_bw / (freqs / 1e3) ** 3 / P  # [nchan]
-        k = jnp.arange(nharm)
-        out = out * jnp.sinc(k[None, :] * smear_wids[:, None])
+        fft_dt = fft_real_dtype(jnp.float64)
+        k = jnp.arange(nharm, dtype=fft_dt)
+        out = out * jnp.sinc(k[None, :]
+                             * smear_wids.astype(fft_dt)[:, None])
     return out

@@ -16,6 +16,8 @@ single reduction instead of the reference's host-side ``opt.brute``.
 import jax
 import jax.numpy as jnp
 
+from ..config import as_fft_operand
+
 __all__ = ["get_noise", "get_noise_PS", "get_noise_fit", "get_SNR",
            "find_kc", "half_triangle_function"]
 
@@ -41,7 +43,7 @@ def get_noise_PS(data, frac=4):
     """
     data = jnp.asarray(data)
     nbin = data.shape[-1]
-    FFT = jnp.fft.rfft(data, axis=-1)
+    FFT = jnp.fft.rfft(as_fft_operand(data), axis=-1)
     pows = jnp.real(FFT * jnp.conj(FFT)) / nbin
     npow = pows.shape[-1]
     kc = int((1 - 1.0 / frac) * npow)
@@ -109,7 +111,7 @@ def get_noise_fit(data, fact=1.1, fn="exp_dc"):
     """
     data = jnp.asarray(data)
     nbin = data.shape[-1]
-    FFT = jnp.fft.rfft(data, axis=-1)
+    FFT = jnp.fft.rfft(as_fft_operand(data), axis=-1)
     pows = jnp.real(FFT * jnp.conj(FFT)) / nbin
     npow = pows.shape[-1]
 

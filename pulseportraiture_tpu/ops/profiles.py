@@ -15,8 +15,10 @@ model fitter.
 import jax
 import jax.numpy as jnp
 
+from ..config import as_fft_operand, fft_real_dtype
 from .fourier import get_bin_centers
-from .scattering import scattering_portrait_FT, scattering_times
+from .scattering import (scattering_portrait_FT, scattering_profile_FT,
+                         scattering_times)
 
 __all__ = [
     "FWHM_FACT",
@@ -93,10 +95,9 @@ def gen_gaussian_profile(params, nbin):
     profs = jnp.stack([gaussian_profile(nbin, loc, wid) * amp
                        for loc, wid, amp in comps])
     model = dc + profs.sum(axis=0)
-    k = jnp.arange(nbin // 2 + 1, dtype=params.dtype)
-    x = 2.0 * jnp.pi * k * (tau / nbin)
-    sp_FT = jax.lax.complex(1.0 / (1.0 + x * x), -x / (1.0 + x * x))
-    scattered = jnp.fft.irfft(sp_FT * jnp.fft.rfft(model), n=nbin)
+    sp_FT = scattering_profile_FT(tau / nbin, nbin)
+    scattered = jnp.fft.irfft(sp_FT * jnp.fft.rfft(as_fft_operand(model)),
+                              n=nbin)
     return jnp.where(tau != 0.0, scattered, model)
 
 
@@ -183,10 +184,11 @@ def gen_gaussian_portrait(model_code, params, scattering_index, phases,
     gport = dc + jnp.sum(amps[..., None] * comps_prof, axis=1)
 
     taus = scattering_times(tau / nbin, scattering_index, freqs,
-                            nu_ref).astype(params.dtype)
+                            nu_ref).astype(fft_real_dtype(params.dtype))
     sp_FT = scattering_portrait_FT(taus, nbin)
-    scattered = jnp.fft.irfft(sp_FT * jnp.fft.rfft(gport, axis=-1), n=nbin,
-                              axis=-1)
+    scattered = jnp.fft.irfft(sp_FT * jnp.fft.rfft(as_fft_operand(gport),
+                                                   axis=-1),
+                              n=nbin, axis=-1)
     gport = jnp.where(tau != 0.0, scattered, gport)
 
     if njoin:
@@ -213,7 +215,7 @@ def gaussian_profile_FT(nbin, loc, wid, amp):
     bin-center sampling to the reference's t=0-anchored continuous-FT
     convention.
     """
-    prof = amp * gaussian_profile(nbin, loc, wid, norm=False)
+    prof = as_fft_operand(amp * gaussian_profile(nbin, loc, wid, norm=False))
     k = jnp.arange(nbin // 2 + 1, dtype=prof.dtype)
     ang = jnp.pi * k / nbin
     return jnp.fft.rfft(prof) * jax.lax.complex(jnp.cos(ang),
@@ -230,4 +232,4 @@ def gaussian_portrait_FT(model_code, params, scattering_index, nbin, freqs,
     phases = get_bin_centers(nbin)
     port = gen_gaussian_portrait(model_code, params, scattering_index,
                                  phases, freqs, nu_ref)
-    return jnp.fft.rfft(port, axis=-1)
+    return jnp.fft.rfft(as_fft_operand(port), axis=-1)

@@ -18,6 +18,8 @@ branches on tau, unlike the reference's ``if taus.sum()`` host branches).
 import jax
 import jax.numpy as jnp
 
+from ..config import as_fft_operand, fft_real_dtype
+
 __all__ = [
     "scattering_times",
     "scattering_times_deriv",
@@ -84,9 +86,10 @@ def scattering_profile_FT(tau, nbin):
     Equivalent of /root/reference/pplib.py:4061-4084.
     """
     nharm = nbin // 2 + 1
-    k = jnp.arange(nharm, dtype=jnp.asarray(tau).dtype)
+    tau = as_fft_operand(tau)
+    k = jnp.arange(nharm, dtype=tau.dtype)
     # 1/(1+ix) = (1-ix)/(1+x^2), expressed in real ops + lax.complex so
-    # no complex128 scalar constants reach the device (TPU-safe)
+    # no complex128 reaches a backend that lacks it (TPU-safe)
     x = 2.0 * jnp.pi * k * tau
     denom = 1.0 + x * x
     return jax.lax.complex(1.0 / denom, -x / denom)
@@ -98,7 +101,7 @@ def scattering_portrait_FT(taus, nbin):
     Equivalent of /root/reference/pplib.py:4086-4101 without the host-side
     ``np.any(taus)`` branch (tau=0 channels already yield ones).
     """
-    taus = jnp.asarray(taus)
+    taus = as_fft_operand(taus)
     nharm = nbin // 2 + 1
     k = jnp.arange(nharm, dtype=taus.dtype)
     x = 2.0 * jnp.pi * k * taus[..., None]
@@ -114,7 +117,7 @@ def scattering_portrait_FT_deriv(taus, taus_deriv, scat_port_FT):
     /root/reference/pptoaslib.py:318-330.
     """
     nharm = scat_port_FT.shape[-1]
-    k = jnp.arange(nharm, dtype=jnp.asarray(taus).dtype)
+    k = jnp.arange(nharm, dtype=fft_real_dtype(jnp.asarray(taus).dtype))
     # -2*pi*i*k as a same-dtype complex array (no weak c128 scalars)
     mjk = jax.lax.complex(jnp.zeros_like(k), -2.0 * jnp.pi * k)
     dB_dtaus = mjk * scat_port_FT ** 2
@@ -133,7 +136,7 @@ def scattering_portrait_FT_2deriv(taus, taus_deriv, taus_2deriv,
     /root/reference/pptoaslib.py:332-356.
     """
     nharm = scat_port_FT.shape[-1]
-    k = jnp.arange(nharm, dtype=jnp.asarray(taus).dtype)
+    k = jnp.arange(nharm, dtype=fft_real_dtype(jnp.asarray(taus).dtype))
     u = jax.lax.complex(jnp.zeros_like(k), -2.0 * jnp.pi * k)
     B = scat_port_FT
     dB = u * B ** 2
@@ -199,8 +202,8 @@ def add_scattering(port, kernel, repeat=3):
     tiled_d = jnp.tile(port2, (1, repeat))
     tiled_k = jnp.tile(kernel2, (1, repeat))
     tiled_k = tiled_k / tiled_k.sum(axis=-1, keepdims=True)
-    conv = jnp.fft.irfft(jnp.fft.rfft(tiled_d, axis=-1)
-                         * jnp.fft.rfft(tiled_k, axis=-1),
+    conv = jnp.fft.irfft(jnp.fft.rfft(as_fft_operand(tiled_d), axis=-1)
+                         * jnp.fft.rfft(as_fft_operand(tiled_k), axis=-1),
                          n=repeat * nbin, axis=-1)
     out = conv[..., mid * nbin:(mid + 1) * nbin]
     return out[0] if squeeze else out

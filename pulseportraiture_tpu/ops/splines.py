@@ -11,6 +11,8 @@ model generation inside fit loops runs on device.
 import jax.numpy as jnp
 import numpy as np
 
+from ..config import as_fft_operand
+
 __all__ = ["splev", "gen_spline_portrait", "fft_resample"]
 
 
@@ -64,7 +66,7 @@ def fft_resample(port, nbin):
     semantics for real input)."""
     port = jnp.asarray(port)
     n = port.shape[-1]
-    X = jnp.fft.rfft(port, axis=-1)
+    X = jnp.fft.rfft(as_fft_operand(port), axis=-1)
     nh_out = nbin // 2 + 1
     if nbin < n:
         Xr = X[..., :nh_out]

@@ -6,7 +6,7 @@ subintegrations are phase/DM-fit against the running template *in one
 batched device call* and accumulated with scales/noise weighting; the
 weighted average becomes the next iteration's template.  The subprocess
 wrappers around PSRCHIVE's psradd/psrsmooth are replaced with native
-equivalents (average_archives, and models.wavelet smoothing).
+equivalents (average_archives; ops.wavelet smoothing for psrsmooth -W).
 """
 
 import numpy as np
@@ -197,7 +197,7 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
         nz = total_weights > 0
         for ipol in range(npol):
             aligned_port[ipol][nz] /= total_weights[nz]
-        model_port = aligned_port[0]
+        model_port = aligned_port[0].copy()
 
     if norm in ("mean", "max", "prof", "rms", "abs"):
         for ipol in range(npol):

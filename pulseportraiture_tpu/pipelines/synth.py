@@ -11,7 +11,7 @@ fixtures are reproducible and jit-able.
 import jax
 import jax.numpy as jnp
 
-from ..config import Dconst, scattering_alpha
+from ..config import Dconst, as_fft_operand, scattering_alpha
 from ..ops.fourier import get_bin_centers, rotate_data
 from ..ops.profiles import gen_gaussian_portrait
 from ..ops.scattering import scattering_portrait_FT, scattering_times
@@ -79,8 +79,9 @@ def make_fake_portrait(model_params, nchan, nbin, freqs, P, *,
     if t_scat:
         taus = scattering_times(t_scat / P, scattering_index, freqs, nu_ref)
         sp_FT = scattering_portrait_FT(taus, nbin)
-        port = jnp.fft.irfft(sp_FT * jnp.fft.rfft(port, axis=-1), n=nbin,
-                             axis=-1)
+        port = jnp.fft.irfft(sp_FT * jnp.fft.rfft(as_fft_operand(port),
+                                                  axis=-1),
+                             n=nbin, axis=-1)
     if scint is not False:
         if scint is True:
             key, kscint = jax.random.split(key)

@@ -66,3 +66,18 @@ def test_align_archives_sharpens(setup, tmp_path):
         naive.max() / np.abs(naive).mean()
     # aligned portrait should look like the injected model: high S/N
     assert d.prof_SNR > 50
+
+
+def test_align_archives_niter3_nonzero(setup, tmp_path):
+    # regression: iteration >=2 used to fit against a zeroed template
+    # (aliasing through a numpy view), collapsing all weights to 0
+    tmp, files, gmodel = setup
+    init = str(tmp_path / "init3.fits")
+    average_archives(files, init, palign=True)
+    out = str(tmp_path / "aligned3.fits")
+    _, aligned, weights = align_archives(
+        files, init, fit_dm=True, niter=3, outfile=out, quiet=True)
+    assert weights.sum() > 0
+    assert np.abs(aligned).max() > 0
+    prof = aligned[0].mean(axis=0)
+    assert prof.max() / np.abs(prof).mean() > 3

@@ -1,0 +1,100 @@
+"""Device dtype-discipline tests.
+
+TPUs cannot compile complex128; the framework's contract (config.
+fft_real_dtype) is that float64 *data* entering any rfft/lax.complex
+boundary is clamped to float32 on such backends while solver state stays
+float64.  CI runs on CPU, so these tests force the clamp by monkeypatching
+``backend_supports_complex128`` and then assert (a) no complex128 appears
+anywhere in the jaxpr of the core device paths, and (b) the clamped
+results still agree with the full-f64 path to well below the noise floor.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import pulseportraiture_tpu.config as config
+from pulseportraiture_tpu.fit.portrait import fit_portrait_full
+from pulseportraiture_tpu.ops.fourier import get_bin_centers, rotate_data
+from pulseportraiture_tpu.ops.profiles import gen_gaussian_portrait
+from pulseportraiture_tpu.ops.scattering import scattering_portrait_FT
+
+
+@pytest.fixture
+def no_c128(monkeypatch):
+    """Pretend the backend lacks complex128 (as TPU does)."""
+    monkeypatch.setattr(config, "backend_supports_complex128", lambda: False)
+    yield
+
+
+def _assert_no_c128(fn, *args):
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    for eqn in jaxpr.jaxpr.eqns:
+        for v in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(v, "aval", None)
+            if aval is not None and hasattr(aval, "dtype"):
+                assert aval.dtype != jnp.complex128, (
+                    f"complex128 in jaxpr eqn {eqn.primitive}")
+
+
+def test_rotate_data_no_c128_and_parity(no_c128):
+    rng = np.random.default_rng(0)
+    port = rng.normal(size=(16, 256))
+    freqs = np.linspace(1300.0, 1700.0, 16)
+
+    def f(p):
+        return rotate_data(p, 0.123, 0.5e-3, 1.0e-3, freqs, 1500.0)
+
+    _assert_no_c128(f, port)
+    clamped = np.asarray(f(port))
+    full = np.asarray(rotate_data(port.astype(np.float64), 0.123, 0.5e-3,
+                                  1.0e-3, freqs, 1500.0))
+    # f32 FFT of O(1) data: expect ~1e-6 absolute agreement
+    assert np.max(np.abs(clamped - full)) < 1e-4
+
+
+def test_gen_gaussian_portrait_no_c128(no_c128):
+    params = jnp.asarray([0.05, 1.5, 0.35, -0.05, 0.05, 0.1, 1.0, -1.2])
+    freqs = jnp.linspace(1300.0, 1700.0, 16)
+    phases = get_bin_centers(128)
+
+    def f(p):
+        return gen_gaussian_portrait("000", p, -4.0, phases, freqs, 1500.0)
+
+    _assert_no_c128(f, params)
+    out = np.asarray(f(params))
+    assert np.isfinite(out).all() and out.max() > 0.1
+
+
+def test_scattering_FT_no_c128(no_c128):
+    taus = jnp.full(8, 1e-3, dtype=jnp.float64)
+
+    def f(t):
+        return scattering_portrait_FT(t, 256)
+
+    _assert_no_c128(f, taus)
+    assert f(taus).dtype == jnp.complex64
+
+
+def test_fit_portrait_full_clamped_parity(no_c128):
+    # phase+DM fit on clean synthetic data: the clamped (TPU-style) path
+    # must recover the same (phi, DM) as full f64 to ~1e-7 rot
+    rng = np.random.default_rng(7)
+    nchan, nbin = 32, 512
+    freqs = np.linspace(1300.0, 1700.0, nchan)
+    phases = get_bin_centers(nbin)
+    params = jnp.asarray([0.0, 0.0, 0.4, -0.02, 0.04, 0.05, 1.0, -1.0])
+    model = np.asarray(gen_gaussian_portrait("000", params, -4.0, phases,
+                                             freqs, 1500.0))
+    P = 3.0e-3
+    phi_true, dDM_true = 0.123, 4.0e-4
+    data = np.asarray(rotate_data(model, -phi_true, -dDM_true, P, freqs,
+                                  1500.0))
+    data = data + rng.normal(0, 1e-3, data.shape)
+    r = fit_portrait_full(data, model, [0.1, 0.0, 0.0, 0.0, 0.0], P, freqs,
+                          nu_fits=(1500.0, None, None),
+                          nu_outs=(1500.0, None, None), errs=1e-3,
+                          fit_flags=(1, 1, 0, 0, 0), log10_tau=False)
+    assert abs(float(r.phi) - phi_true) < 1e-5
+    assert abs(float(r.DM) - dDM_true) < 1e-5
