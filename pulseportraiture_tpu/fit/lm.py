@@ -1,0 +1,122 @@
+"""Generic bounded Levenberg-Marquardt least squares in JAX.
+
+The reference relies on lmfit's ``leastsq`` (MINPACK) for its model
+builders (fit_powlaw /root/reference/pplib.py:1763-1802,
+fit_gaussian_profile :1842-1922, fit_gaussian_portrait :1924-2052).
+lmfit is a host-side, per-problem C loop; here the same class of
+problems is solved by one jitted damped-normal-equations LM iteration in
+``lax.while_loop`` — vmappable over batches of problems, with parameter
+freezing by flag masks and bounds by projection, which is how the whole
+Gaussian-portrait fit stays on device.
+
+Error semantics follow lmfit's defaults: the parameter covariance is
+``inv(J^T J) * red_chi2`` (scale_covar=True) with J the err-weighted
+Jacobian at the solution, and stderr = sqrt(diag(cov)).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.databunch import DataBunch
+from .smallsolve import solve_refined
+
+__all__ = ["lm_solve"]
+
+
+def lm_solve(residual_fn, x0, fit_flags=None, bounds=None, max_iter=100,
+             ftol=1e-12, xtol=1e-12, args=()):
+    """Minimize ``sum(residual_fn(x, *args)**2)`` over x.
+
+    residual_fn: x [nparam] (+args) -> err-weighted residuals [N].
+    x0: initial parameters [nparam] (or [B, nparam] — batched problems
+    solve in lockstep under vmap).
+    fit_flags: optional 0/1 mask [nparam]; 0 freezes a parameter.
+    bounds: optional (lo [nparam], hi [nparam]) arrays (+-inf = free).
+    Returns DataBunch(params, param_errs, covar, chi2, red_chi2, nfev,
+    return_code, ndata).
+    """
+    x0 = jnp.asarray(x0, dtype=jnp.float64)
+    if x0.ndim == 2:
+        one = partial(lm_solve, residual_fn, fit_flags=fit_flags,
+                      bounds=bounds, max_iter=max_iter, ftol=ftol,
+                      xtol=xtol, args=args)
+        return jax.vmap(lambda x: one(x))(x0)
+
+    nparam = x0.shape[0]
+    flags = jnp.ones(nparam) if fit_flags is None else \
+        jnp.asarray(fit_flags, dtype=jnp.float64)
+    if bounds is None:
+        lo = jnp.full(nparam, -jnp.inf)
+        hi = jnp.full(nparam, jnp.inf)
+    else:
+        lo = jnp.asarray(bounds[0], dtype=jnp.float64)
+        hi = jnp.asarray(bounds[1], dtype=jnp.float64)
+
+    def res(x):
+        return jnp.asarray(residual_fn(x, *args), dtype=jnp.float64)
+
+    jac = jax.jacfwd(res)
+    unfit = jnp.eye(nparam) * (1.0 - flags)
+
+    r0 = res(x0)
+    ndata = r0.shape[0]
+    f0 = jnp.sum(r0 * r0)
+
+    def normal_step(x, f, mu):
+        J = jac(x) * flags[None, :]
+        r = res(x)
+        g = J.T @ r
+        JtJ = J.T @ J
+        scale_d = jnp.maximum(jnp.abs(jnp.diagonal(JtJ)), 1e-30)
+        A = JtJ + mu * jnp.diag(scale_d) + unfit
+        step = -solve_refined(A, g)
+        trial = jnp.clip(x + step, lo, hi)
+        r_t = res(trial)
+        f_t = jnp.sum(r_t * r_t)
+        return trial, f_t
+
+    state = dict(x=x0, f=f0, mu=jnp.asarray(1e-3),
+                 done=jnp.asarray(False), it=jnp.asarray(0),
+                 nfev=jnp.asarray(1), rc=jnp.asarray(3))
+
+    def cond(s):
+        return (~s["done"]) & (s["it"] < max_iter)
+
+    def body(s):
+        trial, f_t = normal_step(s["x"], s["f"], s["mu"])
+        accept = f_t < s["f"]
+        mu = jnp.where(accept, jnp.maximum(s["mu"] * 0.3, 1e-14),
+                       s["mu"] * 5.0)
+        x_new = jnp.where(accept, trial, s["x"])
+        f_new = jnp.where(accept, f_t, s["f"])
+        df = jnp.abs(s["f"] - f_new)
+        dx = jnp.max(jnp.abs(x_new - s["x"]))
+        f_conv = accept & (df <= ftol * jnp.maximum(f_new, 1.0))
+        x_conv = accept & (dx <= xtol * jnp.maximum(
+            jnp.max(jnp.abs(x_new)), 1.0))
+        stuck = (~accept) & (mu > 1e12)
+        rc = jnp.where(f_conv, 1, jnp.where(x_conv, 2,
+                                            jnp.where(stuck, 4, s["rc"])))
+        return dict(x=x_new, f=f_new, mu=mu,
+                    done=f_conv | x_conv | stuck, it=s["it"] + 1,
+                    nfev=s["nfev"] + 2, rc=rc)
+
+    out = jax.lax.while_loop(cond, body, state)
+    x = out["x"]
+
+    # lmfit-style covariance at the solution: inv(J^T J) * red_chi2
+    J = jac(x) * flags[None, :]
+    JtJ = J.T @ J + unfit
+    nfit = jnp.sum(flags)
+    dof = jnp.maximum(ndata - nfit, 1.0)
+    chi2 = out["f"]
+    red_chi2 = chi2 / dof
+    cov = jnp.linalg.inv(JtJ) * red_chi2
+    # frozen params report zero uncertainty; negative diagonals (singular
+    # fits) surface as NaN
+    perr = jnp.sqrt(jnp.diagonal(cov)) * flags
+    return DataBunch(params=x, param_errs=perr, covar=cov, chi2=chi2,
+                     red_chi2=red_chi2, nfev=out["nfev"],
+                     return_code=out["rc"], ndata=ndata)

@@ -1,0 +1,98 @@
+"""Spectral fits: power-law flux fit and DM-from-residuals fit.
+
+TPU-native equivalents of /root/reference/pplib.py:1763-1840
+(``fit_powlaw`` via lmfit, ``fit_DM_to_freq_resids`` via np.polyfit) and
+the GM <-> DMc discrete-cloud conversions
+(/root/reference/pptoaslib.py:83-110).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Dconst
+from ..ops.powlaw import powlaw
+from ..utils.databunch import DataBunch
+from .lm import lm_solve
+
+__all__ = ["fit_powlaw", "fit_DM_to_freq_resids", "GM_from_DMc",
+           "DMc_from_GM"]
+
+
+def fit_powlaw(data, init_params, errs, freqs, nu_ref):
+    """Fit amp * (freqs/nu_ref)**alpha to data with uncertainties errs.
+
+    Returns DataBunch(amp, amp_err, alpha, alpha_err, residuals, nu_ref,
+    chi2, dof) matching the reference's lmfit result surface
+    (/root/reference/pplib.py:1763-1802); the minimizer is the in-repo
+    JAX Levenberg-Marquardt.
+    """
+    data = jnp.asarray(data, dtype=jnp.float64)
+    errs = jnp.broadcast_to(jnp.asarray(errs, dtype=jnp.float64),
+                            data.shape)
+    freqs = jnp.asarray(freqs, dtype=jnp.float64)
+
+    def residual(x):
+        return (data - powlaw(freqs, nu_ref, x[0], x[1])) / errs
+
+    r = lm_solve(residual, jnp.asarray(init_params, dtype=jnp.float64))
+    residuals = np.asarray(residual(r.params)) * np.asarray(errs)
+    return DataBunch(amp=float(r.params[0]), amp_err=float(r.param_errs[0]),
+                     alpha=float(r.params[1]),
+                     alpha_err=float(r.param_errs[1]),
+                     residuals=residuals, nu_ref=nu_ref,
+                     chi2=float(r.chi2), dof=int(np.asarray(r.ndata)) - 2,
+                     red_chi2=float(r.chi2) / max(
+                         int(np.asarray(r.ndata)) - 2, 1))
+
+
+def fit_DM_to_freq_resids(freqs, frequency_residuals, errs):
+    """Weighted linear fit res = Dconst*DM*nu**-2 + offset; also returns
+    the implied zero-crossing frequency nu_ref = (-b/a)**-0.5.
+
+    Equivalent of /root/reference/pplib.py:1804-1840 (np.polyfit with
+    cov=True semantics: the covariance is scaled by red_chi2).
+    """
+    freqs = np.asarray(freqs, dtype=np.float64)
+    y = np.asarray(frequency_residuals, dtype=np.float64)
+    errs = np.asarray(errs, dtype=np.float64)
+    x = freqs ** -2
+    p, V = np.polyfit(x=x, y=y, deg=1, w=errs ** -2, cov=True)
+    a, b = p
+    DM = a / Dconst
+    nu_ref = (-b / a) ** -0.5 if -b / a > 0 else np.nan
+    a_err, b_err = np.sqrt(np.diag(V))
+    cov = V.ravel()[1]
+    nu_ref_err = np.sqrt(np.abs(
+        (nu_ref ** 2 / 4.0) * ((a_err / a) ** 2 + (b_err / b) ** 2
+                               - 2 * cov / (a * b)))) \
+        if np.isfinite(nu_ref) else np.nan
+    residuals = y - (a * x + b)
+    chi2 = float(np.sum((residuals / errs) ** 2))
+    dof = len(y) - 2
+    return DataBunch(DM=DM, DM_err=a_err / Dconst, offset=b,
+                     offset_err=b_err, nu_ref=nu_ref,
+                     nu_ref_err=nu_ref_err, ab_cov=cov,
+                     residuals=residuals, chi2=chi2, dof=dof,
+                     red_chi2=chi2 / max(dof, 1))
+
+
+# speed of light in [cm/s] over [cm/kpc]: kpc -> light-travel conversion
+_C_KPC = 3e10 / 3.1e21
+
+
+def GM_from_DMc(DMc, D, a_perp):
+    """Geometric delay factor GM of a discrete cloud of dispersion
+    measure DMc [cm**-3 pc] at distance D [kpc] with transverse scale
+    a_perp [AU] (Lam et al. 2016).  Equivalent of
+    /root/reference/pptoaslib.py:83-96.
+    """
+    return DMc ** 2 * (_C_KPC * D) / (2.0 * (a_perp * 4.8e-9) ** 2)
+
+
+def DMc_from_GM(GM, D, a_perp):
+    """Inverse of GM_from_DMc (/root/reference/pptoaslib.py:98-110).
+
+    NB: the reference's expression does not square a_perp and therefore
+    does not invert its own GM_from_DMc; this is the exact inverse.
+    """
+    return (GM * 2.0 * (a_perp * 4.8e-9) ** 2 / (_C_KPC * D)) ** 0.5

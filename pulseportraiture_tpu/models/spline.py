@@ -1,0 +1,172 @@
+"""ppspline-equivalent model builder: PCA + B-spline profile evolution.
+
+TPU-native equivalent of the reference's primary modern modeling path
+(/root/reference/ppspline.py:34-274 ``make_spline_model``/
+``write_model``): the portrait is decomposed into a weighted-mean
+profile plus principal components (device ``eigh``), significant
+eigenvectors are selected by smoothed Fourier S/N (batched wavelet
+search, ops.pca/ops.wavelet), the per-channel projections are fit with a
+parametric B-spline over frequency (host FITPACK ``splprep`` — runs once
+per model), and the model is stored in the npz spline container that the
+TOA pipeline evaluates on device with the de Boor kernel (ops.splines).
+"""
+
+import numpy as np
+import scipy.interpolate as si
+
+from ..dataportrait import DataPortrait
+from ..io.splmodel import write_spline_model
+from ..ops.pca import find_significant_eigvec, pca, reconstruct_portrait
+from ..ops.splines import gen_spline_portrait
+from ..ops.wavelet import smart_smooth
+from ..utils.databunch import DataBunch
+
+__all__ = ["make_spline_model", "SplineModelPortrait"]
+
+
+def make_spline_model(dp, max_ncomp=10, smooth=True, snr_cutoff=150.0,
+                      rchi2_tol=0.1, k=3, sfac=1.0, max_nbreak=None,
+                      model_name=None, quiet=True, **kwargs):
+    """Build a PCA/B-spline portrait model from a DataPortrait.
+
+    dp: a DataPortrait (or path to an archive/metafile, loaded here).
+    Behavioral equivalent of /root/reference/ppspline.py:34-204; returns
+    a DataBunch with (model_name, source, datafile, mean_prof, eigvec
+    [nbin, ncomp], tck, ieig, ncomp, eigval, proj_port, model, modelx,
+    fp, ier) and stores the same attributes on ``dp``.
+    Smoothing parameter: s = sfac * nprof * sum((SNR*sigma)**2)/sum(SNR)**2
+    (the reference's formula, ppspline.py:135-146).
+    """
+    if isinstance(dp, str):
+        dp = DataPortrait(dp, quiet=quiet)
+
+    port = dp.portx
+    pca_weights = dp.SNRsxs / np.sum(dp.SNRsxs)
+    mean_prof = (port * pca_weights[:, None]).sum(axis=0) / \
+        pca_weights.sum()
+    freqs = dp.freqsxs[0]
+    nu_lo, nu_hi = freqs.min(), freqs.max()
+    nbin = port.shape[1]
+    if nbin % 2 != 0:
+        if not quiet:
+            print("nbin = %d is odd; cannot wavelet-smooth." % nbin)
+        smooth = False
+
+    eigval, eigvec = (np.asarray(a) for a in
+                      pca(port, mean_prof, pca_weights))
+    return_max = 10 if max_ncomp is None else min(max_ncomp, 10)
+    if smooth:
+        ieig, smooth_eigvec = find_significant_eigvec(
+            eigvec, check_max=10, return_max=return_max,
+            snr_cutoff=snr_cutoff, return_smooth=True,
+            rchi2_tol=rchi2_tol, **kwargs)
+        smooth_mean_prof = np.asarray(smart_smooth(
+            mean_prof, rchi2_tol=rchi2_tol, fallback="raw"))
+        use_mean = smooth_mean_prof
+        use_eigvec = smooth_eigvec
+    else:
+        ieig = find_significant_eigvec(
+            eigvec, check_max=10, return_max=return_max,
+            snr_cutoff=snr_cutoff, return_smooth=False,
+            rchi2_tol=rchi2_tol, **kwargs)
+        smooth_mean_prof = smooth_eigvec = None
+        use_mean = mean_prof
+        use_eigvec = eigvec
+    ncomp = len(ieig)
+
+    nchan_all = dp.freqs.shape[-1]
+    if ncomp == 0:
+        # constant-profile model
+        proj_port = port[:, :0]
+        modelx = np.tile(use_mean, (len(freqs), 1))
+        model = np.tile(use_mean, (nchan_all, 1))
+        tck = [np.array([]), np.array([]).reshape(0, 0), 0]
+        u, fp, ier, msg = np.array([]), None, None, None
+    else:
+        delta_port = port - mean_prof
+        proj_port = delta_port @ use_eigvec[:, ieig]     # [nchanx, ncomp]
+        # FITPACK parametric spline of the projections over frequency
+        spl_weights = pca_weights
+        s = sfac * len(proj_port) * \
+            np.sum((dp.SNRsxs * dp.noise_stdsxs) ** 2) / \
+            np.sum(dp.SNRsxs) ** 2
+        flip = -1 if dp.bw < 0 else 1   # u must be increasing
+        (tck, u), fp, ier, msg = si.splprep(
+            proj_port[::flip].T, w=spl_weights[::flip], u=freqs[::flip],
+            ub=nu_lo, ue=nu_hi, k=min(k, len(freqs) - 1), task=0, s=s,
+            t=None, full_output=1, nest=None, per=0, quiet=int(quiet))
+        if max_nbreak is not None and \
+                len(np.unique(tck[0])) > max_nbreak:
+            max_nbreak = max(max_nbreak, 2)
+            if max_nbreak == 2:
+                s = np.inf
+            (tck, u), fp, ier, msg = si.splprep(
+                proj_port[::flip].T, w=spl_weights[::flip],
+                u=freqs[::flip], ub=nu_lo, ue=nu_hi,
+                k=min(k, len(freqs) - 1), task=0, s=s, t=None,
+                full_output=1, nest=max_nbreak + 2 * k, per=0,
+                quiet=int(quiet))
+        if ier is not None and ier > 1 and not quiet:
+            print("splprep trouble for %s:\n%s" % (dp.source, msg))
+        tck = [np.asarray(tck[0]), np.asarray(tck[1]), tck[2]]
+        modelx = np.asarray(gen_spline_portrait(
+            use_mean, freqs, use_eigvec[:, ieig], tck))
+        model = np.asarray(gen_spline_portrait(
+            use_mean, dp.freqs[0], use_eigvec[:, ieig], tck))
+
+    reconst_port = np.asarray(reconstruct_portrait(
+        port, mean_prof, use_eigvec[:, ieig])) if ncomp else modelx.copy()
+
+    if model_name is None:
+        model_name = str(dp.datafile) + ".spl"
+    # mirror the reference's attribute surface on the DataPortrait
+    dp.ieig, dp.ncomp = ieig, ncomp
+    dp.eigval, dp.eigvec = eigval, eigvec
+    dp.mean_prof = mean_prof
+    if smooth:
+        dp.smooth_mean_prof = smooth_mean_prof
+        dp.smooth_eigvec = smooth_eigvec
+    dp.proj_port, dp.reconst_port = proj_port, reconst_port
+    dp.tck, dp.u, dp.fp, dp.ier = tck, u, fp, ier
+    dp.model_name = model_name
+    dp.model, dp.modelx = model, modelx
+    dp.model_masked = model * dp.masks[0, 0]
+
+    if not quiet:
+        if ncomp:
+            print("B-spline model %s: %d components, %d breakpoints "
+                  "(k=%d)." % (model_name, ncomp,
+                               len(np.unique(tck[0])), tck[2]))
+        else:
+            print("B-spline model %s: 0 components (mean profile only)."
+                  % model_name)
+    return DataBunch(model_name=model_name, source=dp.source,
+                     datafile=str(dp.datafile), mean_prof=use_mean,
+                     eigvec=use_eigvec[:, ieig] if ncomp
+                     else np.zeros((nbin, 0)),
+                     tck=tck, ieig=ieig, ncomp=ncomp, eigval=eigval,
+                     proj_port=proj_port, model=model, modelx=modelx,
+                     fp=fp, ier=ier)
+
+
+def write_model(outfile, built, quiet=True):
+    """Write a built spline model (make_spline_model return) to the npz
+    container (reference ppspline.py:206-230 pickles instead)."""
+    write_spline_model(outfile, built.model_name, built.source,
+                       built.datafile, built.mean_prof, built.eigvec,
+                       built.tck, quiet=quiet)
+    return outfile
+
+
+class SplineModelPortrait(DataPortrait):
+    """DataPortrait with spline-modeling methods, mirroring the
+    reference's ppspline.DataPortrait subclass surface."""
+
+    def make_spline_model(self, **kwargs):
+        self.spline_model = make_spline_model(self, **kwargs)
+        return self.spline_model
+
+    def write_model(self, outfile, quiet=True):
+        if not hasattr(self, "spline_model"):
+            raise AttributeError("call make_spline_model first")
+        return write_model(outfile, self.spline_model, quiet=quiet)

@@ -1,0 +1,113 @@
+"""Weighted PCA and eigenprofile significance selection.
+
+TPU-native equivalent of /root/reference/pplib.py:1497-1619 (``pca``,
+``reconstruct_portrait``, ``find_significant_eigvec``).  The weighted
+covariance + symmetric eigensolve run on device (jnp.linalg.eigh maps to
+XLA's batched eigensolver); the significance scan reuses the batched
+wavelet ``smart_smooth`` so all candidate eigenvectors smooth in one
+device call instead of a per-vector host loop.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from .noise import get_noise
+from .stats import count_crossings
+from .wavelet import smart_smooth
+
+__all__ = ["pca", "reconstruct_portrait", "find_significant_eigvec"]
+
+
+def pca(port, mean_prof=None, weights=None):
+    """Principal components of port [nchan, nbin] (channels = samples).
+
+    Returns (eigval [nbin], eigvec [nbin, nbin]) sorted by decreasing
+    eigenvalue; eigenvectors are column vectors.  The covariance is the
+    unbiased weighted covariance (np.cov aweights semantics).
+    Equivalent of /root/reference/pplib.py:1497-1535.
+    """
+    port = jnp.asarray(port)
+    nmes = port.shape[0]
+    if weights is None:
+        weights = jnp.ones(nmes, dtype=port.dtype)
+    else:
+        weights = jnp.asarray(weights, dtype=port.dtype)
+    if mean_prof is None:
+        mean_prof = (port * weights[:, None]).sum(axis=0) / weights.sum()
+    delta = port - mean_prof
+    # np.cov(delta.T, aweights=w, ddof=1): weighted mean removed, then
+    # normalization sum(w) - sum(w^2)/sum(w)
+    w = weights
+    wsum = w.sum()
+    dmean = (delta * w[:, None]).sum(axis=0) / wsum
+    d = delta - dmean
+    cov = jnp.einsum("i,ij,ik->jk", w, d, d) / (wsum - (w ** 2).sum() / wsum)
+    eigval, eigvec = jnp.linalg.eigh(cov)
+    return eigval[::-1], eigvec[:, ::-1]
+
+
+def reconstruct_portrait(port, mean_prof, eigvec):
+    """Project port onto the eigvec basis and reconstruct.
+
+    Equivalent of /root/reference/pplib.py:1536-1553.
+    """
+    port = jnp.asarray(port)
+    mean_prof = jnp.asarray(mean_prof)
+    eigvec = jnp.asarray(eigvec)
+    delta = port - mean_prof
+    return (delta @ eigvec) @ eigvec.T + mean_prof
+
+
+def find_significant_eigvec(eigvec, check_max=10, return_max=10,
+                            snr_cutoff=150.0, check_crossings=True,
+                            check_acorr=True, return_smooth=True,
+                            **kwargs):
+    """Indices of "significant" eigenvectors by smoothed Fourier S/N.
+
+    eigvec: [nbin, ncomp] column eigenvectors.  An eigenvector is
+    significant when its smoothed version's Fourier-power S/N passes
+    ``snr_cutoff``; borderline cases (< 3x cutoff) additionally pass a
+    crossings-count sanity check (and optionally an autocorrelation
+    width check) to weed out RFI-like vectors.  Behavioral equivalent of
+    /root/reference/pplib.py:1555-1619; the candidate smoothing runs
+    batched (one call for all check_max vectors).
+    """
+    eigvec = np.asarray(eigvec)
+    nbin = eigvec.shape[0]
+    ncheck = min(max(check_max, return_max), eigvec.shape[1])
+    cand = eigvec[:, :ncheck].T                       # [ncheck, nbin]
+    smooth_cand = np.asarray(smart_smooth(cand, **kwargs))
+    noise = np.asarray(get_noise(cand)) * np.sqrt(nbin / 2.0)
+    sig = np.sum(np.abs(np.fft.rfft(smooth_cand, axis=-1)[:, 1:]) ** 2,
+                 axis=-1)
+    snrs = np.divide(sig, noise, out=np.zeros_like(sig),
+                     where=noise > 0.0)
+
+    smooth_eigvec = np.zeros(eigvec.shape)
+    ieig = []
+    for ivec in range(ncheck):
+        ev = smooth_cand[ivec]
+        ev_snr = snrs[ivec]
+        add = False
+        if ev_snr >= snr_cutoff:
+            if check_crossings and ev_snr < 3 * snr_cutoff:
+                # borderline: many crossings -> rejected.  NB: the
+                # reference's autocorrelation rescue (check_acorr,
+                # pplib.py:1655-1663) is dead code there — its elif
+                # requires add_eigvec already True — so for parity a
+                # crossings failure is final and check_acorr is accepted
+                # but unused.
+                ncross = int(np.asarray(count_crossings(
+                    np.abs(ev), 0.1 * np.abs(ev).max())))
+                add = ncross < int(0.02 * nbin)
+            else:
+                add = True
+        if add:
+            ieig.append(ivec)
+            smooth_eigvec[:, ivec] = ev
+        if ivec + 1 == check_max or len(ieig) == return_max:
+            break
+    ieig = np.array(ieig, dtype=int)
+    if return_smooth:
+        return ieig, smooth_eigvec
+    return ieig

@@ -1,0 +1,255 @@
+"""Stationary wavelet denoising (Daubechies), FFT-domain and batched.
+
+TPU-native equivalent of the reference's wavelet smoothing layer
+(/root/reference/pplib.py:1621-1761 ``wavelet_smooth``/``smart_smooth``/
+``fit_wavelet_smooth_function``), which drives PyWavelets' ``swt/iswt``
+inside a per-profile, per-level ``opt.brute`` host loop.
+
+Design (not a translation):
+
+* PyWavelets is replaced by an in-repo implementation.  The undecimated
+  (a trous) SWT with periodic boundaries is a circular convolution per
+  level, so both the transform and its exact inverse are expressed as
+  FFT multiplies with the level-j filter response H(2^j w) — batched
+  rFFT-style ops that vectorize over (channel, threshold-candidate) and
+  compile to one XLA program, instead of pywt's per-profile C loops.
+* Daubechies scaling filters are generated numerically by spectral
+  factorization (roots of the binomial polynomial), not hard-coded
+  tables; ``daubechies_dec_lo(2)`` reproduces the textbook db2 values to
+  1e-12 (tested).
+* The reference's ``opt.brute`` over the threshold factor, run per
+  profile per level on the host, becomes a dense [nlevel, nfact]
+  candidate grid evaluated in one vmapped computation with an argmax
+  selection — ``smart_smooth`` of a whole portrait is a single device
+  call.
+* Thresholding: universal threshold sigma*sqrt(2 ln nbin) with sigma
+  from the median absolute *finest-detail* coefficient (Donoho-Johnstone
+  estimator).  The reference medians over its library's first-returned
+  coefficient pair instead; the smart_smooth factor search absorbs the
+  scale difference.  Only detail bands are thresholded (the
+  approximation band carries the profile baseline).
+"""
+
+import functools
+from math import comb
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import as_fft_operand, complex_dtype_for, fft_real_dtype
+from .noise import get_noise
+from .stats import get_red_chi2
+
+__all__ = ["daubechies_dec_lo", "swt", "iswt", "wavelet_smooth",
+           "smart_smooth", "threshold"]
+
+
+@functools.lru_cache(maxsize=None)
+def daubechies_dec_lo(N):
+    """Daubechies scaling (lowpass analysis) filter with N vanishing
+    moments (2N taps, 'db{N}'), by spectral factorization.
+
+    H(z) = sqrt(2) ((1+z)/2)^N Q(z) with |Q(e^{iw})|^2 = P(sin^2(w/2)),
+    P(y) = sum_{k<N} C(N-1+k, k) y^k; Q keeps the minimum-phase roots.
+    """
+    if N < 1:
+        raise ValueError("N >= 1 required")
+    if N == 1:  # Haar
+        return np.array([1.0, 1.0]) / np.sqrt(2.0)
+    p = np.array([comb(N - 1 + k, k) for k in range(N)], dtype=np.float64)
+    yroots = np.roots(p[::-1])
+    zroots = []
+    for y in yroots:
+        # y = (2 - z - 1/z)/4  =>  z^2 - (2 - 4y) z + 1 = 0
+        b = 2.0 - 4.0 * y
+        disc = np.sqrt(b * b - 4.0 + 0j)
+        for z in ((b + disc) / 2.0, (b - disc) / 2.0):
+            if abs(z) < 1.0:
+                zroots.append(z)
+    q = np.array([1.0 + 0j])
+    for z in zroots:
+        q = np.convolve(q, np.array([1.0, -z]))
+    h = np.array([1.0])
+    for _ in range(N):
+        h = np.convolve(h, np.array([1.0, 1.0]))
+    h = np.convolve(h, q.real)
+    return h * (np.sqrt(2.0) / h.sum())
+
+
+def _filter_responses(wavelet, nbin, dtype):
+    """(H, G): full-FFT frequency responses of the analysis lo/hi filters
+    on an nbin-point circle.  g_n = (-1)^n h_{L-1-n} (QMF)."""
+    if isinstance(wavelet, str):
+        if not wavelet.startswith("db"):
+            raise ValueError(f"unsupported wavelet '{wavelet}'")
+        h = daubechies_dec_lo(int(wavelet[2:]))
+    else:
+        h = np.asarray(wavelet, dtype=np.float64)
+    L = len(h)
+    g = ((-1.0) ** np.arange(L)) * h[::-1]
+    cdt = complex_dtype_for(fft_real_dtype(dtype))
+    H = jnp.asarray(np.fft.fft(h, nbin), dtype=cdt)
+    G = jnp.asarray(np.fft.fft(g, nbin), dtype=cdt)
+    return H, G
+
+
+def _level_response(H, j):
+    """Response of the level-j a-trous-upsampled filter: H(2^j w)."""
+    nbin = H.shape[0]
+    idx = (np.arange(nbin) * (2 ** j)) % nbin
+    return H[idx]
+
+
+def swt(x, nlevel, wavelet="db8"):
+    """Undecimated wavelet transform of [..., nbin] with periodic
+    boundaries; returns (cA [..., nbin], cDs list of nlevel arrays,
+    finest first).  Perfect-reconstruction partner of ``iswt``."""
+    x = jnp.asarray(x)
+    nbin = x.shape[-1]
+    H, G = _filter_responses(wavelet, nbin, x.dtype)
+    A = jnp.fft.fft(as_fft_operand(x), axis=-1)
+    cDs = []
+    for j in range(nlevel):
+        Hj, Gj = _level_response(H, j), _level_response(G, j)
+        cDs.append(jnp.real(jnp.fft.ifft(jnp.conj(Gj) * A, axis=-1)))
+        A = jnp.conj(Hj) * A
+    cA = jnp.real(jnp.fft.ifft(A, axis=-1))
+    return cA, cDs
+
+
+def iswt(cA, cDs, wavelet="db8"):
+    """Inverse of ``swt``: exact reconstruction via the synthesis
+    responses (|H|^2 + |G|^2 = 2 for orthonormal filters)."""
+    cA = jnp.asarray(cA)
+    nbin = cA.shape[-1]
+    H, G = _filter_responses(wavelet, nbin, cA.dtype)
+    A = jnp.fft.fft(as_fft_operand(cA), axis=-1)
+    for j in reversed(range(len(cDs))):
+        Hj, Gj = _level_response(H, j), _level_response(G, j)
+        D = jnp.fft.fft(as_fft_operand(cDs[j]), axis=-1)
+        A = 0.5 * (Hj * A + Gj * D)
+    return jnp.real(jnp.fft.ifft(A, axis=-1))
+
+
+def threshold(c, value, mode="hard"):
+    """Hard/soft wavelet thresholding (pywt.threshold semantics)."""
+    c = jnp.asarray(c)
+    value = jnp.asarray(value)
+    if mode == "hard":
+        return jnp.where(jnp.abs(c) < value, 0.0, c)
+    if mode == "soft":
+        return jnp.sign(c) * jnp.maximum(jnp.abs(c) - value, 0.0)
+    raise ValueError(f"unknown threshold mode '{mode}'")
+
+
+def wavelet_smooth(port, wavelet="db8", nlevel=5, threshtype="hard",
+                   fact=1.0):
+    """Wavelet-denoised portrait or profile (universal threshold).
+
+    port: [nbin] or [..., nbin]; ``fact`` scales the threshold and may
+    carry extra leading batch dims (e.g. a candidate grid) that
+    broadcast against port's batch shape.  Behavioral equivalent of
+    /root/reference/pplib.py:1621-1666, batched.
+    """
+    port = jnp.asarray(port)
+    nbin = port.shape[-1]
+    cA, cDs = swt(port, nlevel, wavelet)
+    sigma = jnp.median(jnp.abs(cDs[0]), axis=-1) / 0.6745
+    lopt = jnp.asarray(fact) * sigma * jnp.sqrt(2.0 * jnp.log(float(nbin)))
+    cA = jnp.broadcast_to(cA, lopt.shape + cA.shape[-1:])
+    cDs = [threshold(D, lopt[..., None], threshtype) for D in cDs]
+    return iswt(cA, cDs, wavelet)
+
+
+def _pseudo_snr(smooth_prof):
+    """Fourier-domain pseudo-S/N used by the smoothing-factor search
+    (reference pplib.py:1737-1761)."""
+    sig = jnp.sum(
+        jnp.abs(jnp.fft.rfft(as_fft_operand(smooth_prof),
+                             axis=-1)[..., 1:]) ** 2, axis=-1)
+    noise = get_noise(smooth_prof) * jnp.sqrt(smooth_prof.shape[-1] / 2.0)
+    return jnp.where(noise > 0.0, sig / jnp.where(noise > 0.0, noise, 1.0),
+                     jnp.where(sig > 0.0, jnp.inf, 0.0))
+
+
+@functools.partial(jax.jit, static_argnames=("try_nlevels", "nfact",
+                                             "wavelet", "threshtype"))
+def _smart_smooth_grid(port, try_nlevels, nfact, rchi2_tol, wavelet,
+                       threshtype):
+    """Dense (nlevel x fact) candidate search, one XLA program.
+
+    Returns the per-profile best smooth [..., nbin] (zeros where no
+    candidate satisfies |red_chi2 - 1| <= rchi2_tol).
+    """
+    port = jnp.asarray(port)
+    nbin = port.shape[-1]
+    errs = get_noise(port)                      # [...] per profile
+    facts = jnp.linspace(0.0, 3.0, nfact)
+
+    # reduced chi2 of smooth-vs-raw with dof = nbin.  The gate is
+    # one-sided, chi2 <= 1 + tol: over-distortion (removing more than
+    # the noise) is rejected, while chi2 < 1 - tol (under-smoothing, or
+    # a biased-high noise estimate making chi2 = (sigma/sigma_est)^2 < 1
+    # even at perfect denoising) stays eligible — the pseudo-S/N argmax
+    # then drives toward the most aggressive admissible smoothing.  The
+    # reference's two-sided |chi2 - 1| <= tol gate silently zeroes
+    # profiles whenever its noise estimator runs a few percent hot.
+    def chi2_of(sm):
+        r = (port - sm) / jnp.where(errs > 0.0, errs, 1.0)[..., None]
+        return jnp.sum(r * r, axis=-1) / nbin
+
+    best = jnp.zeros_like(port)
+    best_snr = jnp.full(port.shape[:-1], -jnp.inf)
+    for ilevel in range(try_nlevels):
+        # [nfact, ..., nbin] candidates for this decomposition depth
+        fgrid = facts.reshape((nfact,) + (1,) * (port.ndim - 1))
+        sm = wavelet_smooth(port, wavelet, ilevel + 1, threshtype, fgrid)
+        snr = _pseudo_snr(sm)                   # [nfact, ...]
+        ok = chi2_of(sm) - 1.0 <= rchi2_tol
+        snr = jnp.where(ok, snr, 0.0)
+        ibest = jnp.argmax(snr, axis=0)         # [...]
+        sm_best = jnp.take_along_axis(
+            sm, ibest[None, ..., None], axis=0)[0]
+        snr_best = jnp.take_along_axis(snr, ibest[None], axis=0)[0]
+        improve = snr_best > best_snr
+        best = jnp.where(improve[..., None], sm_best, best)
+        best_snr = jnp.maximum(best_snr, snr_best)
+    final_ok = (best_snr > 0.0) & (chi2_of(best) - 1.0 <= rchi2_tol)
+    return jnp.where(final_ok[..., None], best, 0.0)
+
+
+def smart_smooth(port, try_nlevels=None, rchi2_tol=0.1, wavelet="db8",
+                 threshtype="hard", nfact=30, fallback="zero"):
+    """Automated wavelet smoothing: maximize pseudo-S/N over
+    (nlevel, fact) subject to red-chi2 within ``rchi2_tol`` of 1.
+
+    port: [nbin] or [nchan, nbin].  Equivalent of
+    /root/reference/pplib.py:1668-1735 with the per-profile
+    ``opt.brute`` replaced by the dense on-device grid search.
+    ``fallback`` controls profiles where no candidate satisfies the
+    chi2 gate: 'zero' zeroes them (the reference's behavior — correct
+    for eigenvector *significance* screening), 'raw' returns them
+    unsmoothed (correct when the caller needs a usable profile, e.g.
+    the model mean profile of nearly noiseless data).
+    """
+    port_in = np.asarray(port)
+    nbin = port_in.shape[-1]
+    if try_nlevels == 0 or nbin % 2 != 0:
+        return port_in
+    if np.modf(np.log2(nbin))[1] != np.log2(nbin):
+        try_nlevels = 1
+    elif try_nlevels is None:
+        try_nlevels = int(np.log2(nbin))
+    out = np.array(_smart_smooth_grid(
+        jnp.asarray(port_in), int(try_nlevels), int(nfact),
+        float(rchi2_tol), wavelet, threshtype))
+    if fallback == "raw":
+        failed = ~np.any(out, axis=-1)
+        if port_in.ndim > 1:
+            out[failed] = port_in[failed]
+        elif failed:
+            out = port_in.copy()
+    elif port_in.ndim > 1:  # all-zero profiles stay zero (reference skips)
+        out[~np.any(port_in, axis=-1)] = 0.0
+    return out

@@ -1,0 +1,178 @@
+"""Tests: wavelet smoothing, PCA, and the ppspline model builder."""
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.dataportrait import DataPortrait
+from pulseportraiture_tpu.io.archive import make_fake_pulsar
+from pulseportraiture_tpu.io.gmodel import write_model as write_gmodel
+from pulseportraiture_tpu.io.splmodel import read_spline_model
+from pulseportraiture_tpu.models.spline import (SplineModelPortrait,
+                                                make_spline_model,
+                                                write_model)
+from pulseportraiture_tpu.ops.pca import (find_significant_eigvec, pca,
+                                          reconstruct_portrait)
+from pulseportraiture_tpu.ops.profiles import gaussian_profile
+from pulseportraiture_tpu.ops.wavelet import (daubechies_dec_lo, iswt,
+                                              smart_smooth, swt,
+                                              wavelet_smooth)
+
+MODEL_PARAMS = np.array([0.0, 0.0, 0.35, -0.05, 0.05, 0.1, 1.0, -1.2])
+
+
+# -- wavelet ---------------------------------------------------------------
+
+def test_daubechies_filters():
+    db2 = daubechies_dec_lo(2)
+    ref = np.array([1 + np.sqrt(3), 3 + np.sqrt(3), 3 - np.sqrt(3),
+                    1 - np.sqrt(3)]) / (4 * np.sqrt(2))
+    np.testing.assert_allclose(db2, ref, atol=1e-12)
+    for N in (1, 4, 8):
+        h = daubechies_dec_lo(N)
+        assert len(h) == 2 * N
+        np.testing.assert_allclose(h.sum(), np.sqrt(2.0), atol=1e-12)
+        np.testing.assert_allclose((h ** 2).sum(), 1.0, atol=1e-12)
+
+
+def test_swt_perfect_reconstruction():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(3, 256))
+    for nlevel in (1, 3, 5):
+        cA, cDs = swt(x, nlevel)
+        np.testing.assert_allclose(np.asarray(iswt(cA, cDs)), x,
+                                   atol=1e-12)
+
+
+def test_wavelet_smooth_denoises():
+    rng = np.random.default_rng(1)
+    prof = np.asarray(gaussian_profile(256, 0.5, 0.05))
+    noisy = prof + rng.normal(0, 0.05, 256)
+    sm = np.asarray(wavelet_smooth(noisy, nlevel=5, fact=1.0))
+    assert np.sqrt(np.mean((sm - prof) ** 2)) < \
+        0.5 * np.sqrt(np.mean((noisy - prof) ** 2))
+
+
+def test_smart_smooth_batched_and_fallbacks():
+    rng = np.random.default_rng(2)
+    prof = np.asarray(gaussian_profile(256, 0.5, 0.05))
+    noisy = prof + rng.normal(0, 0.05, 256)
+    port = np.stack([noisy, np.zeros(256)])
+    out = smart_smooth(port)
+    assert np.sqrt(np.mean((out[0] - prof) ** 2)) < \
+        0.7 * np.sqrt(np.mean((noisy - prof) ** 2))
+    assert np.abs(out[1]).max() == 0.0
+    # noiseless profile: chi2 against a ~zero noise estimate is
+    # ill-defined (even FFT roundoff fails the gate) -> zeroed by
+    # default, passed through with fallback='raw'
+    clean = np.stack([prof])
+    assert np.abs(smart_smooth(clean)[0]).max() < 1e-10
+    np.testing.assert_allclose(smart_smooth(clean, fallback="raw")[0],
+                               prof)
+    # odd nbin: pass-through
+    odd = noisy[:255]
+    np.testing.assert_allclose(smart_smooth(odd), odd)
+
+
+# -- pca -------------------------------------------------------------------
+
+def test_pca_matches_numpy_cov():
+    rng = np.random.default_rng(3)
+    port = rng.normal(size=(40, 64)) + \
+        np.outer(rng.normal(size=40), np.sin(np.linspace(0, 6, 64)))
+    w = rng.uniform(0.5, 2.0, 40)
+    mean = (port * w[:, None]).sum(0) / w.sum()
+    cov = np.cov((port - mean).T, aweights=w, ddof=1)
+    ev_np, evec_np = np.linalg.eigh(cov)
+    isort = np.argsort(ev_np)[::-1]
+    ev, evec = pca(port, mean, w)
+    np.testing.assert_allclose(np.asarray(ev), ev_np[isort], atol=1e-12)
+    dots = np.abs(np.sum(np.asarray(evec)[:, :5]
+                         * evec_np[:, isort][:, :5], axis=0))
+    np.testing.assert_allclose(dots, 1.0, atol=1e-9)
+    rec = np.asarray(reconstruct_portrait(port, mean, np.asarray(evec)))
+    np.testing.assert_allclose(rec, port, atol=1e-10)
+
+
+def test_find_significant_eigvec():
+    rng = np.random.default_rng(4)
+    nbin = 256
+    sig1 = np.asarray(gaussian_profile(nbin, 0.3, 0.04))
+    sig2 = np.asarray(gaussian_profile(nbin, 0.7, 0.1))
+    # noise level chosen so the rchi2~1 smoothing gate is *achievable*
+    # (near-noiseless vectors cannot smooth to red-chi2 ~ 1 by design)
+    evec = np.zeros((nbin, 10))
+    evec[:, 0] = sig1 / np.linalg.norm(sig1) + rng.normal(0, 5e-3, nbin)
+    evec[:, 1] = sig2 / np.linalg.norm(sig2) + rng.normal(0, 5e-3, nbin)
+    for i in range(2, 10):
+        evec[:, i] = rng.normal(0, 1.0 / np.sqrt(nbin), nbin)
+    ieig, smooth = find_significant_eigvec(evec, snr_cutoff=150.0)
+    assert 0 in ieig and 1 in ieig
+    assert not any(i >= 2 for i in ieig)
+    assert np.abs(smooth[:, ieig]).max() > 0
+
+
+# -- builder ---------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def spline_setup(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("spline")
+    gm = str(tmp / "f.gmodel")
+    write_gmodel(gm, "fake", "000", 1500.0, MODEL_PARAMS,
+                 np.zeros(8, int), -4.0, 0, quiet=True)
+    par = str(tmp / "f.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    avg = str(tmp / "avg.fits")
+    make_fake_pulsar(gm, par, avg, nsub=1, nchan=32, nbin=256, nu0=1500.0,
+                     bw=800.0, tsub=60.0, noise_stds=0.002,
+                     dedispersed=True, seed=7, quiet=True)
+    return tmp, gm, par, avg
+
+
+def test_make_spline_model_reconstructs(spline_setup):
+    tmp, gm, par, avg = spline_setup
+    dp = DataPortrait(avg, quiet=True)
+    built = make_spline_model(dp, max_ncomp=6, smooth=True,
+                              snr_cutoff=50.0, quiet=True)
+    # the injected model evolves over frequency: needs >= 1 component,
+    # and the built model must match the data at the noise level
+    assert built.ncomp >= 1
+    rms = np.sqrt(np.mean((dp.portx - built.modelx) ** 2))
+    assert rms < 3 * 0.002, rms
+    # evolution captured: model differs across the band
+    assert np.abs(built.model[0] - built.model[-1]).max() > 0.01
+
+
+def test_spline_model_roundtrip_and_toas(spline_setup):
+    tmp, gm, par, avg = spline_setup
+    from pulseportraiture_tpu.pipelines.toas import GetTOAs
+
+    dpm = SplineModelPortrait(avg, quiet=True)
+    dpm.make_spline_model(max_ncomp=6, smooth=True, snr_cutoff=50.0,
+                          quiet=True)
+    spl = str(tmp / "m.spl")
+    dpm.write_model(spl)
+    name, port = read_spline_model(spl,
+                                   freqs=np.linspace(1150., 1850., 16),
+                                   nbin=256)
+    assert port.shape == (16, 256)
+
+    rng = np.random.default_rng(3)
+    files, dDMs = [], []
+    for i in range(2):
+        dDM = float(rng.normal(0, 1e-3))
+        ph = float(rng.uniform(-0.2, 0.2))
+        f = str(tmp / f"e{i}.fits")
+        make_fake_pulsar(gm, par, f, nsub=2, nchan=32, nbin=256,
+                         nu0=1500.0, bw=800.0, tsub=60.0, phase=ph,
+                         dDM=dDM, noise_stds=0.02, dedispersed=False,
+                         seed=50 + i, quiet=True)
+        files.append(f)
+        dDMs.append(dDM)
+    gt = GetTOAs(files, spl, quiet=True)
+    gt.get_TOAs(bary=False)
+    for i in range(2):
+        got, err = gt.DeltaDM_means[i], gt.DeltaDM_errs[i]
+        assert abs(got - dDMs[i]) < max(5 * err, 1e-4), \
+            (i, got, dDMs[i], err)

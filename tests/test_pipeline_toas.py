@@ -114,23 +114,18 @@ def test_zap_channels_clean_data(fake_archives):
 
 
 def test_spline_model_pipeline(fake_archives, tmp_path):
-    # build a trivial spline model (flat eigen-space) and fit with it
-    import scipy.interpolate as si
-    from pulseportraiture_tpu.io.splmodel import write_spline_model
-    from pulseportraiture_tpu.ops.profiles import gen_gaussian_profile
+    # build a real spline model with the ppspline-equivalent builder and
+    # fit with it (deeper builder coverage in test_models_spline.py)
+    from pulseportraiture_tpu.dataportrait import DataPortrait
+    from pulseportraiture_tpu.models.spline import (make_spline_model,
+                                                    write_model)
 
     files, phases, dDMs, gmodel = fake_archives
-    d = load_data(files[0], dedisperse=True, pscrunch=True, quiet=True)
-    # mean profile from the data; no frequency evolution (0 eigvec)
-    prof = d.prof
+    dp = DataPortrait(files[0], quiet=True)
+    built = make_spline_model(dp, max_ncomp=6, smooth=False,
+                              snr_cutoff=50.0, quiet=True)
     path = str(tmp_path / "model.spl")
-    freqs = d.freqs[0]
-    coords = np.zeros((1, len(freqs)))
-    tck, _ = si.splprep(coords, u=freqs, k=1, s=0)
-    write_spline_model(path, "m", "src", files[0], prof,
-                       np.zeros((len(prof), 1)), (tck[0],
-                                                  np.asarray(tck[1]),
-                                                  tck[2]))
+    write_model(path, built)
     gt = GetTOAs(files[:1], path, quiet=True)
     gt.get_TOAs(bary=False)
     assert len(gt.TOA_list) == 4
