@@ -1,0 +1,155 @@
+"""Tests: Gaussian profile/portrait fitters and the ppgauss builder."""
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.fit.gauss import (auto_gauss_seed,
+                                            fit_gaussian_portrait,
+                                            fit_gaussian_profile,
+                                            peak_pick_seed)
+from pulseportraiture_tpu.io.archive import make_fake_pulsar
+from pulseportraiture_tpu.io.gmodel import read_model
+from pulseportraiture_tpu.io.gmodel import write_model as write_gmodel
+from pulseportraiture_tpu.models.gauss import (GaussianModelPortrait,
+                                               make_gaussian_model)
+from pulseportraiture_tpu.ops.fourier import get_bin_centers
+from pulseportraiture_tpu.ops.profiles import (gen_gaussian_portrait,
+                                               gen_gaussian_profile)
+
+MODEL_PARAMS = np.array([0.05, 0.0, 0.35, -0.05, 0.05, 0.1, 1.0, -1.2])
+
+
+def test_fit_gaussian_profile_recovers():
+    rng = np.random.default_rng(0)
+    nbin = 256
+    true = np.array([0.05, 0.0, 0.30, 0.04, 1.0])
+    prof = np.asarray(gen_gaussian_profile(true, nbin)) \
+        + rng.normal(0, 0.01, nbin)
+    init = true + np.array([0.01, 0.0, 0.01, 0.005, -0.05])
+    r = fit_gaussian_profile(prof, init, 0.01)
+    np.testing.assert_allclose(r.fitted_params[2:], true[2:], atol=5e-3)
+    assert 0.7 < r.chi2 / r.dof < 1.3
+    # errors: loc error ~ wid/(snr*sqrt(nbin_eff)) — sane, nonzero
+    assert 0 < r.fit_errs[2] < 0.01
+
+
+def test_fit_gaussian_profile_scattering():
+    rng = np.random.default_rng(1)
+    nbin = 256
+    true = np.array([0.0, 6.0, 0.30, 0.05, 1.0])  # tau = 6 bins
+    prof = np.asarray(gen_gaussian_profile(true, nbin)) \
+        + rng.normal(0, 0.005, nbin)
+    init = np.array([0.0, 2.0, 0.30, 0.05, 1.0])
+    r = fit_gaussian_profile(prof, init, 0.005, fit_scattering=True)
+    assert abs(r.fitted_params[1] - 6.0) < 1.0, r.fitted_params
+
+
+def test_peak_pick_seed_finds_components():
+    rng = np.random.default_rng(2)
+    nbin = 256
+    true = np.array([0.02, 0.0, 0.30, 0.04, 1.0, 0.62, 0.10, 0.45])
+    prof = np.asarray(gen_gaussian_profile(true, nbin)) \
+        + rng.normal(0, 0.01, nbin)
+    r = peak_pick_seed(prof, 0.01, max_ngauss=5)
+    ngauss = (len(r.fitted_params) - 2) // 3
+    assert ngauss == 2
+    locs = sorted(r.fitted_params[2::3] % 1.0)
+    np.testing.assert_allclose(locs, [0.30, 0.62], atol=0.01)
+
+
+def test_auto_gauss_seed():
+    nbin = 256
+    prof = np.asarray(gen_gaussian_profile(
+        np.array([0.0, 0.0, 0.40, 0.06, 2.0]), nbin))
+    r = auto_gauss_seed(prof + 0.002, 0.002, wid_guess=0.05)
+    assert abs(r.fitted_params[2] % 1.0 - 0.40) < 0.01
+    assert abs(r.fitted_params[3] - 0.06) < 0.01
+
+
+def test_fit_gaussian_portrait_recovers():
+    rng = np.random.default_rng(3)
+    nbin, nchan = 256, 16
+    freqs = np.linspace(1300.0, 1700.0, nchan)
+    phases = np.asarray(get_bin_centers(nbin))
+    true = np.array([0.0, 0.0, 0.30, -0.02, 0.04, 0.0, 1.0, -1.0])
+    port = np.asarray(gen_gaussian_portrait("000", true, -4.0, phases,
+                                            freqs, 1500.0))
+    port = port + rng.normal(0, 0.01, port.shape)
+    init = true + rng.normal(0, 0.002, 8) * np.array(
+        [1, 0, 1, 1, 1, 0, 1, 1])
+    r = fit_gaussian_portrait("000", port, init, -4.0,
+                              np.full((nchan, nbin), 0.01), np.ones(8),
+                              False, phases, freqs, 1500.0)
+    np.testing.assert_allclose(r.fitted_params[[2, 3, 4, 6, 7]],
+                               true[[2, 3, 4, 6, 7]], atol=0.02)
+    assert 0.8 < r.chi2 / r.dof < 1.2
+
+
+@pytest.fixture(scope="module")
+def gauss_setup(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("gauss")
+    gm = str(tmp / "f.gmodel")
+    write_gmodel(gm, "fake", "000", 1500.0, MODEL_PARAMS,
+                 np.ones(8, int), -4.0, 0, quiet=True)
+    par = str(tmp / "f.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    avg = str(tmp / "avg.fits")
+    make_fake_pulsar(gm, par, avg, nsub=1, nchan=32, nbin=256, nu0=1500.0,
+                     bw=800.0, tsub=60.0, noise_stds=0.003,
+                     dedispersed=True, seed=7, quiet=True)
+    return tmp, gm, par, avg
+
+
+def test_make_gaussian_model_recovers_injected(gauss_setup):
+    tmp, gm, par, avg = gauss_setup
+    dp = make_gaussian_model(avg, niter=3, quiet=True)
+    mp = dp.model_params
+    # loc, dloc, wid, dwid, amp, damp vs injection (dc removed with the
+    # baseline at load)
+    np.testing.assert_allclose(mp[2], 0.35, atol=1e-3)
+    np.testing.assert_allclose(mp[3], -0.05, atol=3e-3)
+    np.testing.assert_allclose(mp[4], 0.05, atol=1e-3)
+    np.testing.assert_allclose(mp[5], 0.1, atol=0.05)
+    np.testing.assert_allclose(mp[6], 1.0, atol=0.01)
+    np.testing.assert_allclose(mp[7], -1.2, atol=0.05)
+    # model matches the data at the noise level; converged
+    assert (dp.portx - dp.modelx).std() < 2 * 0.003
+    assert dp.cnvrgnc
+
+
+def test_gaussian_model_toa_pipeline(gauss_setup):
+    from pulseportraiture_tpu.pipelines.toas import GetTOAs
+
+    tmp, gm, par, avg = gauss_setup
+    dp = make_gaussian_model(avg, niter=3, quiet=True)
+    out = str(tmp / "fit.gmodel")
+    dp.write_model(out)
+    # written model round-trips
+    name, code, nu_ref, ngauss, params, flags, alpha, fita = \
+        read_model(out)
+    assert ngauss == 1 and code == "000"
+    f2 = str(tmp / "e.fits")
+    make_fake_pulsar(gm, par, f2, nsub=2, nchan=32, nbin=256, nu0=1500.0,
+                     bw=800.0, tsub=60.0, phase=0.1, dDM=8e-4,
+                     noise_stds=0.02, dedispersed=False, seed=51,
+                     quiet=True)
+    gt = GetTOAs([f2], out, quiet=True)
+    gt.get_TOAs(bary=False)
+    got, err = gt.DeltaDM_means[0], gt.DeltaDM_errs[0]
+    assert abs(got - 8e-4) < max(5 * err, 1e-4), (got, err)
+
+
+def test_improve_mode_from_modelfile(gauss_setup):
+    tmp, gm, par, avg = gauss_setup
+    # seed from the true .gmodel (improve mode) and refit
+    dp = GaussianModelPortrait(avg, quiet=True)
+    dp.make_gaussian_model(modelfile=gm, niter=2,
+                           outfile=str(tmp / "improved.gmodel"),
+                           writemodel=True, quiet=True)
+    assert (dp.portx - dp.modelx).std() < 2 * 0.003
+    name, code, nu_ref, ngauss, params, flags, alpha, fita = \
+        read_model(str(tmp / "improved.gmodel"))
+    np.testing.assert_allclose(params[2], 0.35, atol=1e-3)
+    np.testing.assert_allclose(params[6], 1.0, atol=0.01)
