@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from ..utils.databunch import DataBunch
-from .smallsolve import solve_refined
+from .smallsolve import inv_refined, solve_refined
 
 __all__ = ["lm_solve"]
 
@@ -106,17 +106,33 @@ def lm_solve(residual_fn, x0, fit_flags=None, bounds=None, max_iter=100,
     out = jax.lax.while_loop(cond, body, state)
     x = out["x"]
 
-    # lmfit-style covariance at the solution: inv(J^T J) * red_chi2
+    # lmfit-style covariance at the solution: inv(J^T J) * red_chi2.
+    # Parameters whose Jacobian column vanishes at the solution (e.g. a
+    # scattering time pinned at its tau=0 bound) are unidentifiable: they
+    # are excluded from the inverse like frozen parameters — otherwise the
+    # singular row poisons every other parameter's error — and report an
+    # infinite uncertainty.  inv_refined (f32 LU + f64 Newton polish)
+    # replaces jnp.linalg.inv because TPU's LuDecomposition only
+    # implements f32/c64.
     J = jac(x) * flags[None, :]
-    JtJ = J.T @ J + unfit
+    colnorm = jnp.sum(J * J, axis=0)
+    ident = flags * (colnorm > 1e-30)
+    J = J * ident[None, :]
+    JtJ = J.T @ J + jnp.eye(nparam) * (1.0 - ident)
     nfit = jnp.sum(flags)
     dof = jnp.maximum(ndata - nfit, 1.0)
     chi2 = out["f"]
     red_chi2 = chi2 / dof
-    cov = jnp.linalg.inv(JtJ) * red_chi2
-    # frozen params report zero uncertainty; negative diagonals (singular
-    # fits) surface as NaN
+    # Jacobi equilibration bounds the condition number seen by the f32
+    # seed inverse: mixed parameter scales (amp ~1, wid ~1e-2, slopes
+    # ~1e-3) otherwise push cond(JtJ) past what Newton polish recovers.
+    d = 1.0 / jnp.sqrt(jnp.maximum(jnp.diagonal(JtJ), 1e-300))
+    cov = (inv_refined(d[:, None] * JtJ * d[None, :])
+           * d[:, None] * d[None, :]) * red_chi2
+    # frozen params report zero uncertainty, unidentifiable ones inf;
+    # negative diagonals (singular fits) surface as NaN
     perr = jnp.sqrt(jnp.diagonal(cov)) * flags
+    perr = jnp.where(flags * (1.0 - ident) > 0, jnp.inf, perr)
     return DataBunch(params=x, param_errs=perr, covar=cov, chi2=chi2,
                      red_chi2=red_chi2, nfev=out["nfev"],
                      return_code=out["rc"], ndata=ndata)

@@ -116,8 +116,11 @@ class GaussianModelPortrait(DataPortrait):
             self.fit_flags[5::6] *= not fixwid
             self.fit_flags[7::6] *= not fixamp
             if fiducial_gaussian:
+                # free every component's loc slope except the first: the
+                # fiducial component does not drift with frequency
+                # (ref ppgauss.py:155-159)
                 self.fit_flags[3::6] = 1
-                self.fit_flags[2] = 0  # first component's loc anchors
+                self.fit_flags[3] = 0
         if errfile is None and outfile is not None:
             errfile = outfile + "_errs"
 
@@ -249,6 +252,9 @@ class GaussianModelPortrait(DataPortrait):
         if outfile is None:
             outfile = self.model_name + ".gmodel"
         params = np.copy(self.model_params)
+        # wrap component locations back into [0, 1) (ref ppgauss.py:345)
+        params[2::6] = np.where(params[2::6] >= 1.0, params[2::6] % 1.0,
+                                params[2::6])
         params[1] *= self.Ps[0] / self.nbin
         write_model(outfile, self.model_name, self.model_code, self.nu_ref,
                     params, self.fit_flags.astype(int),
