@@ -1,0 +1,13 @@
+"""Command-line tools mirroring the reference executables.
+
+Each module exposes ``main(argv=None)`` and runs as
+``python -m pulseportraiture_tpu.cli.<tool>``:
+
+- pptoas   — measure wideband/narrowband TOAs (+DM, GM, scattering)
+- ppalign  — align and average archives
+- ppgauss  — build Gaussian-component portrait models
+- ppspline — build PCA/B-spline portrait models
+- ppzap    — identify bad channels to zap
+"""
+
+TOOLS = ("pptoas", "ppalign", "ppgauss", "ppspline", "ppzap")

@@ -67,6 +67,9 @@ class DataPortrait:
                     "noise_stds", "SNRs", "phases", "prof", "flux_prof",
                     "DM", "epochs", "telescope", "telescope_code"):
             setattr(self, key, d[key])
+        # load_data may hand out non-writable (device-backed) arrays;
+        # normalize/smooth update noise levels in place
+        self.noise_stds = np.array(self.noise_stds)
         if self.source is None:
             self.source = "noname"
         ok = self.ok_ichans[0]
@@ -316,6 +319,21 @@ class DataPortrait:
                 nu_ref))
             self.model_masked = self.model * self.masks[0, 0]
             self.modelx = self.model[self.ok_ichans[0]]
+
+    # -- visualization (ref pplib.py:617-649) ------------------------------
+    def show_data_portrait(self, **kwargs):
+        from .viz import show_data_portrait
+        return show_data_portrait(self, **kwargs)
+
+    def show_model_portrait(self, **kwargs):
+        from .viz import show_portrait
+        return show_portrait(np.asarray(self.modelx),
+                             phases=np.asarray(self.phases),
+                             freqs=np.asarray(self.freqsxs[0]), **kwargs)
+
+    def show_model_fit(self, **kwargs):
+        from .viz import show_model_fit
+        return show_model_fit(self, **kwargs)
 
     def write_join_parameters(self, joinfile=None):
         """Persist join parameters (ref pplib.py:486-521)."""

@@ -19,7 +19,62 @@ from ..ops.fourier import rotate_data
 from ..ops.normalize import normalize_portrait
 from ..ops.profiles import gaussian_profile
 
-__all__ = ["align_archives", "average_archives"]
+__all__ = ["align_archives", "average_archives", "make_constant_portrait",
+           "psrsmooth_archive"]
+
+
+def make_constant_portrait(archive, outfile, profile=None, DM=0.0,
+                           dmc=False, weights=None, quiet=True):
+    """Fill a copy of ``archive`` with one profile in every channel.
+
+    Native equivalent of /root/reference/pplib.py:958-994 (no PSRCHIVE
+    round trip): profile defaults to the archive's full-scrunch average.
+    """
+    from ..io.archive import unload_new_archive
+    from ..io.psrfits import read_archive
+
+    arch = read_archive(archive)
+    nsub, npol, nchan, nbin = arch.data.shape
+    if profile is None:
+        sc = arch.copy()
+        sc.tscrunch()
+        sc.pscrunch()
+        sc.dedisperse()
+        sc.fscrunch()
+        profile = sc.data[0, 0, 0]
+    profile = np.asarray(profile)
+    if len(profile) != nbin:
+        raise ValueError("len(profile) != number of bins in dummy archive")
+    if weights is None:
+        weights = np.ones([nsub, nchan])
+    data = np.broadcast_to(profile, (nsub, npol, nchan, nbin))
+    unload_new_archive(data, arch, outfile, DM=DM, dmc=int(dmc),
+                       weights=weights, quiet=quiet)
+    return outfile
+
+
+def psrsmooth_archive(archive, options="-W", outfile=None, quiet=True):
+    """Wavelet-smooth an archive's profiles and write '<archive>.sm'.
+
+    Native equivalent of the reference's psrsmooth subprocess wrapper
+    (/root/reference/ppalign.py:40-52): '-W' applies per-channel
+    wavelet denoising (ops.wavelet.smart_smooth) to every
+    subintegration/polarization of the stored data.
+    """
+    from ..io.psrfits import read_archive
+    from ..ops.wavelet import smart_smooth
+
+    arch = read_archive(archive)
+    sm = arch.copy()
+    nsub, npol = sm.data.shape[:2]
+    for isub in range(nsub):
+        for ipol in range(npol):
+            sm.data[isub, ipol] = smart_smooth(sm.data[isub, ipol],
+                                               fallback="raw")
+    if outfile is None:
+        outfile = archive + ".sm"
+    sm.unload(outfile, quiet=quiet)
+    return outfile
 
 
 def average_archives(datafiles, outfile, palign=False, tscrunch=True,

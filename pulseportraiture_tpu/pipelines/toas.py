@@ -116,6 +116,64 @@ class GetTOAs:
                 model = np.tile(model[0], (len(freqs), 1))
             return np.asarray(model)
 
+    # -- archive loading with the dmc-reload degraded mode --------------
+    def _load_archive(self, datafile, tscrunch, quiet):
+        """load_data with the reference's dmc-reload fallback
+        (pptoas.py:216-233); returns the DataBunch or None on failure."""
+        try:
+            data = load_data(datafile, dedisperse=False,
+                             dededisperse=False, tscrunch=tscrunch,
+                             pscrunch=True, rm_baseline=True,
+                             refresh_arch=False, return_arch=False,
+                             quiet=quiet)
+            if data.dmc:
+                data = load_data(datafile, dedisperse=False,
+                                 dededisperse=True, tscrunch=tscrunch,
+                                 pscrunch=True, rm_baseline=True,
+                                 refresh_arch=False, return_arch=False,
+                                 quiet=quiet)
+            if not len(data.ok_isubs):
+                if not quiet:
+                    print(f"No subints to fit for {datafile}; "
+                          f"skipping it.")
+                return None
+            return data
+        except (RuntimeError, ValueError, OSError) as e:
+            if not quiet:
+                print(f"Cannot load_data({datafile}): {e}; skipping it.")
+            return None
+
+    def _prepare_models(self, d, ports, freqs_b, Ps_b, fit_scat,
+                        add_instrumental_response, datafile):
+        """Per-batch model portraits [B, nchan, nbin] for one archive,
+        shared by the wideband and narrowband drivers: per-subint models
+        when channel frequencies differ, the FITS-template nbin check,
+        and the optional instrumental-response convolution.  Returns
+        None when the archive must be skipped."""
+        nbin = ports.shape[-1]
+        same_freqs = np.allclose(freqs_b, freqs_b[0])
+        if same_freqs:
+            model = self._build_model(freqs_b[0], d.phases,
+                                      float(Ps_b[0]), fit_scat)
+            models_b = np.broadcast_to(model, ports.shape)
+        else:
+            models_b = np.stack([
+                self._build_model(freqs_b[i], d.phases, float(Ps_b[i]),
+                                  fit_scat)
+                for i in range(len(ports))])
+        if self.is_FITS_model and models_b.shape[-1] != nbin:
+            print(f"Model nbin != data nbin for {datafile}; "
+                  f"skipping it.")
+            return None, same_freqs
+        if add_instrumental_response and (self.ird["DM"]
+                                          or len(self.ird["wids"])):
+            irFT = np.asarray(instrumental_response_port_FT(
+                nbin, freqs_b[0], self.ird["DM"], float(Ps_b[0]),
+                self.ird["wids"], self.ird["irf_types"]))
+            models_b = np.fft.irfft(irFT * np.fft.rfft(models_b, axis=-1),
+                                    nbin, axis=-1)
+        return models_b, same_freqs
+
     # -- the main driver -----------------------------------------------
     def get_TOAs(self, datafile=None, tscrunch=False, nu_refs=None,
                  DM0=None, bary=True, fit_DM=True, fit_GM=False,
@@ -151,28 +209,8 @@ class GetTOAs:
 
         datafiles = self.datafiles if datafile is None else [datafile]
         for iarch, datafile in enumerate(datafiles):
-            try:
-                data = load_data(datafile, dedisperse=False,
-                                 dededisperse=False, tscrunch=tscrunch,
-                                 pscrunch=True, rm_baseline=True,
-                                 refresh_arch=False, return_arch=False,
-                                 quiet=quiet)
-                if data.dmc:
-                    data = load_data(datafile, dedisperse=False,
-                                     dededisperse=True, tscrunch=tscrunch,
-                                     pscrunch=True, rm_baseline=True,
-                                     refresh_arch=False, return_arch=False,
-                                     quiet=quiet)
-                if not len(data.ok_isubs):
-                    if not quiet:
-                        print(f"No subints to fit for {datafile}; "
-                              f"skipping it.")
-                    continue
-                self.ok_idatafiles.append(iarch)
-            except (RuntimeError, ValueError, OSError) as e:
-                if not quiet:
-                    print(f"Cannot load_data({datafile}): {e}; "
-                          f"skipping it.")
+            data = self._load_archive(datafile, tscrunch, quiet)
+            if data is None:
                 continue
             d = data
             nsub, nchan, nbin = d.nsub, d.nchan, d.nbin
@@ -191,30 +229,12 @@ class GetTOAs:
             Ps_b = d.Ps[ok]
             wok = (weights_b > 0.0).astype(np.float64)
 
-            # channel freqs are common across subints in practice;
-            # build per-subint models only when they differ
-            same_freqs = np.allclose(freqs_b, freqs_b[0])
-            if same_freqs:
-                model = self._build_model(freqs_b[0], d.phases,
-                                          float(Ps_b[0]), fit_scat)
-                models_b = np.broadcast_to(model, ports.shape)
-            else:
-                models_b = np.stack([
-                    self._build_model(freqs_b[i], d.phases,
-                                      float(Ps_b[i]), fit_scat)
-                    for i in range(B)])
-            if self.is_FITS_model and models_b.shape[-1] != nbin:
-                print(f"Model nbin != data nbin for {datafile}; "
-                      f"skipping it.")
+            models_b, same_freqs = self._prepare_models(
+                d, ports, freqs_b, Ps_b, fit_scat,
+                add_instrumental_response, datafile)
+            if models_b is None:
                 continue
-            if add_instrumental_response and (self.ird["DM"]
-                                              or len(self.ird["wids"])):
-                irFT = np.asarray(instrumental_response_port_FT(
-                    nbin, freqs_b[0], self.ird["DM"], float(Ps_b[0]),
-                    self.ird["wids"], self.ird["irf_types"]))
-                models_b = np.fft.irfft(irFT * np.fft.rfft(models_b,
-                                                           axis=-1),
-                                        nbin, axis=-1)
+            self.ok_idatafiles.append(iarch)
 
             # reference frequencies for fit and output
             nu_means = (freqs_b * wok).sum(-1) / wok.sum(-1)
@@ -560,11 +580,323 @@ class GetTOAs:
             print("Total time: %.2f sec, ~%.4f sec/TOA"
                   % (tot, tot / max(ntoa, 1)))
 
+    # -- narrowband (per-channel) TOAs ----------------------------------
+    def get_narrowband_TOAs(self, datafile=None, tscrunch=False,
+                            fit_scat=False, log10_tau=True,
+                            scat_guess=None, print_phase=False,
+                            print_flux=False, print_parangle=False,
+                            add_instrumental_response=False,
+                            addtnl_toa_flags={}, method="trust-ncg",
+                            bounds=None, show_plot=False, quiet=None,
+                            max_iter=50):
+        """Measure per-channel (narrowband) TOAs.
+
+        Equivalent of /root/reference/pptoas.py:740-1125, re-designed as
+        one device call per archive: every live (subint, channel)
+        profile is fit in a single batched FFTFIT (grid matmul + Newton
+        polish) instead of the reference's per-channel host loop.
+
+        fit_scat=True fits a per-channel scattering time jointly with
+        the phase — the reference declares this mode not yet implemented
+        and zeroes tau; here each channel becomes a single-channel
+        portrait through the 5-parameter kernel with fit_flags
+        (phi, tau) so the scattering fit is real.  alpha and DM/GM are
+        unidentifiable from one channel and stay fixed.
+        """
+        if quiet is None:
+            quiet = self.quiet
+        self.nfit = 1 + 2 * int(fit_scat)
+        self.fit_phi = True
+        self.fit_tau = fit_scat
+        self.fit_flags = [1, int(fit_scat)]
+        if not fit_scat:
+            log10_tau = False
+        self.log10_tau = log10_tau
+        self.scat_guess = scat_guess
+        self.tscrunch = tscrunch
+        self.add_instrumental_response = add_instrumental_response
+        start = time.time()
+
+        datafiles = self.datafiles if datafile is None else [datafile]
+        for iarch, datafile in enumerate(datafiles):
+            data = self._load_archive(datafile, tscrunch, quiet)
+            if data is None:
+                continue
+            d = data
+            nsub, nchan, nbin = d.nsub, d.nchan, d.nbin
+            fit_start = time.time()
+            ok = np.asarray(d.ok_isubs)
+            B = len(ok)
+            ports = d.subints[ok, 0]                      # [B, nchan, nbin]
+            freqs_b = d.freqs[ok]
+            weights_b = d.weights[ok]
+            errs_b = d.noise_stds[ok, 0]
+            Ps_b = d.Ps[ok]
+            wok = (weights_b > 0.0).astype(np.float64)
+
+            models_b, same_freqs = self._prepare_models(
+                d, ports, freqs_b, Ps_b, fit_scat,
+                add_instrumental_response, datafile)
+            if models_b is None:
+                continue
+            self.ok_idatafiles.append(iarch)
+
+            # flatten live (subint, channel) pairs into one fit batch
+            jj, cc = np.nonzero(wok)                      # [M], [M]
+            sub_idx = ok[jj]                 # archive subint index per fit
+            profs = ports[jj, cc]                         # [M, nbin]
+            mods = np.ascontiguousarray(models_b[jj, cc])
+            errsx = errs_b[jj, cc]
+            nusx = freqs_b[jj, cc]
+            Psx = Ps_b[jj]
+            M = len(jj)
+
+            taus_fit = np.zeros(M)
+            tau_errs_fit = np.zeros(M)
+            covariances = np.zeros([nsub, nchan, self.nfit, self.nfit])
+            nfevals = np.zeros([nsub, nchan], dtype=int)
+            rcs_a = np.zeros([nsub, nchan], dtype=int)
+            # caller bounds follow the reference's [(phi), (tau)] contract
+            phi_bounds = (-0.5, 0.5)
+            if bounds is not None and bounds[0] is not None \
+                    and None not in bounds[0]:
+                phi_bounds = tuple(bounds[0])
+            if not fit_scat:
+                r = fit_phase_shift(profs, mods, noise=errsx,
+                                    bounds=phi_bounds, Ns=100)
+                phis_fit = np.asarray(r.phase)
+                phi_errs_fit = np.asarray(r.phase_err)
+                scales_fit = np.asarray(r.scale)
+                scale_errs_fit = np.asarray(r.scale_err)
+                snrs_fit = np.asarray(r.snr)
+                red_chi2s_fit = np.asarray(r.red_chi2)
+            else:
+                # per-channel tau guess at each channel's frequency
+                alpha_guess = getattr(self, "alpha", scattering_alpha)
+                if self.scat_guess is not None:
+                    tg_s, tg_ref, alpha_guess = self.scat_guess
+                    tau_g = (tg_s / Psx) * (nusx / tg_ref) ** alpha_guess
+                elif hasattr(self, "gparams"):
+                    tau_g = (self.gparams[1] / Psx) * \
+                        (nusx / self.model_nu_ref) ** alpha_guess
+                else:
+                    tau_g = np.zeros(M)
+                # phase guess vs the scattered model
+                taus_g = np.asarray(scattering_times(tau_g, alpha_guess,
+                                                     nusx, nusx))
+                spFT = np.asarray(scattering_portrait_FT(taus_g, nbin))
+                mods_scat = np.fft.irfft(spFT * np.fft.rfft(mods, axis=-1),
+                                         nbin, axis=-1)
+                guess = fit_phase_shift(profs, mods_scat, noise=errsx,
+                                        Ns=100)
+                if log10_tau:
+                    tau_g = np.log10(np.where(tau_g == 0.0, 1.0 / nbin,
+                                              tau_g))
+                init = np.stack([np.asarray(guess.phase),
+                                 np.full(M, d.DM), np.zeros(M), tau_g,
+                                 np.full(M, alpha_guess)], axis=1)
+                if bounds is None:
+                    tau_lo = np.log10(1.0 / (10 * nbin)) if log10_tau \
+                        else 0.0
+                    bounds_eff = [(None, None), (None, None),
+                                  (None, None), (tau_lo, None),
+                                  (-10.0, 10.0)]
+                else:
+                    bounds_eff = [tuple(bounds[0]), (None, None),
+                                  (None, None), tuple(bounds[1]),
+                                  (-10.0, 10.0)]
+                out = fit_portrait_full_batch(
+                    profs[:, None, :], mods[:, None, :], init, Psx,
+                    nusx[:, None], errs=errsx[:, None],
+                    fit_flags=(1, 0, 0, 1, 0),
+                    nu_fits=np.stack([nusx] * 3, axis=1),
+                    bounds=bounds_eff, log10_tau=log10_tau,
+                    max_iter=max_iter)
+                phis_fit = np.asarray(out["phi"])
+                phi_errs_fit = np.asarray(out["phi_err"])
+                taus_fit = np.asarray(out["tau"])
+                tau_errs_fit = np.asarray(out["tau_err"])
+                scales_fit = np.asarray(out["scales"])[:, 0]
+                scale_errs_fit = np.asarray(out["scale_errs"])[:, 0]
+                snrs_fit = np.asarray(out["snr"])
+                red_chi2s_fit = np.asarray(out["red_chi2"])
+                # (phi, tau) covariance block from the 5-param kernel's
+                # packed [nfit, nfit] matrix (fit order: phi, tau)
+                cov = np.asarray(out["covariance_matrix"])
+                covariances[sub_idx, cc, 0, 0] = cov[:, 0, 0]
+                covariances[sub_idx, cc, 0, 1] = cov[:, 0, 1]
+                covariances[sub_idx, cc, 1, 0] = cov[:, 1, 0]
+                covariances[sub_idx, cc, 1, 1] = cov[:, 1, 1]
+                nfevals[sub_idx, cc] = np.asarray(out["nfeval"])
+                rcs_a[sub_idx, cc] = np.asarray(out["return_code"])
+            fit_duration = time.time() - fit_start
+
+            # -- assemble per-archive [nsub, nchan] outputs -------------
+            phis = np.zeros([nsub, nchan])
+            phi_errs = np.zeros([nsub, nchan])
+            TOAs_arr = np.zeros([nsub, nchan], dtype=object)
+            TOA_errs_arr = np.zeros([nsub, nchan], dtype=object)
+            taus_a = np.zeros([nsub, nchan])
+            tau_errs = np.zeros([nsub, nchan])
+            scales_a = np.zeros([nsub, nchan])
+            scale_errs_a = np.zeros([nsub, nchan])
+            channel_snrs = np.zeros([nsub, nchan])
+            profile_fluxes = np.zeros([nsub, nchan])
+            profile_flux_errs = np.zeros([nsub, nchan])
+            channel_red_chi2s = np.zeros([nsub, nchan])
+            MJDs = np.array([d.epochs[isub].mjd() for isub in range(nsub)])
+
+            phis[sub_idx, cc] = phis_fit
+            phi_errs[sub_idx, cc] = phi_errs_fit
+            taus_a[sub_idx, cc] = taus_fit
+            tau_errs[sub_idx, cc] = tau_errs_fit
+            scales_a[sub_idx, cc] = scales_fit
+            scale_errs_a[sub_idx, cc] = scale_errs_fit
+            channel_snrs[sub_idx, cc] = snrs_fit
+            channel_red_chi2s[sub_idx, cc] = red_chi2s_fit
+
+            if print_flux:
+                # per-channel flux of the (scattered) scaled template
+                if fit_scat:
+                    tau_lin = 10 ** taus_fit if log10_tau else taus_fit
+                    tausx = np.asarray(scattering_times(
+                        tau_lin, scattering_alpha, nusx, nusx))
+                    spFT = np.asarray(scattering_portrait_FT(tausx, nbin))
+                    scat_mods = np.fft.irfft(
+                        spFT * np.fft.rfft(mods, axis=-1), nbin, axis=-1)
+                else:
+                    scat_mods = mods
+                means = scat_mods.mean(axis=-1)
+                profile_fluxes[sub_idx, cc] = means * scales_fit
+                profile_flux_errs[sub_idx, cc] = np.abs(means) * \
+                    scale_errs_fit
+
+            for m in range(M):
+                isub = int(sub_idx[m])
+                ichan = int(cc[m])
+                P = float(Psx[m])
+                epoch = d.epochs[isub]
+                TOA_epoch = epoch.add_seconds(
+                    float(phis_fit[m]) * P + d.backend_delay)
+                TOA_err_us = float(phi_errs_fit[m]) * P * 1e6
+                TOAs_arr[isub, ichan] = TOA_epoch
+                TOA_errs_arr[isub, ichan] = TOA_err_us
+
+                toa_flags = {}
+                if fit_scat:
+                    df = float(d.doppler_factors[isub])
+                    if log10_tau:
+                        toa_flags["scat_time"] = \
+                            10 ** float(taus_fit[m]) * P / df * 1e6
+                        toa_flags["log10_scat_time"] = \
+                            float(taus_fit[m]) + np.log10(P / df)
+                        toa_flags["log10_scat_time_err"] = \
+                            float(tau_errs_fit[m])
+                    else:
+                        toa_flags["scat_time"] = \
+                            float(taus_fit[m]) * P / df * 1e6
+                        toa_flags["scat_time_err"] = \
+                            float(tau_errs_fit[m]) * P / df * 1e6
+                    toa_flags["phi_tau_cov"] = \
+                        float(covariances[isub, ichan, 0, 1])
+                toa_flags.update(
+                    be=d.backend, fe=d.frontend,
+                    f=f"{d.frontend}_{d.backend}", nbin=nbin,
+                    bw=abs(d.bw) / nchan, subint=isub, chan=ichan,
+                    tobs=float(d.subtimes[isub]), tmplt=self.modelfile,
+                    snr=float(snrs_fit[m]),
+                    gof=float(red_chi2s_fit[m]))
+                if print_phase:
+                    toa_flags["phs"] = float(phis_fit[m])
+                    toa_flags["phs_err"] = float(phi_errs_fit[m])
+                if print_flux:
+                    toa_flags["flux"] = float(profile_fluxes[isub, ichan])
+                    toa_flags["flux_err"] = \
+                        float(profile_flux_errs[isub, ichan])
+                if print_parangle:
+                    toa_flags["par_angle"] = \
+                        float(d.parallactic_angles[isub])
+                toa_flags.update(addtnl_toa_flags)
+                self.TOA_list.append(TOA(
+                    datafile, float(nusx[m]), TOA_epoch, TOA_err_us,
+                    d.telescope, d.telescope_code, None, None, toa_flags))
+
+            self.order.append(datafile)
+            self.obs.append(DataBunch(telescope=d.telescope,
+                                      backend=d.backend,
+                                      frontend=d.frontend))
+            self.doppler_fs.append(d.doppler_factors)
+            self.ok_isubs.append(ok)
+            self.epochs.append(d.epochs)
+            self.MJDs.append(MJDs)
+            self.Ps.append(d.Ps)
+            self.phis.append(phis)
+            self.phi_errs.append(phi_errs)
+            self.TOAs.append(TOAs_arr)
+            self.TOA_errs.append(TOA_errs_arr)
+            self.taus.append(taus_a)
+            self.tau_errs.append(tau_errs)
+            self.scales.append(scales_a)
+            self.scale_errs.append(scale_errs_a)
+            self.channel_snrs.append(channel_snrs)
+            self.profile_fluxes.append(profile_fluxes)
+            self.profile_flux_errs.append(profile_flux_errs)
+            self.covariances.append(covariances)
+            if not hasattr(self, "channel_red_chi2s"):
+                self.channel_red_chi2s = []
+            self.channel_red_chi2s.append(channel_red_chi2s)
+            self.nfevals.append(nfevals)
+            self.rcs.append(rcs_a)
+            self.fit_durations.append(fit_duration)
+            if not quiet:
+                print("--------------------------")
+                print(datafile)
+                print("~%.4f sec/TOA" % (fit_duration / max(M, 1)))
+                print("Med. TOA error is %.3f us"
+                      % np.median(phi_errs_fit * Psx * 1e6))
+        if not quiet and len(self.ok_isubs):
+            tot = time.time() - start
+            print("--------------------------")
+            print("Total time: %.2f sec, ~%.4f sec/TOA"
+                  % (tot, tot / max(len(self.TOA_list), 1)))
+
     def write_TOAs(self, outfile=None, nu_ref=None, format="tempo2",
                    SNR_cutoff=0.0, append=True):
         """Write the accumulated TOA_list to a .tim file."""
         write_TOAs(self.TOA_list, SNR_cutoff=SNR_cutoff, outfile=outfile,
                    append=append)
+
+    def write_princeton_TOAs(self, outfile=None, one_DM=False,
+                             dmerrfile=None):
+        """Write the accumulated TOAs in Princeton/tempo format.
+
+        Implements the method the reference CLI calls but never defines
+        (pptoas.py:1589): one line per TOA via
+        io.timfile.write_princeton_TOA, with the dDM column from the
+        per-subint fit (or the per-archive mean when ``one_DM``);
+        ``dmerrfile`` appends the matching DM uncertainties.
+        """
+        from ..io.timfile import write_princeton_TOA
+
+        dm_err_lines = []
+        for toa in self.TOA_list:
+            ifile = self.order.index(toa.archive)
+            DM0 = self.DM0s[ifile] if ifile < len(self.DM0s) else 0.0
+            if one_DM and ifile < len(self.DeltaDM_means):
+                dDM = float(self.DeltaDM_means[ifile])
+                dDM_err = float(self.DeltaDM_errs[ifile])
+            elif toa.DM is not None:
+                dDM = float(toa.DM) - DM0
+                dDM_err = float(toa.DM_error)
+            else:  # narrowband TOAs carry no DM measurement
+                dDM = dDM_err = 0.0
+            write_princeton_TOA(toa.MJD.intday(), toa.MJD.fracday(),
+                                toa.TOA_error, toa.frequency, dDM,
+                                obs=toa.telescope_code, outfile=outfile)
+            dm_err_lines.append("%.5e" % dDM_err)
+        if dmerrfile is not None:
+            with open(dmerrfile, "a") as f:
+                f.write("\n".join(dm_err_lines) + "\n")
 
     # -- post-fit channel zapping (reference pptoas.py:1201-1278) -------
     def return_fit(self, ifile, isub):
@@ -618,6 +950,19 @@ class GetTOAs:
             port, self.phis[ifile][isub], DM_topo, P, freqs,
             self.nu_refs[ifile][isub][0]))
         return rot_port, model, ok_ichans, freqs, d.noise_stds[isub, 0]
+
+    def show_subint(self, ifile=0, isub=0, rotate=0.0, **kwargs):
+        """Plot one fitted subintegration (ref pptoas.py:1280-1308)."""
+        from ..viz import show_subint
+        return show_subint(self, ifile=ifile, isub=isub, rotate=rotate,
+                           **kwargs)
+
+    def show_fit(self, ifile=0, isub=0, rotate=0.0, **kwargs):
+        """Plot one subint's data/model/residuals
+        (ref pptoas.py:1310-1412)."""
+        from ..viz import show_fit
+        return show_fit(self, ifile=ifile, isub=isub, rotate=rotate,
+                        **kwargs)
 
     def get_channels_to_zap(self, SNR_threshold=8.0, rchi2_threshold=1.3,
                             iterate=True, show=False):

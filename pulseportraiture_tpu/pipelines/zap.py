@@ -1,0 +1,90 @@
+"""Standalone channel-zapping heuristics + paz command emission.
+
+Equivalent of the reference's ppzap module functions
+(/root/reference/ppzap.py:18-95): the model-free median-noise cut
+(``get_zap_channels``) and the paz shell-command writer
+(``print_paz_cmds``).  The model-based path lives on
+``GetTOAs.get_channels_to_zap`` (pipelines/toas.py), as in the
+reference.
+
+The median cut here is vectorized across a subintegration's channels
+(boolean masks instead of the reference's list.pop loop) but iterates to
+the same fixed point: a channel is zapped when its noise level exceeds
+the median of the surviving channels by ``nstd`` standard deviations.
+"""
+
+import sys
+
+import numpy as np
+
+__all__ = ["get_zap_channels", "print_paz_cmds"]
+
+
+def get_zap_channels(data, nstd=3):
+    """Propose channels to zap via the iterated median-noise algorithm.
+
+    data: DataBunch from load_data (or DataPortrait); uses
+    data.ok_isubs / data.ok_ichans / data.noise_stds.
+    Returns a per-subint list of sorted channel-index lists
+    (ref /root/reference/ppzap.py:18-48).
+    """
+    zap_channels = []
+    for isub in data.ok_isubs:
+        ichans = np.asarray(data.ok_ichans[isub], dtype=int)
+        alive = np.ones(len(ichans), dtype=bool)
+        noise = np.asarray(data.noise_stds[isub, 0, ichans])
+        while alive.any():
+            ns = noise[alive]
+            bad = noise > np.median(ns) + nstd * np.std(ns)
+            bad &= alive
+            if not bad.any():
+                break
+            alive &= ~bad
+        zap_channels.append(sorted(ichans[~alive].tolist()))
+    return zap_channels
+
+
+def print_paz_cmds(datafiles, zap_list, all_subs=False, modify=True,
+                   outfile=None, quiet=False):
+    """Emit paz shell commands for a zap list.
+
+    zap_list[iarch][isub] -> channel indices to zap; all_subs applies a
+    channel's zap to every subint (deduplicated); modify=True emits
+    in-place ('-m') commands, else a '-e zap' copy first.  outfile
+    appends to a file instead of stdout.  Returns the emitted lines
+    (ref /root/reference/ppzap.py:50-95).
+    """
+    if not len(datafiles) or not len(zap_list):
+        if not quiet:
+            print("Nothing to zap.")
+        return []
+    lines = []
+    for iarch, datafile in enumerate(datafiles):
+        count = sum(len(z) for z in zap_list[iarch])
+        if count:
+            if modify:
+                paz_outfile = datafile
+            else:
+                ii = datafile[::-1].find(".")
+                paz_outfile = datafile + ".zap" if ii < 0 \
+                    else datafile[:-ii] + "zap"
+                lines.append("paz -e zap %s" % datafile)
+        last_line = ""
+        for isub, bad_ichans in enumerate(zap_list[iarch]):
+            for bad_ichan in bad_ichans:
+                if not all_subs:
+                    lines.append("paz -m -I -z %d -w %d %s"
+                                 % (bad_ichan, isub, paz_outfile))
+                else:
+                    line = "paz -m -z %d %s" % (bad_ichan, paz_outfile)
+                    if line != last_line:
+                        lines.append(line)
+                    last_line = line
+    out = open(outfile, "a") if outfile is not None else sys.stdout
+    for line in lines:
+        print(line, file=out)
+    if outfile is not None:
+        out.close()
+        if not quiet:
+            print("Wrote %s." % outfile)
+    return lines

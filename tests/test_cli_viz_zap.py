@@ -1,0 +1,211 @@
+"""Tests: ppzap heuristics, CLI tools, and the viz layer (smoke)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.io.archive import load_data, make_fake_pulsar
+from pulseportraiture_tpu.io.gmodel import write_model
+from pulseportraiture_tpu.io.splmodel import read_spline_model
+from pulseportraiture_tpu.pipelines.zap import (get_zap_channels,
+                                                print_paz_cmds)
+
+MODEL_PARAMS = np.array([0.02, 0.0, 0.40, 0.0, 0.05, 0.0, 1.0, -0.5])
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("clizap")
+    gm = str(tmp / "f.gmodel")
+    write_model(gm, "fake", "000", 1500.0, MODEL_PARAMS,
+                np.ones(8, int), -4.0, 0, quiet=True)
+    par = str(tmp / "f.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 100.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    # archive with two hot (high-noise) channels
+    noise = np.full(16, 0.005)
+    noise[3] = 0.08
+    noise[11] = 0.05
+    hot = str(tmp / "hot.fits")
+    make_fake_pulsar(gm, par, hot, nsub=2, nchan=16, nbin=128, nu0=1500.0,
+                     bw=800.0, tsub=60.0, noise_stds=noise,
+                     dedispersed=False, seed=3, quiet=True)
+    clean = str(tmp / "clean.fits")
+    make_fake_pulsar(gm, par, clean, nsub=1, nchan=16, nbin=128,
+                     nu0=1500.0, bw=800.0, tsub=60.0, noise_stds=0.004,
+                     dedispersed=True, seed=4, quiet=True)
+    return tmp, gm, par, hot, clean
+
+
+def test_get_zap_channels_flags_hot_channels(setup):
+    tmp, gm, par, hot, clean = setup
+    data = load_data(hot, dedisperse=False, tscrunch=False, pscrunch=True,
+                     rm_baseline=True, quiet=True)
+    zaps = get_zap_channels(data, nstd=3)
+    assert len(zaps) == 2
+    for z in zaps:
+        assert 3 in z and 11 in z
+        assert len(z) <= 4  # no mass false positives
+
+
+def test_print_paz_cmds(setup, capsys):
+    tmp, gm, par, hot, clean = setup
+    zap_list = [[[3, 11], [3]]]
+    lines = print_paz_cmds([hot], zap_list, modify=True, quiet=True)
+    assert lines == ["paz -m -I -z 3 -w 0 %s" % hot,
+                     "paz -m -I -z 11 -w 0 %s" % hot,
+                     "paz -m -I -z 3 -w 1 %s" % hot]
+    capsys.readouterr()
+    lines = print_paz_cmds([hot], [[[3], [3]]], all_subs=True,
+                           modify=False, quiet=True)
+    assert lines[0].startswith("paz -e zap")
+    # consecutive duplicates collapse (reference semantics)
+    assert sum(ln.endswith("zap") and "-z 3" in ln for ln in lines) == 1
+    out = str(tmp / "paz.cmds")
+    print_paz_cmds([hot], zap_list, outfile=out, quiet=True)
+    assert os.path.exists(out)
+
+
+def test_cli_ppzap(setup, capsys):
+    from pulseportraiture_tpu.cli.ppzap import main
+
+    tmp, gm, par, hot, clean = setup
+    out = str(tmp / "zap1.cmds")
+    assert main(["-d", hot, "-n", "3", "-o", out, "--quiet"]) == 0
+    text = open(out).read()
+    assert "-z 3" in text and "-z 11" in text
+    # model-based path
+    out2 = str(tmp / "zap2.cmds")
+    assert main(["-d", hot, "-m", gm, "-o", out2, "--quiet"]) == 0
+    capsys.readouterr()
+
+
+def test_cli_pptoas_wideband_and_formats(setup):
+    from pulseportraiture_tpu.cli.pptoas import main
+
+    tmp, gm, par, hot, clean = setup
+    tim = str(tmp / "out.tim")
+    assert main(["-d", hot, "-m", gm, "-o", tim, "--quiet"]) == 0
+    toa_lines = [ln for ln in open(tim).read().splitlines()
+                 if ln and not ln.startswith("FORMAT")]
+    assert len(toa_lines) == 2  # one per subint
+    assert all("-pp_dm" in ln for ln in toa_lines)
+    # princeton format + DM error file
+    prn = str(tmp / "out.princeton")
+    err = str(tmp / "out.dmerrs")
+    assert main(["-d", hot, "-m", gm, "-o", prn, "-f", "princeton",
+                 "--errfile", err, "--quiet"]) == 0
+    assert len(open(prn).read().splitlines()) == 2
+    assert len(open(err).read().splitlines()) == 2
+    # narrowband
+    nb = str(tmp / "out_nb.tim")
+    assert main(["-d", clean, "-m", gm, "-o", nb, "--narrowband",
+                 "--quiet"]) == 0
+    nb_lines = [ln for ln in open(nb).read().splitlines()
+                if ln and not ln.startswith("FORMAT")]
+    assert len(nb_lines) == 16
+    # one_DM mode marks TOA lines with the epoch-mean DM
+    one = str(tmp / "out_onedm.tim")
+    assert main(["-d", hot, "-m", gm, "-o", one, "--one_DM",
+                 "--quiet"]) == 0
+    assert all("-DM_mean" in ln for ln in
+               open(one).read().splitlines()[1:])
+
+
+def test_cli_ppspline_and_model(setup):
+    from pulseportraiture_tpu.cli.ppspline import main
+
+    tmp, gm, par, hot, clean = setup
+    spl = str(tmp / "model.spl")
+    assert main(["-d", clean, "-o", spl, "-n", "4", "--quiet"]) == 0
+    name, source, datafile, mean_prof, eigvec, tck = \
+        read_spline_model(spl, quiet=True)
+    assert mean_prof.shape == (128,)
+
+
+def test_cli_ppgauss(setup):
+    from pulseportraiture_tpu.cli.ppgauss import main
+    from pulseportraiture_tpu.io.gmodel import read_model
+
+    tmp, gm, par, hot, clean = setup
+    out = str(tmp / "cli.gmodel")
+    assert main(["-d", clean, "-o", out, "--autogauss", "0.05",
+                 "--niter", "1"]) == 0
+    name, code, nu_ref, ngauss, params, flags, alpha, fita = \
+        read_model(out)
+    assert ngauss >= 1
+    assert abs(params[2] % 1.0 - 0.40) < 0.01
+    assert os.path.exists(out + "_errs")
+
+
+def test_cli_ppalign(setup):
+    from pulseportraiture_tpu.cli.ppalign import main
+
+    tmp, gm, par, hot, clean = setup
+    # two epochs of the same pulsar to average
+    a1 = str(tmp / "e1.fits")
+    a2 = str(tmp / "e2.fits")
+    make_fake_pulsar(gm, par, a1, nsub=1, nchan=16, nbin=128, nu0=1500.0,
+                     bw=800.0, tsub=60.0, phase=0.02, noise_stds=0.01,
+                     dedispersed=True, seed=5, quiet=True)
+    make_fake_pulsar(gm, par, a2, nsub=1, nchan=16, nbin=128, nu0=1500.0,
+                     bw=800.0, tsub=60.0, phase=-0.03, noise_stds=0.01,
+                     dedispersed=True, seed=6, quiet=True)
+    meta = str(tmp / "align.meta")
+    with open(meta, "w") as f:
+        f.write(a1 + "\n" + a2 + "\n")
+    out = str(tmp / "avg.algnd.fits")
+    assert main(["-M", meta, "-o", out, "--niter", "2", "-s"]) == 0
+    assert os.path.exists(out)
+    assert os.path.exists(out + ".sm")
+    avg = load_data(out, tscrunch=True, pscrunch=True, rm_baseline=True,
+                    quiet=True)
+    # averaged portrait is sharper than the noise of one archive
+    assert avg.subints[0, 0][avg.ok_ichans[0]].max() > 0.5
+
+
+def test_viz_smoke(setup):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    from pulseportraiture_tpu import viz
+    from pulseportraiture_tpu.models.spline import SplineModelPortrait
+    from pulseportraiture_tpu.pipelines.toas import GetTOAs
+
+    tmp, gm, par, hot, clean = setup
+    d = load_data(clean, tscrunch=True, pscrunch=True, rm_baseline=True,
+                  quiet=True)
+    port = d.subints[0, 0]
+    p1 = str(tmp / "portrait.png")
+    viz.show_portrait(port, phases=d.phases, freqs=d.freqs[0],
+                      title="t", savefig=p1)
+    assert os.path.getsize(p1) > 1000
+    p2 = str(tmp / "resid.png")
+    viz.show_residual_plot(port, port * 0.95,
+                           freqs=d.freqs[0], noise_stds=d.noise_stds[0, 0],
+                           savefig=p2)
+    assert os.path.getsize(p2) > 1000
+    p3 = str(tmp / "stacked.png")
+    viz.show_stacked_profiles(port[::4], phases=d.phases, fit=True,
+                              savefig=p3)
+    assert os.path.getsize(p3) > 1000
+    # spline-model views
+    dp = SplineModelPortrait(clean, quiet=True)
+    dp.make_spline_model(max_ncomp=4, quiet=True)
+    p4 = str(tmp / "eig.png")
+    viz.show_eigenprofiles(dp, savefig=p4)
+    assert os.path.getsize(p4) > 1000
+    p5 = str(tmp / "proj.png")
+    viz.show_spline_curve_projections(dp, savefig=p5)
+    assert os.path.getsize(p5) > 1000
+    # GetTOAs views
+    gt = GetTOAs([hot], gm, quiet=True)
+    gt.get_TOAs(bary=False)
+    p6 = str(tmp / "fit.png")
+    gt.show_fit(0, 0, savefig=p6)
+    assert os.path.getsize(p6) > 1000
+    p7 = str(tmp / "subint.png")
+    gt.show_subint(0, 0, savefig=p7)
+    assert os.path.getsize(p7) > 1000
