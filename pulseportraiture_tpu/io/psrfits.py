@@ -78,12 +78,23 @@ class Archive:
         self.nu0 = float(nu0 if nu0 is not None
                          else self.freqs[0].mean())
         self.ephemeris_text = ephemeris_text
-        self.doppler_factors = (np.ones(self.nsub)
-                                if doppler_factors is None
-                                else np.asarray(doppler_factors))
-        self.parallactic_angles = (np.zeros(self.nsub)
-                                   if parallactic_angles is None
-                                   else np.asarray(parallactic_angles))
+        # When not stored, compute Doppler factors / parallactic angles
+        # from the observatory + source geometry (the reference gets
+        # them from PSRCHIVE, pplib.py:2697-2708); unity/zero fallback
+        # when the coordinates are unknown.
+        if doppler_factors is None or parallactic_angles is None:
+            from ..utils.ephem import doppler_parangle_for_archive
+
+            dfs, pas = doppler_parangle_for_archive(
+                self.epochs, ephemeris_text, telescope)
+            if doppler_factors is None:
+                doppler_factors = dfs if dfs is not None \
+                    else np.ones(self.nsub)
+            if parallactic_angles is None:
+                parallactic_angles = pas if pas is not None \
+                    else np.zeros(self.nsub)
+        self.doppler_factors = np.asarray(doppler_factors)
+        self.parallactic_angles = np.asarray(parallactic_angles)
         self.filename = filename
 
     def copy(self):
@@ -331,10 +342,13 @@ def read_archive(filename):
     state = str(sh.get("STATE", "")).strip() or \
         {"IQUV": "Stokes", "AABBCRCI": "Coherence"}.get(pol_type,
                                                         "Intensity")
-    dop = np.asarray(cols.get("DOPPLER", np.ones(nsub)),
-                     dtype=np.float64).reshape(nsub)
-    par = np.asarray(cols.get("PAR_ANG", np.zeros(nsub)),
-                     dtype=np.float64).reshape(nsub)
+    # absent columns -> None so Archive computes them from geometry
+    dop = cols.get("DOPPLER")
+    if dop is not None:
+        dop = np.asarray(dop, dtype=np.float64).reshape(nsub)
+    par = cols.get("PAR_ANG")
+    if par is not None:
+        par = np.asarray(par, dtype=np.float64).reshape(nsub)
     return Archive(
         data, freqs, weights, Ps, epochs, durations,
         DM=float(sh.get("DM", 0.0)),

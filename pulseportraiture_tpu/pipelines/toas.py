@@ -860,6 +860,69 @@ class GetTOAs:
             print("Total time: %.2f sec, ~%.4f sec/TOA"
                   % (tot, tot / max(len(self.TOA_list), 1)))
 
+    def get_psrchive_TOAs(self, datafile=None, tscrunch=False,
+                          algorithm="PGS", toa_format="tempo2",
+                          flags="IPTA", attributes=("chan", "subint"),
+                          quiet=None):
+        """Narrowband TOAs via the external PSRCHIVE 'pat' machinery —
+        a cross-validation hook against an independent implementation
+        (ref /root/reference/pptoas.py:1127-1199).  Requires the
+        optional ``psrchive`` python bindings; raises a clear
+        RuntimeError when they are not installed (they are not part of
+        this framework — the native equivalent is
+        ``get_narrowband_TOAs``).  Results accumulate (as TOA-line
+        strings per archive) on self.psrchive_toas.
+        """
+        if quiet is None:
+            quiet = self.quiet
+        try:
+            import psrchive as pr
+        except ImportError as e:
+            raise RuntimeError(
+                "get_psrchive_TOAs needs the external PSRCHIVE python "
+                "bindings (the cross-check path); use "
+                "get_narrowband_TOAs for the native equivalent.") from e
+        self.psrchive_toas = []
+        arrtim = pr.ArrivalTime()
+        arrtim.set_shift_estimator(algorithm)
+        arrtim.set_format(toa_format)
+        arrtim.set_format_flags(flags)
+        arrtim.set_attributes(list(attributes))
+        datafiles = self.datafiles if datafile is None else [datafile]
+        if self.is_FITS_model:
+            model_arch = pr.Archive_load(self.modelfile)
+            model_arch.pscrunch()
+            arrtim.set_standard(model_arch)
+        for datafile in datafiles:
+            arch = pr.Archive_load(datafile)
+            arch.pscrunch()
+            if tscrunch:
+                arch.tscrunch()
+            arrtim.set_observation(arch)
+            if not self.is_FITS_model:
+                # fill a clone with the evaluated model as the standard
+                from ..ops.fourier import get_bin_centers
+
+                nchan, nbin = arch.get_nchan(), arch.get_nbin()
+                freqs = np.array([arch.get_Integration(0)
+                                  .get_centre_frequency(ic)
+                                  for ic in range(nchan)])
+                P = arch.get_Integration(0).get_folding_period()
+                model = self._build_model(
+                    freqs, np.asarray(get_bin_centers(nbin)), P,
+                    fit_scat=False)
+                model_arch = arch.clone()
+                model_arch.tscrunch()
+                sub = model_arch.get_Integration(0)
+                for ipol in range(arch.get_npol()):
+                    for ichan in range(nchan):
+                        prof = sub.get_Profile(ipol, ichan)
+                        prof.get_amps()[:] = model[ichan]
+                        sub.set_weight(ichan, 1.0)
+                arrtim.set_standard(model_arch)
+            self.psrchive_toas.append(arrtim.get_toas())
+        return self.psrchive_toas
+
     def write_TOAs(self, outfile=None, nu_ref=None, format="tempo2",
                    SNR_cutoff=0.0, append=True):
         """Write the accumulated TOA_list to a .tim file."""

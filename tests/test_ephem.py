@@ -1,0 +1,132 @@
+"""Tests: Doppler factors and parallactic angles from geometry."""
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.utils.ephem import (doppler_factor,
+                                              earth_velocity_kms,
+                                              gmst_rad, itrf_to_geodetic,
+                                              parallactic_angle,
+                                              parse_ra_dec,
+                                              OBSERVATORY_ITRF)
+
+
+def test_earth_velocity_magnitude_and_annual_cycle():
+    mjds = 56000.0 + np.linspace(0.0, 365.25, 200)
+    v = earth_velocity_kms(mjds)
+    speed = np.linalg.norm(v, axis=-1)
+    # orbital speed varies between ~29.29 (aphelion) and ~30.29 km/s
+    assert 29.2 < speed.min() < 29.4
+    assert 30.2 < speed.max() < 30.4
+    # yearly mean nearly vanishes (closed orbit; residual from uniform
+    # time sampling of the eccentric anomaly)
+    assert np.linalg.norm(v.mean(axis=0)) < 0.3
+
+
+def test_doppler_factor_ecliptic_geometry():
+    mjds = 56000.0 + np.linspace(0.0, 365.25, 400)
+    # source near the ecliptic plane: annual amplitude ~ v_orb/c ~ 1e-4
+    df_ecl = doppler_factor(mjds, ra=0.0, dec=0.0, telescope="GBT")
+    assert np.max(np.abs(df_ecl - 1.0)) > 8.5e-5
+    assert np.max(np.abs(df_ecl - 1.0)) < 1.1e-4
+    # source at the north ecliptic pole (ra=18h, dec=66.56 deg): the
+    # orbital term projects out; only diurnal rotation (<1.6e-6) remains
+    df_pole = doppler_factor(mjds, ra=18.0 * 2 * np.pi / 24.0,
+                             dec=np.radians(66.5607), telescope="GBT")
+    assert np.max(np.abs(df_pole - 1.0)) < 4e-6
+
+
+def test_geodetic_gbt():
+    lat, lon, h = itrf_to_geodetic(OBSERVATORY_ITRF["GBT"])
+    # Green Bank: 38.4331 N, 79.8398 W, ~800 m
+    assert abs(np.degrees(lat) - 38.433) < 0.01
+    assert abs(np.degrees(lon) + 79.840) < 0.01
+    assert 600.0 < h < 1000.0
+
+
+def test_parallactic_angle_transit():
+    lat, lon, _ = itrf_to_geodetic(OBSERVATORY_ITRF["GBT"])
+    ra, dec = 1.3, 0.1
+    # find an epoch of upper transit: gmst + lon = ra
+    mjd0 = 56000.0
+    ha0 = (gmst_rad(mjd0) + lon - ra) % (2 * np.pi)
+    mjd_t = mjd0 + ((2 * np.pi - ha0) % (2 * np.pi)) / \
+        (2 * np.pi * 1.0027379) % 1.0
+    q0 = parallactic_angle(mjd_t, ra, dec, "GBT")
+    assert abs(q0) < 0.01
+    # antisymmetric about transit for dec < lat
+    qm = parallactic_angle(mjd_t - 0.04, ra, dec, "GBT")
+    qp = parallactic_angle(mjd_t + 0.04, ra, dec, "GBT")
+    assert qm < 0 < qp or qp < 0 < qm
+    assert abs(qm + qp) < 0.02
+
+
+def test_parse_ra_dec():
+    ra, dec = parse_ra_dec("PSR J0437\nRAJ 04:37:15.8\nDECJ -47:15:09\n"
+                           "F0 173.7\n")
+    assert abs(ra - (4 + 37 / 60 + 15.8 / 3600) * 2 * np.pi / 24) < 1e-12
+    assert abs(np.degrees(dec) + (47 + 15 / 60 + 9 / 3600)) < 1e-9
+    assert parse_ra_dec("F0 100\nDM 10\n") is None
+
+
+def test_archive_doppler_roundtrip(tmp_path):
+    """Fake archives get real geometric Doppler factors; bary=True
+    scales DMs by them; values round-trip through the FITS layer."""
+    from pulseportraiture_tpu.io.archive import make_fake_pulsar
+    from pulseportraiture_tpu.io.gmodel import write_model
+    from pulseportraiture_tpu.io.psrfits import read_archive
+    from pulseportraiture_tpu.pipelines.toas import GetTOAs
+
+    gm = str(tmp_path / "f.gmodel")
+    write_model(gm, "fake", "000", 1500.0,
+                np.array([0.02, 0.0, 0.40, 0.0, 0.05, 0.0, 1.0, 0.0]),
+                np.ones(8, int), -4.0, 0, quiet=True)
+    par = str(tmp_path / "f.par")
+    with open(par, "w") as f:
+        # an ecliptic-plane source: |df - 1| up to ~1e-4
+        f.write("PSR J0\nRAJ 12:00:00\nDECJ 00:20:00\nF0 100.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    arc = str(tmp_path / "a.fits")
+    make_fake_pulsar(gm, par, arc, nsub=2, nchan=16, nbin=128,
+                     nu0=1500.0, bw=800.0, tsub=60.0, noise_stds=0.004,
+                     dedispersed=True, seed=7, quiet=True)
+    arch = read_archive(arc)
+    df = arch.doppler_factors
+    assert np.all(df != 1.0)
+    assert np.all(np.abs(df - 1.0) < 1.2e-4)
+    # round-trip: the stored values are reread exactly
+    arch.unload(str(tmp_path / "b.fits"), quiet=True)
+    arch2 = read_archive(str(tmp_path / "b.fits"))
+    np.testing.assert_allclose(arch2.doppler_factors, df, rtol=0, atol=0)
+    np.testing.assert_allclose(arch2.parallactic_angles,
+                               arch.parallactic_angles, rtol=0, atol=0)
+    # bary=True multiplies fitted DMs by the per-subint factor
+    topo = GetTOAs([arc], gm, quiet=True)
+    topo.get_TOAs(bary=False)
+    bary = GetTOAs([arc], gm, quiet=True)
+    bary.get_TOAs(bary=True)
+    np.testing.assert_allclose(bary.DMs[0], topo.DMs[0] * df, rtol=1e-12)
+    # parallactic angle lands on the TOA line when requested
+    pa = GetTOAs([arc], gm, quiet=True)
+    pa.get_TOAs(bary=False, print_parangle=True)
+    assert all(t.flags["par_angle"] != 0.0 for t in pa.TOA_list)
+
+
+def test_ecliptic_coords_and_fallback_warning(tmp_path):
+    from pulseportraiture_tpu.utils.ephem import (
+        doppler_parangle_for_archive, precess_from_j2000)
+    from pulseportraiture_tpu.utils.mjd import MJD
+
+    epochs = [MJD.from_mjd(56000.0)]
+    # ELONG/ELAT ephemeris works
+    dfs, pas = doppler_parangle_for_archive(
+        epochs, "ELONG 120.0\nELAT 3.0\n", "GBT")
+    assert dfs is not None and abs(dfs[0] - 1.0) < 1.2e-4
+    # unknown telescope warns loudly instead of failing silently
+    with pytest.warns(UserWarning, match="topocentric"):
+        dfs, pas = doppler_parangle_for_archive(
+            epochs, "RAJ 12:00:00\nDECJ 00:00:00\n", "SPACE_DISH_9")
+    assert dfs is None
+    # precession: J2000 pole stays within ~0.4 deg of the of-date pole
+    n = precess_from_j2000(61000.0, np.array([0.0, 0.0, 1.0]))
+    assert n[2] > 0.99997

@@ -140,3 +140,43 @@ def test_nu_refs_honored(fake_archives):
     ok = gt.ok_isubs[0]
     np.testing.assert_allclose(gt.nu_refs[0][ok][:, 0], 1400.0)
     assert all(abs(t.frequency - 1400.0) < 1e-9 for t in gt.TOA_list)
+
+
+def test_two_channel_degraded_mode(fixture_dir):
+    """A 2-live-channel subint demotes only the GM flag (reference
+    pptoas.py:474-484 semantics) and still runs under fit_scat."""
+    tmp, gmodel, par = fixture_dir
+    out = str(tmp / "twochan.fits")
+    make_fake_pulsar(gmodel, par, out, nsub=2, nchan=8, nbin=128,
+                     nu0=1500.0, bw=800.0, tsub=60.0, noise_stds=0.004,
+                     dedispersed=True, seed=23, quiet=True)
+    # zap all but two channels of subint 1
+    from pulseportraiture_tpu.io.psrfits import read_archive
+
+    arch = read_archive(out)
+    arch.weights[1, :6] = 0.0
+    arch.unload(out, quiet=True)
+    gt = GetTOAs([out], gmodel, quiet=True)
+    gt.get_TOAs(bary=False, fit_DM=True, fit_GM=True, fit_scat=True,
+                fix_alpha=True)
+    # subint 0: full flags except alpha; subint 1: GM demoted
+    t0 = next(t for t in gt.TOA_list if t.flags["subint"] == 0)
+    t1 = next(t for t in gt.TOA_list if t.flags["subint"] == 1)
+    assert "gm" in t0.flags and "scat_time" in t0.flags
+    assert "gm" not in t1.flags and "scat_time" in t1.flags
+    assert t1.flags["nchx"] == 2
+    assert t1.DM is not None  # phi + DM survive the demotion
+
+
+def test_psrchive_cross_check_gate(fake_archives):
+    """The PSRCHIVE cross-validation hook fails loudly (not silently)
+    when the external bindings are absent."""
+    files, phases, dDMs, gmodel = fake_archives
+    gt = GetTOAs(files[:1], gmodel, quiet=True)
+    try:
+        import psrchive  # noqa: F401
+        pytest.skip("psrchive installed; gate not testable")
+    except ImportError:
+        pass
+    with pytest.raises(RuntimeError, match="PSRCHIVE"):
+        gt.get_psrchive_TOAs()
