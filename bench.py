@@ -1,20 +1,41 @@
-"""Benchmark: batched wideband TOA+DM fitting throughput.
+"""Benchmark: batched wideband TOA+DM fitting throughput + parity.
 
 North-star config (BASELINE.md): 1000 subints x 512 channels x 2048
-bins, phase+DM joint fit, single chip, target < 60 s with ~ns-level
-residuals vs the injected truth.  Prints ONE JSON line:
-{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+bins, phase+DM joint fit, single chip, target < 60 s with TOA residuals
+within 1 ns of the SciPy reference.  Prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": ...}.
 
 vs_baseline is measured throughput / target throughput (1000 fits/60 s);
 > 1 beats the north-star target.  The fit batch is processed in chunks
 sized to HBM; every chunk reuses one compiled executable.
+
+extra carries the other BASELINE.md configs and the accuracy criterion:
+- parity_scipy_max_ns / parity_cpu_f64_max_ns: max |device - oracle| TOA
+  residual on identical data (target < 1 ns).  The SciPy oracle is the
+  independent Nelder-Mead+Powell minimizer from tests/oracle.py; the
+  CPU-f64 oracle is this framework's own kernel at full precision.
+- scat_fits_per_sec: the joint phase+DM+tau+alpha fit (flags 11011).
+- ipta_fits_per_sec: the 20 pulsars x 10 epochs sharded sweep
+  (parallel.sharded_fit.ipta_sweep_fit).
+- gflops_approx: rough sustained FLOP/s from an rFFT+iteration count.
 """
 
+import importlib.util
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+
+def _load_oracle():
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tests", "oracle.py")
+    spec = importlib.util.spec_from_file_location("pp_bench_oracle", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def main():
@@ -30,12 +51,19 @@ def main():
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
     if on_accel:
-        nsub, nchan, nbin, chunk = 1000, 512, 2048, 125
+        # chunk sized to HBM for the f64 pair path (~150 MB/subint of
+        # program temporaries at 512x2048)
+        nsub, nchan, nbin, chunk = 1000, 512, 2048, 40
     else:  # CPU smoke config (first-slice scale from BASELINE.md)
         nsub, nchan, nbin, chunk = 64, 128, 1024, 32
     P0 = 0.005
     noise = 0.05
+    # generation/storage dtype; the timed fits run in FULL f64 on every
+    # backend — on TPU via the complex128-free (re, im) pair path
+    # (ops.fourier.rfft_pair + pair moments), which is what holds the
+    # <1 ns oracle-parity criterion at speed
     dtype = jnp.float32 if on_accel else jnp.float64
+    fit_dtype = jnp.float64
 
     model_params = np.array([0.0, 0.0, 0.35, -0.05, 0.05, 0.1, 1.0, -1.2],
                             dtype=np.float32 if on_accel else np.float64)
@@ -68,15 +96,17 @@ def main():
         chunks.append(make_chunk(i0, i1, keys[ci]))
     jax.block_until_ready(chunks)
 
-    errs = jnp.full((chunk, nchan), noise, dtype)
+    errs = jnp.full((chunk, nchan), noise, fit_dtype)
     Ps = jnp.full((chunk,), P0, jnp.float64)
     freqs_b = jnp.broadcast_to(freqs_j, (chunk, nchan))
     model_b = jnp.broadcast_to(model, (chunk, nchan, nbin))
+    model_b64 = model_b.astype(fit_dtype)
 
     def fit_chunk(data, init):
         out = fit_portrait_full_batch(
-            data, model_b, init, Ps, freqs_b, errs=errs,
-            fit_flags=(1, 1, 0, 0, 0), log10_tau=False, max_iter=30)
+            data.astype(fit_dtype), model_b64, init, Ps, freqs_b,
+            errs=errs, fit_flags=(1, 1, 0, 0, 0), log10_tau=False,
+            max_iter=30)
         return out
 
     # warm-up compile on the first chunk (guess + fit)
@@ -112,12 +142,138 @@ def main():
     DM = np.concatenate([np.asarray(d) for d in DMs])
     nu_ref = np.concatenate([np.asarray(n) for n in nus])
     phi_err = np.concatenate([np.asarray(e) for e in phi_errs])
-    nu0 = freqs.mean()
+    nu0 = float(freqs.mean())
     phi_at_nu0 = phi + Dconst * DM / P0 * (nu0 ** -2.0 - nu_ref ** -2.0)
     resid = (phi_at_nu0 - phis_inj + 0.5) % 1.0 - 0.5
     resid_ns = resid * P0 * 1e9
     # noise-normalized: |residual| / reported error (should be ~1)
     zscore = np.median(np.abs(resid) / phi_err)
+
+    # ---- parity vs oracles (the BASELINE <1 ns criterion) -------------
+    # pin nu_fit = nu_out = nu0 on all paths so phi/DM compare directly
+    K_cpu = min(32, chunk)
+    K_scipy = 4
+    data_par = chunks[0][:K_cpu]
+    nus_pin = np.tile([nu0, nu0, nu0], (K_cpu, 1))
+    init_par = np.zeros((K_cpu, 5))
+    init_par[:, 0] = phis_inj[:K_cpu]
+    init_par[:, 1] = dDMs_inj[:K_cpu]
+
+    def pinned_fit(data, nsel, dtype_sel):
+        return fit_portrait_full_batch(
+            jnp.asarray(data, dtype_sel), model_b[:nsel].astype(dtype_sel),
+            init_par[:nsel], Ps[:nsel], freqs_b[:nsel],
+            errs=errs[:nsel].astype(dtype_sel),
+            fit_flags=(1, 1, 0, 0, 0), nu_fits=nus_pin[:nsel],
+            nu_outs=(nus_pin[:nsel, 0], nus_pin[:nsel, 1],
+                     nus_pin[:nsel, 2]),
+            log10_tau=False, max_iter=50)
+
+    dev_out = pinned_fit(data_par, K_cpu, fit_dtype)
+    dev_phi = np.asarray(dev_out.phi)
+    dev_DM = np.asarray(dev_out.DM)
+    # CPU f64 oracle: identical data/inits through the same kernel at
+    # full precision on the host backend
+    data_np = np.asarray(data_par, np.float64)
+    cpu_dev = jax.devices("cpu")[0]
+    with jax.default_device(cpu_dev):
+        cpu_out = pinned_fit(data_np, K_cpu, jnp.float64)
+        cpu_phi = np.asarray(cpu_out.phi)
+        cpu_DM = np.asarray(cpu_out.DM)
+    dphi = (dev_phi - cpu_phi + 0.5) % 1.0 - 0.5
+    # TOA parity at nu0 (phi already referenced to nu0 on both paths)
+    parity_cpu_ns = float(np.max(np.abs(dphi)) * P0 * 1e9)
+
+    # SciPy oracle (independent optimizer) on a small subset
+    oracle = _load_oracle()
+    parity_scipy = []
+    for i in range(K_scipy):
+        x, _ = oracle.oracle_fit(
+            data_np[i], np.asarray(model_b[i], np.float64),
+            init_par[i], P0, np.asarray(freqs, np.float64),
+            fit_flags=(1, 1, 0, 0, 0), log10_tau=False,
+            noise=np.full(nchan, noise), nu_fits=nu0)
+        d = (dev_phi[i] - x[0] + 0.5) % 1.0 - 0.5
+        parity_scipy.append(abs(d) * P0 * 1e9)
+    parity_scipy_ns = float(np.max(parity_scipy))
+
+    # ---- scattering joint fit (flags 11011, log10 tau) ----------------
+    scat_B = chunk
+    tau_inj = 3e-3  # rot at nu0
+    from pulseportraiture_tpu.ops.scattering import (scattering_portrait_FT,
+                                                     scattering_times)
+    # built fully on device: the axon tunnel cannot transfer complex
+    # buffers to host (config.host_array), so keep the spectra there
+    taus_chan = scattering_times(tau_inj, -4.0, jnp.asarray(freqs), nu0)
+    spFT = scattering_portrait_FT(taus_chan, nbin)
+    scat_model = jnp.fft.irfft(spFT * jnp.fft.rfft(model, axis=-1),
+                               nbin, axis=-1).astype(dtype)
+    ph_s = jnp.asarray(phis_inj[:scat_B])
+    dm_s = jnp.asarray(dDMs_inj[:scat_B])
+    scat_base = jax.vmap(
+        lambda p, d: rotate_data(scat_model, -p, -d, P0, freqs_j,
+                                 nu0))(ph_s, dm_s)
+    scat_data = np.asarray(scat_base) + np.asarray(
+        noise * jax.random.normal(jax.random.key(3), scat_base.shape,
+                                  dtype))
+    scat_init = np.zeros((scat_B, 5))
+    scat_init[:, 0] = phis_inj[:scat_B]
+    scat_init[:, 1] = dDMs_inj[:scat_B]
+    scat_init[:, 3] = np.log10(tau_inj * 1.5)
+    scat_init[:, 4] = -4.0
+
+    nus_pin_s = np.tile([nu0, nu0, nu0], (scat_B, 1))
+
+    def scat_fit():
+        return fit_portrait_full_batch(
+            jnp.asarray(scat_data, dtype), model_b, scat_init, Ps,
+            freqs_b, errs=errs, fit_flags=(1, 1, 0, 1, 1),
+            nu_fits=nus_pin_s,
+            nu_outs=(nus_pin_s[:, 0], nus_pin_s[:, 1], nus_pin_s[:, 2]),
+            log10_tau=True, max_iter=30)
+
+    jax.block_until_ready(scat_fit().phi)  # compile
+    t0 = time.time()
+    sout = scat_fit()
+    jax.block_until_ready(sout.phi)
+    scat_dur = time.time() - t0
+    tau_fit = np.median(10 ** np.asarray(sout.tau))
+
+    # ---- IPTA sweep: 20 pulsars x 10 epochs (sharded path) ------------
+    from pulseportraiture_tpu.parallel.sharded_fit import ipta_sweep_fit
+
+    np_, ne, inchan, inbin = 20, 10, 128, 1024
+    i_model_params = model_params.astype(np.float64)
+    i_freqs = np.linspace(1300.0, 1700.0, inchan) + 400.0 / inchan / 2
+    i_phases = np.asarray(get_bin_centers(inbin))
+    i_model = np.asarray(gen_gaussian_portrait(
+        "000", i_model_params, -4.0, i_phases, i_freqs, 1500.0))
+    i_rng = np.random.default_rng(2)
+    i_data = (np.broadcast_to(i_model, (np_ * ne, inchan, inbin))
+              + i_rng.normal(0, noise, (np_ * ne, inchan, inbin))) \
+        .astype(np.float32 if on_accel else np.float64)
+
+    def ipta_run():
+        return ipta_sweep_fit(
+            jnp.asarray(i_data, dtype), jnp.asarray(i_model, dtype),
+            np.zeros(5), np.full(np_ * ne, P0), jnp.asarray(i_freqs),
+            errs=np.full((np_ * ne, inchan), noise),
+            fit_flags=(1, 1, 0, 0, 0), log10_tau=False, max_iter=20)
+
+    jax.block_until_ready(ipta_run().phi)  # compile
+    t0 = time.time()
+    iout = ipta_run()
+    jax.block_until_ready(iout.phi)
+    ipta_dur = time.time() - t0
+
+    # ---- rough sustained FLOP/s for the main config -------------------
+    # per subint: rFFT (5 N log2 N per channel) + ~n_iter fused moment
+    # passes of ~40 flops per (channel, harmonic)
+    nharm = nbin // 2 + 1
+    niter = 30
+    flops_per_sub = nchan * 5.0 * nbin * np.log2(nbin) \
+        + niter * 40.0 * nchan * nharm
+    gflops = nsub * flops_per_sub / duration / 1e9
 
     toas_per_sec = nsub / duration
     target = 1000.0 / 60.0  # north-star: 1000 fits in 60 s
@@ -134,6 +290,16 @@ def main():
             "median_resid_over_err": round(float(zscore), 3),
             "median_reported_err_ns": round(float(np.median(phi_err)
                                                   * P0 * 1e9), 3),
+            "parity_scipy_max_ns": round(parity_scipy_ns, 4),
+            "parity_cpu_f64_max_ns": round(parity_cpu_ns, 4),
+            "parity_cpu_f64_max_dDM": round(float(np.max(np.abs(
+                dev_DM - cpu_DM))), 9),
+            "scat_fits_per_sec": round(scat_B / scat_dur, 3),
+            "scat_tau_rel_err": round(abs(tau_fit - tau_inj) / tau_inj,
+                                      4),
+            "ipta_fits_per_sec": round(np_ * ne / ipta_dur, 3),
+            "ipta_config": f"{np_}x{ne}x{inchan}x{inbin}",
+            "gflops_approx": round(float(gflops), 1),
         },
     }
     print(json.dumps(result))

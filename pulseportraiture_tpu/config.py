@@ -129,6 +129,22 @@ def as_fft_operand(x):
     return x.astype(fft_real_dtype(x.dtype))
 
 
+def host_array(x):
+    """Device array -> numpy, transferring complex values as two real
+    planes.
+
+    Some TPU transports (the axon remote-compile tunnel here) cannot
+    transfer complex buffers device->host at all ("UNIMPLEMENTED", and
+    the failed transfer wedges the client) — every host materialization
+    of a possibly-complex device array must go through this helper
+    instead of np.asarray.
+    """
+    x = jnp.asarray(x)
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        return np.asarray(jnp.real(x)) + 1j * np.asarray(jnp.imag(x))
+    return np.asarray(x)
+
+
 __all__ = [
     "Dconst",
     "Dconst_exact",

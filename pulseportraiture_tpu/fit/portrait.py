@@ -38,7 +38,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import Dconst, F0_fact, as_fft_operand
+from ..config import (Dconst, F0_fact, as_fft_operand,
+                      backend_supports_complex128)
+from ..ops.fourier import rfft_pair
 from ..ops.noise import get_noise
 from ..ops.scattering import (
     abs_scattering_portrait_FT_2deriv,
@@ -83,8 +85,19 @@ def _moments(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
     phi, DM, GM, tau_p, alpha = (params[0], params[1], params[2], params[3],
                                  params[4])
     tau = 10 ** tau_p if log10_tau else tau_p
-    nharm = cross.shape[-1]
-    real_dtype = cross.real.dtype
+    # ``cross`` is either complex [nchan, nharm] or an f64 (re, im) pair
+    # — the pair form is the TPU full-precision representation (c128
+    # does not compile there; see ops.fourier.rfft_pair)
+    pair = isinstance(cross, tuple)
+    if pair:
+        cross_re, cross_im = cross
+        nharm = cross_re.shape[-1]
+        nchan = cross_re.shape[0]
+        real_dtype = cross_re.dtype
+    else:
+        nharm = cross.shape[-1]
+        nchan = cross.shape[0]
+        real_dtype = cross.real.dtype
     k64 = jnp.arange(nharm, dtype=jnp.float64)
     k = k64.astype(real_dtype)
 
@@ -94,14 +107,19 @@ def _moments(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
         + (Dconst ** 2) * GM * (freqs ** -4 - nu_GM ** -4) / P
     frac = ((shifts[:, None] * k64) % 1.0).astype(real_dtype)
     ang = 2.0 * jnp.pi * frac
-    phsr = jax.lax.complex(jnp.cos(ang), jnp.sin(ang))
 
-    nchan = cross.shape[0]
     tpk = 2.0 * jnp.pi * k
     if not scat:
         # fast path: B == 1 identically; no scattering temporaries
-        core = cross * phsr                      # [nchan, nharm]
-        C = jnp.sum(jnp.real(core), axis=-1) * inv_err2
+        if pair:  # real-pair product: (cr + i ci) (cos + i sin)
+            cp, sp = jnp.cos(ang), jnp.sin(ang)
+            core_re = cross_re * cp - cross_im * sp
+            core_im = cross_re * sp + cross_im * cp
+        else:
+            phsr = jax.lax.complex(jnp.cos(ang), jnp.sin(ang))
+            core = cross * phsr                  # [nchan, nharm]
+            core_re, core_im = jnp.real(core), jnp.imag(core)
+        C = jnp.sum(core_re, axis=-1) * inv_err2
         S = jnp.sum(abs_m2, axis=-1) * inv_err2
         out = {"C": C, "S": S}
         if order < 1:
@@ -109,19 +127,25 @@ def _moments(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
         # cast to the objective dtype so the Hessian scatter below never
         # mixes f64 products into an f32 array (future-error in JAX)
         pd = _phase_shift_derivs(freqs, nu_DM, nu_GM, P).astype(C.dtype)
-        T1 = -jnp.sum(tpk * jnp.imag(core), axis=-1) * inv_err2
+        T1 = -jnp.sum(tpk * core_im, axis=-1) * inv_err2
         dC = jnp.concatenate([T1[None] * pd,
                               jnp.zeros((2, nchan), C.dtype)])
         dS = jnp.zeros((5, nchan), C.dtype)
         out.update(dC=dC, dS=dS)
         if order < 2:
             return out
-        T2 = -jnp.sum(tpk ** 2 * jnp.real(core), axis=-1) * inv_err2
+        T2 = -jnp.sum(tpk ** 2 * core_re, axis=-1) * inv_err2
         d2C = jnp.zeros((5, 5, nchan), dtype=C.dtype)
         d2C = d2C.at[:3, :3].set(T2[None, None] * pd[:, None]
                                  * pd[None, :])
         out.update(d2C=d2C, d2S=jnp.zeros((5, 5, nchan), C.dtype))
         return out
+
+    if pair:
+        raise NotImplementedError(
+            "the f64 pair representation covers the no-scattering fast "
+            "path only; scattering fits use the complex path")
+    phsr = jax.lax.complex(jnp.cos(ang), jnp.sin(ang))
 
     # scattering chain in the data's real dtype (complex128-free on TPU)
     taus = scattering_times(tau, alpha, freqs, nu_tau).astype(real_dtype)
@@ -568,7 +592,7 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
                       nu_outs=(None, None, None), errs=None, weights=None,
                       fit_flags=(1, 1, 1, 1, 1), bounds=None,
                       log10_tau=True, option=0, max_iter=50, is_toa=True,
-                      quiet=True, scat=None):
+                      quiet=True, scat=None, pair=None):
     """Fit (phi, DM, GM, tau, alpha) between one data and model portrait.
 
     Behavioral equivalent of /root/reference/pptoaslib.py:928-1096,
@@ -595,10 +619,6 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
     nfit = len(ifit)
     dof = data_port.size - (nfit + nchan)
 
-    dFFT = jnp.fft.rfft(as_fft_operand(data_port),
-                        axis=-1).at[..., 0].multiply(F0_fact)
-    mFFT = jnp.fft.rfft(as_fft_operand(model_port),
-                        axis=-1).at[..., 0].multiply(F0_fact)
     if errs is None:
         errs_FT = get_noise(data_port) * jnp.sqrt(nbin / 2.0)
     else:
@@ -611,9 +631,31 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
         inv_err2 = jnp.where(wmask, inv_err2, 0.0)
         nchan_ok = wmask.sum()
         dof = nbin * nchan_ok - (nfit + nchan_ok)
-    cross = dFFT * jnp.conj(mFFT)
-    abs_m2 = jnp.abs(mFFT) ** 2
-    Sd = jnp.sum(jnp.abs(dFFT) ** 2 * inv_err2[:, None])
+    # Full-precision (f64) fits on a backend without complex128 (TPU)
+    # take the (re, im) pair path: DFT-matmul spectra + real-pair
+    # moments.  This is what holds TOA parity with the f64 oracle at
+    # <1 ns on device; complex64 would cap phase precision near 1e-5
+    # rot.  (Pair moments cover the no-scattering configuration only.)
+    use_pair = pair if pair is not None else (
+        data_port.dtype == jnp.float64 and not scat
+        and not backend_supports_complex128())
+    if use_pair and scat:
+        raise ValueError("pair=True covers no-scattering fits only")
+    if use_pair:
+        dre, dim = rfft_pair(data_port)
+        mre, mim = rfft_pair(jnp.asarray(model_port, jnp.float64))
+        # d * conj(m) as real pairs
+        cross = (dre * mre + dim * mim, dim * mre - dre * mim)
+        abs_m2 = mre ** 2 + mim ** 2
+        Sd = jnp.sum((dre ** 2 + dim ** 2) * inv_err2[:, None])
+    else:
+        dFFT = jnp.fft.rfft(as_fft_operand(data_port),
+                            axis=-1).at[..., 0].multiply(F0_fact)
+        mFFT = jnp.fft.rfft(as_fft_operand(model_port),
+                            axis=-1).at[..., 0].multiply(F0_fact)
+        cross = dFFT * jnp.conj(mFFT)
+        abs_m2 = jnp.abs(mFFT) ** 2
+        Sd = jnp.sum(jnp.abs(dFFT) ** 2 * inv_err2[:, None])
 
     nu_fit_DM, nu_fit_GM, nu_fit_tau = [
         freqs.mean() if nf is None else nf for nf in nu_fits]

@@ -182,7 +182,7 @@ def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
     """
     import jax
 
-    from ..config import Dconst
+    from ..config import Dconst, host_array
     from ..ops.fourier import add_DM_nu, rotate_data
     from ..ops.scattering import scattering_portrait_FT, scattering_times
     from ..pipelines.synth import add_scintillation
@@ -225,7 +225,7 @@ def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
         if t_scat:
             taus = np.asarray(scattering_times(t_scat / P, alpha, freqs,
                                                nu0))
-            sp_FT = np.asarray(scattering_portrait_FT(taus, nbin))
+            sp_FT = host_array(scattering_portrait_FT(taus, nbin))
             rotmodel = np.fft.irfft(sp_FT * np.fft.rfft(rotmodel, axis=-1),
                                     nbin, axis=-1)
         if scint is not False:

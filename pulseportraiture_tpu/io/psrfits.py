@@ -85,8 +85,11 @@ class Archive:
         if doppler_factors is None or parallactic_angles is None:
             from ..utils.ephem import doppler_parangle_for_archive
 
+            # only warn when the Doppler factors themselves (the
+            # barycentric-correction input) are the missing quantity
             dfs, pas = doppler_parangle_for_archive(
-                self.epochs, ephemeris_text, telescope)
+                self.epochs, ephemeris_text, telescope,
+                warn=doppler_factors is None)
             if doppler_factors is None:
                 doppler_factors = dfs if dfs is not None \
                     else np.ones(self.nsub)

@@ -23,14 +23,18 @@ Design notes (TPU-first, not a translation):
   In the Fourier domain that is multiplication by exp(+2j*pi*k*phi_n).
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..config import Dconst, F0_fact, as_fft_operand, fft_real_dtype
 
 __all__ = [
     "nharm_for",
     "rfft_portrait",
+    "rfft_pair",
     "irfft_portrait",
     "phase_shifts",
     "phase_shifts_deriv",
@@ -68,6 +72,41 @@ def irfft_portrait(port_FT, nbin=None):
     if nbin is None:
         nbin = 2 * (port_FT.shape[-1] - 1)
     return jnp.fft.irfft(port_FT, n=nbin, axis=-1)
+
+
+@functools.lru_cache(maxsize=8)
+def _dft_tables(nbin):
+    """(cos, sin) [nharm, nbin] f64 DFT tables; angles formed from
+    (k*n) mod nbin so they are exact to f64 ulp at any size."""
+    k = np.arange(nbin // 2 + 1)
+    n = np.arange(nbin)
+    ang = 2.0 * np.pi * ((k[:, None] * n[None, :]) % nbin) / nbin
+    return np.cos(ang), np.sin(ang)
+
+
+def rfft_pair(x, zap_f0=True):
+    """Float64 rFFT as a (re, im) real pair via a DFT matmul.
+
+    The TPU-safe full-precision spectral path: complex128 does not
+    compile on TPU at all, but f64 matmuls do (XLA lowers them to
+    f32-pair arithmetic on the MXU), so an explicit [nharm, nbin] DFT
+    contraction delivers f64-accurate spectra where jnp.fft.rfft cannot.
+    Used by the fit kernel's f64 pair path (fit/portrait.py) that backs
+    the <1 ns TOA-parity requirement on device.
+
+    x: [..., nbin] real; returns (re, im) [..., nharm] float64 with the
+    rFFT sign convention (X_k = sum_n x_n e^{-2 pi i k n / N}) and the
+    usual F0_fact DC policy.
+    """
+    x = jnp.asarray(x, jnp.float64)
+    nbin = x.shape[-1]
+    C, S = _dft_tables(nbin)
+    re = jnp.einsum("...n,kn->...k", x, jnp.asarray(C))
+    im = -jnp.einsum("...n,kn->...k", x, jnp.asarray(S))
+    if zap_f0:
+        re = re.at[..., 0].multiply(F0_fact)
+        im = im.at[..., 0].multiply(F0_fact)
+    return re, im
 
 
 def phase_shifts(phi, DM, GM, freqs, nu_DM=jnp.inf, nu_GM=jnp.inf, P=None,

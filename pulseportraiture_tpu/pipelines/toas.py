@@ -14,7 +14,7 @@ import time
 
 import numpy as np
 
-from ..config import scattering_alpha
+from ..config import host_array, scattering_alpha
 from ..fit.phase_shift import fit_phase_shift
 from ..fit.portrait import fit_portrait_full_batch
 from ..fit.transforms import guess_fit_freq, phase_transform
@@ -167,7 +167,7 @@ class GetTOAs:
             return None, same_freqs
         if add_instrumental_response and (self.ird["DM"]
                                           or len(self.ird["wids"])):
-            irFT = np.asarray(instrumental_response_port_FT(
+            irFT = host_array(instrumental_response_port_FT(
                 nbin, freqs_b[0], self.ird["DM"], float(Ps_b[0]),
                 self.ird["wids"], self.ird["irf_types"]))
             models_b = np.fft.irfft(irFT * np.fft.rfft(models_b, axis=-1),
@@ -301,7 +301,7 @@ class GetTOAs:
                 taus_g = np.asarray(scattering_times(
                     tau_guess, alpha_guess, nu_fits_b[:, 2],
                     nu_fits_b[:, 2]))
-                spFT = np.asarray(scattering_portrait_FT(taus_g, nbin))
+                spFT = host_array(scattering_portrait_FT(taus_g, nbin))
                 model_profs = np.fft.irfft(
                     spFT * np.fft.rfft(model_profs, axis=-1), nbin,
                     axis=-1)
@@ -414,7 +414,7 @@ class GetTOAs:
                         tausx = np.asarray(scattering_times(
                             tau_lin, float(r["alpha"]), freqs_b[j][okc],
                             float(r["nu_tau"])))
-                        spFT = np.asarray(scattering_portrait_FT(tausx,
+                        spFT = host_array(scattering_portrait_FT(tausx,
                                                                  nbin))
                         scat_model = np.fft.irfft(
                             spFT * np.fft.rfft(mx, axis=-1), nbin, axis=-1)
@@ -684,7 +684,7 @@ class GetTOAs:
                 # phase guess vs the scattered model
                 taus_g = np.asarray(scattering_times(tau_g, alpha_guess,
                                                      nusx, nusx))
-                spFT = np.asarray(scattering_portrait_FT(taus_g, nbin))
+                spFT = host_array(scattering_portrait_FT(taus_g, nbin))
                 mods_scat = np.fft.irfft(spFT * np.fft.rfft(mods, axis=-1),
                                          nbin, axis=-1)
                 guess = fit_phase_shift(profs, mods_scat, noise=errsx,
@@ -761,7 +761,7 @@ class GetTOAs:
                     tau_lin = 10 ** taus_fit if log10_tau else taus_fit
                     tausx = np.asarray(scattering_times(
                         tau_lin, scattering_alpha, nusx, nusx))
-                    spFT = np.asarray(scattering_portrait_FT(tausx, nbin))
+                    spFT = host_array(scattering_portrait_FT(tausx, nbin))
                     scat_mods = np.fft.irfft(
                         spFT * np.fft.rfft(mods, axis=-1), nbin, axis=-1)
                 else:
@@ -873,8 +873,6 @@ class GetTOAs:
         ``get_narrowband_TOAs``).  Results accumulate (as TOA-line
         strings per archive) on self.psrchive_toas.
         """
-        if quiet is None:
-            quiet = self.quiet
         try:
             import psrchive as pr
         except ImportError as e:
@@ -996,12 +994,12 @@ class GetTOAs:
             taus = np.asarray(scattering_times(
                 tau_lin, self.alphas[ifile][isub], freqs,
                 self.nu_refs[ifile][isub][2]))
-            spFT = np.asarray(scattering_portrait_FT(taus, d.nbin))
+            spFT = host_array(scattering_portrait_FT(taus, d.nbin))
             model = np.fft.irfft(spFT * np.fft.rfft(model, axis=-1),
                                  d.nbin, axis=-1)
         if self.add_instrumental_response and (self.ird["DM"]
                                                or len(self.ird["wids"])):
-            irFT = np.asarray(instrumental_response_port_FT(
+            irFT = host_array(instrumental_response_port_FT(
                 d.nbin, freqs, self.ird["DM"], P, self.ird["wids"],
                 self.ird["irf_types"]))
             model = np.fft.irfft(irFT * np.fft.rfft(model, axis=-1),

@@ -123,8 +123,9 @@ def itrf_to_geodetic(xyz):
 
 _RA_RE = re.compile(r"^\s*RAJ?\s+([\d:.+-]+)", re.MULTILINE)
 _DEC_RE = re.compile(r"^\s*DECJ?\s+([\d:.+-]+)", re.MULTILINE)
-_ELONG_RE = re.compile(r"^\s*(?:ELONG|LAMBDA)\s+([\d.+-]+)", re.MULTILINE)
-_ELAT_RE = re.compile(r"^\s*(?:ELAT|BETA)\s+([\d.+-]+)", re.MULTILINE)
+_ELONG_RE = re.compile(r"^\s*(?:ELONG|LAMBDA)\s+([-+.\deE]+)",
+                       re.MULTILINE)
+_ELAT_RE = re.compile(r"^\s*(?:ELAT|BETA)\s+([-+.\deE]+)", re.MULTILINE)
 
 # IAU 2006 obliquity at J2000, for ecliptic-coordinate ephemerides
 _EPS0 = np.radians(84381.406 / 3600.0)
