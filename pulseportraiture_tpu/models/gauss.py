@@ -93,7 +93,22 @@ class GaussianModelPortrait(DataPortrait):
                 inband = (self.freqs[0] > self.nu_ref - bw_ref / 2) & \
                     (self.freqs[0] < self.nu_ref + bw_ref / 2) & \
                     (self.masks[0, 0].mean(axis=1) > 0)
-                profile = self.port[np.flatnonzero(inband)].mean(axis=0)
+                # align the bands with the seed join parameters for the
+                # profile used by automatic component seeding (the
+                # reference leaves this to the interactive selector);
+                # rotate a local copy — never the shared portrait state
+                iband = np.flatnonzero(inband)
+                band_port = np.array(self.port[iband])
+                if self.njoin:
+                    for ij in range(self.njoin):
+                        phi_j = self.join_params[2 * ij]
+                        DM_j = self.join_params[2 * ij + 1]
+                        sel = np.isin(iband, self.join_ichans[ij])
+                        if sel.any():
+                            band_port[sel] = np.asarray(rotate_data(
+                                band_port[sel], -phi_j, -DM_j, self.Ps[0],
+                                self.freqs[0, iband[sel]], self.nu_ref))
+                profile = band_port.mean(axis=0)
                 self.fit_profile(profile, tau=tau, fixscat=fixscat,
                                  auto_gauss=auto_gauss,
                                  max_ngauss=max_ngauss, quiet=quiet)
@@ -200,7 +215,9 @@ class GaussianModelPortrait(DataPortrait):
         full_params = np.concatenate(
             [self.model_params,
              self.join_params if self.njoin else np.array([])])
-        self.model = np.asarray(gen_gaussian_portrait(
+        # np.array (writable copy): the join path rotates bands of the
+        # model in place, and device-backed arrays are read-only
+        self.model = np.array(gen_gaussian_portrait(
             self.model_code, full_params, self.scattering_index,
             self.phases, self.freqs[0], self.nu_ref,
             self.join_ichans, self.Ps[0]))

@@ -1,0 +1,112 @@
+"""Tests: power-law/DM fitters, GM conversions, multi-band join."""
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.config import Dconst
+from pulseportraiture_tpu.dataportrait import DataPortrait
+from pulseportraiture_tpu.fit.powlaw import (DMc_from_GM, GM_from_DMc,
+                                             fit_DM_to_freq_resids,
+                                             fit_powlaw)
+from pulseportraiture_tpu.io.archive import make_fake_pulsar
+from pulseportraiture_tpu.io.gmodel import write_model
+
+MODEL_PARAMS = np.array([0.02, 0.0, 0.40, 0.0, 0.05, 0.0, 1.0, -1.2])
+
+
+def test_fit_powlaw_recovers():
+    rng = np.random.default_rng(0)
+    freqs = np.linspace(1200.0, 1800.0, 64)
+    true_A, true_alpha, nu_ref = 2.5, -1.7, 1500.0
+    flux = true_A * (freqs / nu_ref) ** true_alpha \
+        + rng.normal(0, 0.02, 64)
+    r = fit_powlaw(flux, [1.0, 0.0], 0.02, freqs, nu_ref)
+    assert abs(r.amp - true_A) < 4 * r.amp_err
+    assert abs(r.alpha - true_alpha) < 4 * r.alpha_err
+    assert 0.5 < r.red_chi2 < 1.5
+
+
+def test_fit_dm_to_freq_resids():
+    rng = np.random.default_rng(1)
+    freqs = np.linspace(1200.0, 1800.0, 32)
+    DM_true, P = 1.5e-3, 0.005
+    resids = Dconst * DM_true * freqs ** -2.0 / P \
+        + rng.normal(0, 1e-6, 32)
+    r = fit_DM_to_freq_resids(freqs, resids * P, np.full(32, 1e-6 * P))
+    assert abs(r.DM - DM_true) < 4 * r.DM_err
+
+
+def test_gm_dmc_roundtrip():
+    GM = GM_from_DMc(1e-4, 1.0, 10.0)
+    DMc = DMc_from_GM(GM, 1.0, 10.0)
+    np.testing.assert_allclose(DMc, 1e-4, rtol=1e-12)
+
+
+@pytest.fixture(scope="module")
+def two_bands(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("join")
+    gm = str(tmp / "f.gmodel")
+    write_model(gm, "fake", "000", 1500.0, MODEL_PARAMS,
+                np.ones(8, int), -4.0, 0, quiet=True)
+    par = str(tmp / "f.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 100.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    lo = str(tmp / "lo.fits")
+    hi = str(tmp / "hi.fits")
+    # the high band is offset in phase: the join fit must absorb it
+    make_fake_pulsar(gm, par, lo, nsub=1, nchan=16, nbin=128, nu0=1300.0,
+                     bw=300.0, tsub=60.0, noise_stds=0.004,
+                     dedispersed=True, seed=31, quiet=True)
+    make_fake_pulsar(gm, par, hi, nsub=1, nchan=16, nbin=128, nu0=1700.0,
+                     bw=300.0, tsub=60.0, phase=0.07, noise_stds=0.004,
+                     dedispersed=True, seed=32, quiet=True)
+    meta = str(tmp / "bands.meta")
+    with open(meta, "w") as f:
+        f.write(lo + "\n" + hi + "\n")
+    return tmp, gm, par, meta
+
+
+def test_join_dataportrait(two_bands):
+    tmp, gm, par, meta = two_bands
+    dp = DataPortrait(meta, quiet=True)
+    assert dp.njoin == 2
+    assert dp.nchan == 32
+    # frequency-sorted concatenation spanning both bands
+    assert np.all(np.diff(dp.freqs[0]) > 0)
+    assert dp.freqs[0][0] < 1400 < 1600 < dp.freqs[0][-1]
+    # the FFTFIT seed caught the injected 0.07 offset of band 2
+    assert abs(abs(dp.join_params[2]) - 0.07) < 0.01
+    # join parameter persistence round-trips
+    jf = str(tmp / "bands.join")
+    dp.write_join_parameters(jf)
+    dp2 = DataPortrait(meta, joinfile=jf, quiet=True)
+    np.testing.assert_allclose(dp2.join_params, dp.join_params,
+                               atol=1e-12)
+
+
+def test_join_gaussian_model(two_bands):
+    """Multi-receiver model building (SURVEY S8): a Gaussian model fit
+    across two joined bands recovers the injected component."""
+    from pulseportraiture_tpu.models.gauss import GaussianModelPortrait
+
+    tmp, gm, par, meta = two_bands
+    dp = GaussianModelPortrait(meta, quiet=True)
+    dp.make_gaussian_model(niter=2, quiet=True)
+    assert abs(dp.model_params[2] - 0.40) < 5e-3
+    assert abs(dp.model_params[4] - 0.05) < 5e-3
+    assert abs(dp.model_params[6] - 1.0) < 0.05
+    # the fitted join phase for band 2 absorbed the injected offset
+    assert abs(abs(dp.join_params[2]) - 0.07) < 0.01
+    # model/data residuals at the noise level across BOTH bands
+    assert (dp.portx - dp.modelx).std() < 3 * 0.004
+
+
+def test_fit_flux_profile(two_bands):
+    tmp, gm, par, meta = two_bands
+    dp = DataPortrait(str(tmp / "lo.fits"), quiet=True)
+    fp = dp.fit_flux_profile(channel_errs=np.full(
+        len(dp.freqsxs[0]), 1e-3), quiet=True)
+    # injected amplitude spectral index is -1.2; the flux index tracks it
+    assert abs(fp.alpha - (-1.2)) < 0.3
+    assert fp.amp > 0

@@ -1,0 +1,120 @@
+"""TPU device lane: exercises real-chip execution when one is present.
+
+The rest of the suite pins JAX_PLATFORMS=cpu (conftest.py); these tests
+spawn subprocesses with the pin removed so the container's TPU platform
+is used, and skip cleanly on hosts without an accelerator.  This is the
+lane that catches device-only failures (complex128 compilation,
+complex host-transfer, f64 pair-path behavior) that CPU CI cannot.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _device_env():
+    env = dict(os.environ)
+    # undo conftest's cpu pin; keep any site path (the container's
+    # sitecustomize is what registers the TPU platform plugin)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _run(code, timeout=900):
+    return subprocess.run([sys.executable, "-c", code], env=_device_env(),
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def _tpu_available():
+    try:
+        r = _run("import jax; print(jax.default_backend())", timeout=300)
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+    return r.returncode == 0 and "tpu" in r.stdout
+
+
+pytestmark = pytest.mark.skipif(not _tpu_available(),
+                                reason="no TPU backend available")
+
+
+def test_pair_fit_parity_on_device():
+    """The f64 pair path runs on the chip and agrees with the CPU f64
+    oracle at the sub-ns level (the BASELINE accuracy criterion)."""
+    code = """
+import numpy as np, jax, jax.numpy as jnp
+assert jax.default_backend() == "tpu"
+from pulseportraiture_tpu.fit.portrait import fit_portrait_full_batch
+from pulseportraiture_tpu.ops.fourier import get_bin_centers, rotate_data
+from pulseportraiture_tpu.ops.profiles import gen_gaussian_portrait
+nsub, nchan, nbin = 4, 64, 512
+mp = np.array([0.0,0.0,0.35,-0.05,0.05,0.1,1.0,-1.2])
+freqs = np.linspace(1300.,1700.,nchan)
+phases = np.asarray(get_bin_centers(nbin))
+model = np.array(gen_gaussian_portrait("000", mp, -4.0, phases, freqs, 1500.0))
+P0 = 0.005
+rng = np.random.default_rng(0)
+phis = rng.uniform(-0.3,0.3,nsub); dms = rng.uniform(-1e-3,1e-3,nsub)
+data = np.stack([np.array(rotate_data(model, -phis[i], -dms[i], P0, freqs,
+                 freqs.mean())) for i in range(nsub)])
+data += rng.normal(0, 0.01, data.shape)
+nu0 = float(freqs.mean()); nus = np.tile([nu0]*3,(nsub,1))
+init = np.zeros((nsub,5)); init[:,0]=phis; init[:,1]=dms
+kw = dict(fit_flags=(1,1,0,0,0), log10_tau=False, max_iter=50,
+          nu_fits=nus, nu_outs=(nus[:,0],nus[:,1],nus[:,2]),
+          errs=np.full((nsub,nchan),0.01))
+out = fit_portrait_full_batch(jnp.asarray(data, jnp.float64), model[None],
+                              init, np.full(nsub,P0), freqs, **kw)
+phi_dev = np.asarray(out.phi)
+cpu = jax.devices("cpu")[0]
+with jax.default_device(cpu):
+    outc = fit_portrait_full_batch(data, model[None], init,
+                                   np.full(nsub,P0), freqs, **kw)
+    phi_cpu = np.asarray(outc.phi)
+d = (phi_dev - phi_cpu + 0.5) % 1.0 - 0.5
+ns = np.abs(d).max() * P0 * 1e9
+assert ns < 1.0, ns
+print("PARITY_NS=%.4f" % ns)
+"""
+    r = _run(code)
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "PARITY_NS=" in r.stdout
+
+
+def test_pipeline_runs_on_device():
+    """make_fake_pulsar -> GetTOAs (wideband + narrowband) executes with
+    the TPU as the default backend and recovers the injected dDM."""
+    code = """
+import numpy as np, jax, tempfile, os
+assert jax.default_backend() == "tpu"
+from pulseportraiture_tpu.io.gmodel import write_model
+from pulseportraiture_tpu.io.archive import make_fake_pulsar
+from pulseportraiture_tpu.pipelines.toas import GetTOAs
+tmp = tempfile.mkdtemp()
+gm = os.path.join(tmp, "f.gmodel")
+write_model(gm, "fake", "000", 1500.0,
+            np.array([0.02,0.0,0.40,0.0,0.05,0.0,1.0,-0.5]),
+            np.ones(8,int), -4.0, 0, quiet=True)
+par = os.path.join(tmp, "f.par")
+open(par,"w").write("PSR J0\\nRAJ 00:00:00\\nDECJ 00:00:00\\nF0 100.0\\n"
+                    "PEPOCH 56000.0\\nDM 30.0\\n")
+arc = os.path.join(tmp, "a.fits")
+make_fake_pulsar(gm, par, arc, nsub=2, nchan=16, nbin=128, nu0=1500.0,
+                 bw=800.0, tsub=60.0, dDM=5e-4, noise_stds=0.005,
+                 dedispersed=False, seed=9, quiet=True)
+gt = GetTOAs([arc], gm, quiet=True)
+gt.get_TOAs(bary=False)
+got, err = gt.DeltaDM_means[0], gt.DeltaDM_errs[0]
+assert abs(got - 5e-4) < max(5*err, 2e-4), (got, err)
+nb = GetTOAs([arc], gm, quiet=True)
+nb.get_narrowband_TOAs()
+assert len(nb.TOA_list) == 32
+print("PIPELINE_ON_TPU_OK dDM=%.2e" % got)
+"""
+    r = _run(code)
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "PIPELINE_ON_TPU_OK" in r.stdout
