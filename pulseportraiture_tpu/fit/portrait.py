@@ -636,9 +636,18 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
     # moments.  This is what holds TOA parity with the f64 oracle at
     # <1 ns on device; complex64 would cap phase precision near 1e-5
     # rot.  (Pair moments cover the no-scattering configuration only.)
-    use_pair = pair if pair is not None else (
-        data_port.dtype == jnp.float64 and not scat
-        and not backend_supports_complex128())
+    # The default is *hybrid*: the bulk Newton iterations run on cheap
+    # complex64 spectra and a short f64 pair polish takes the solution
+    # the rest of the way — full-f64 accuracy at near-f32 speed.
+    # ``pair``: None = auto, False = complex only, True = all-f64 pair,
+    # "hybrid" = forced hybrid.
+    if pair is None:
+        use_pair = (data_port.dtype == jnp.float64 and not scat
+                    and not backend_supports_complex128())
+        hybrid = use_pair
+    else:
+        use_pair = bool(pair)
+        hybrid = pair == "hybrid"
     if use_pair and scat:
         raise ValueError("pair=True covers no-scattering fits only")
     if use_pair:
@@ -648,6 +657,13 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
         cross = (dre * mre + dim * mim, dim * mre - dre * mim)
         abs_m2 = mre ** 2 + mim ** 2
         Sd = jnp.sum((dre ** 2 + dim ** 2) * inv_err2[:, None])
+        if hybrid:
+            cross32 = (jax.lax.complex(dre.astype(jnp.float32),
+                                       dim.astype(jnp.float32))
+                       * jnp.conj(jax.lax.complex(
+                           mre.astype(jnp.float32),
+                           mim.astype(jnp.float32))))
+            abs_m2_32 = abs_m2.astype(jnp.float32)
     else:
         dFFT = jnp.fft.rfft(as_fft_operand(data_port),
                             axis=-1).at[..., 0].multiply(F0_fact)
@@ -669,10 +685,26 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
         hi = jnp.asarray([jnp.inf if b[1] is None else b[1]
                           for b in bounds])
 
-    sol = _solve(jnp.asarray(init_params, dtype=jnp.float64), cross,
-                 abs_m2, inv_err2, freqs, P, nu_fit_DM, nu_fit_GM,
-                 nu_fit_tau, flags, log10_tau, nbin, lo, hi,
-                 max_iter=max_iter, scat=scat)
+    if use_pair and hybrid:
+        # bulk iterations on complex64, then a short full-f64 polish
+        # from the converged f32 solution (Newton is locally quadratic:
+        # ~2 steps close the ~1e-5-rot f32 gap to the f64 floor)
+        sol32 = _solve(jnp.asarray(init_params, dtype=jnp.float64),
+                       cross32, abs_m2_32, inv_err2, freqs, P, nu_fit_DM,
+                       nu_fit_GM, nu_fit_tau, flags, log10_tau, nbin, lo,
+                       hi, max_iter=max_iter, scat=scat)
+        # the polish gets the caller's full budget: it exits on
+        # convergence (typically 2-3 steps), but a bulk stage stalled on
+        # the f32 plateau may need more than a token handful
+        sol = _solve(sol32["x"], cross, abs_m2, inv_err2, freqs, P,
+                     nu_fit_DM, nu_fit_GM, nu_fit_tau, flags, log10_tau,
+                     nbin, lo, hi, max_iter=max_iter, scat=scat)
+        sol["nfev"] = sol32["nfev"] + sol["nfev"]
+    else:
+        sol = _solve(jnp.asarray(init_params, dtype=jnp.float64), cross,
+                     abs_m2, inv_err2, freqs, P, nu_fit_DM, nu_fit_GM,
+                     nu_fit_tau, flags, log10_tau, nbin, lo, hi,
+                     max_iter=max_iter, scat=scat)
     params_fit = sol["x"]
     phi_fit, DM_fit, GM_fit, tau_fit, alpha_fit = [params_fit[i]
                                                    for i in range(5)]
@@ -744,10 +776,11 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
 
 
 @partial(jax.jit, static_argnames=("fit_flags", "bounds", "log10_tau",
-                                   "max_iter", "nu_outs_mask", "scat"))
+                                   "max_iter", "nu_outs_mask", "scat",
+                                   "pair"))
 def _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b, errs_b,
                 weights_b, nu_fits_b, nu_outs_b, nu_outs_mask, fit_flags,
-                bounds, log10_tau, max_iter, scat):
+                bounds, log10_tau, max_iter, scat, pair):
     def one(d, m, x0, p, fq, er, w, nf, no):
         wok = (w > 0.0).astype(fq.dtype)
         fq_mean = (fq * wok).sum() / jnp.maximum(wok.sum(), 1.0)
@@ -759,7 +792,7 @@ def _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b, errs_b,
                                  fit_flags=fit_flags, nu_fits=nu_fits,
                                  nu_outs=nu_outs, bounds=bounds,
                                  log10_tau=log10_tau, max_iter=max_iter,
-                                 scat=scat)
+                                 scat=scat, pair=pair)
 
     return jax.vmap(one)(data_ports, model_ports, init_b, Ps_b, freqs_b,
                          errs_b, weights_b, nu_fits_b, nu_outs_b)
@@ -770,7 +803,7 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
                             fit_flags=(1, 1, 0, 0, 0),
                             nu_fits=(None, None, None),
                             nu_outs=(None, None, None), bounds=None,
-                            log10_tau=True, max_iter=50):
+                            log10_tau=True, max_iter=50, pair=None):
     """vmapped+jitted fit over a batch of subints: data [B, nchan, nbin].
 
     model_ports/freqs broadcast over the batch; returns a DataBunch of
@@ -834,7 +867,7 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
     return _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b,
                        errs_b, weights_b, nu_fits_b, nu_outs_b,
                        nu_outs_mask, flags_t, bounds_t, bool(log10_tau),
-                       int(max_iter), scat)
+                       int(max_iter), scat, pair)
 
 
 def get_scales_full(params, data_port, model_port, P, freqs, nu_DM, nu_GM,

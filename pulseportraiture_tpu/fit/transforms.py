@@ -7,10 +7,12 @@ place of PSRCHIVE's pr.MJD).
 """
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..config import Dconst
 
-__all__ = ["DM_delay", "phase_transform", "guess_fit_freq"]
+__all__ = ["DM_delay", "phase_transform", "calculate_TOA",
+           "guess_fit_freq"]
 
 
 def DM_delay(DM, freq, freq_ref=jnp.inf, P=None):
@@ -20,6 +22,18 @@ def DM_delay(DM, freq, freq_ref=jnp.inf, P=None):
     if P is not None:
         return delay / P
     return delay
+
+
+def calculate_TOA(epoch, P, phi, DM=0.0, nu_ref1=jnp.inf, nu_ref2=jnp.inf):
+    """TOA (two-part MJD) = epoch + phi' * P, with phi transformed from
+    nu_ref1 to nu_ref2 via the (pre-Doppler) DM.
+
+    Equivalent of /root/reference/pplib.py:2634-2648 with the in-repo
+    MJD replacing the PSRCHIVE one.
+    """
+    phi_prime = float(np.asarray(phase_transform(phi, DM, nu_ref1,
+                                                 nu_ref2, P, mod=False)))
+    return epoch.add_seconds(phi_prime * P)
 
 
 def phase_transform(phi, DM, nu_ref1=jnp.inf, nu_ref2=jnp.inf, P=None,

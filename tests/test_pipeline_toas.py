@@ -180,3 +180,23 @@ def test_psrchive_cross_check_gate(fake_archives):
         pass
     with pytest.raises(RuntimeError, match="PSRCHIVE"):
         gt.get_psrchive_TOAs()
+
+
+def test_calculate_toa():
+    """calculate_TOA: epoch + transformed-phase * P (validates the DM
+    reference-frequency branch against phase_transform)."""
+    from pulseportraiture_tpu.fit.transforms import (calculate_TOA,
+                                                     phase_transform)
+    from pulseportraiture_tpu.utils.mjd import MJD
+
+    e = MJD.from_mjd(56000.0)
+    P = 0.005
+    t0 = calculate_TOA(e, P, 0.25)
+    assert abs(t0.mjd() - (56000.0 + 0.25 * P / 86400.0)) < 1e-12
+    t1 = calculate_TOA(e, P, 0.1, DM=30.0, nu_ref1=1400.0,
+                       nu_ref2=1500.0)
+    phi_exp = float(np.asarray(phase_transform(0.1, 30.0, 1400.0,
+                                               1500.0, P)))
+    # two-part difference: .mjd() floats cannot resolve sub-ns at 56000
+    dsec = (t1.day - e.day) * 86400.0 + (t1.secs - e.secs)
+    assert abs(dsec / P - phi_exp) < 1e-9
