@@ -225,8 +225,9 @@ def main():
     nus_pin_s = np.tile([nu0, nu0, nu0], (scat_B, 1))
 
     def scat_fit():
+        # full f64 (hybrid pair path covers the scattering chain too)
         return fit_portrait_full_batch(
-            jnp.asarray(scat_data, dtype), model_b, scat_init, Ps,
+            jnp.asarray(scat_data, fit_dtype), model_b64, scat_init, Ps,
             freqs_b, errs=errs, fit_flags=(1, 1, 0, 1, 1),
             nu_fits=nus_pin_s,
             nu_outs=(nus_pin_s[:, 0], nus_pin_s[:, 1], nus_pin_s[:, 2]),

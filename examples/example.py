@@ -90,9 +90,9 @@ else:
 
 # -- measure TOAs + DMs ----------------------------------------------------
 print("Measuring TOAs and DMs (pptoas)...")
-with open(ephemeris) as f:
-    DM0 = float(next(ln for ln in f if ln.startswith("DM ")
-                     or ln.split()[0] == "DM").split()[1])
+from pulseportraiture_tpu.io.parfile import read_par
+
+DM0 = float(read_par(ephemeris).DM)
 gt = GetTOAs(metafile, fitted_modelfile, quiet=True)
 gt.get_TOAs(DM0=DM0, bary=False)
 timfile = os.path.join(workdir, "example.tim")

@@ -142,9 +142,81 @@ def _moments(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
         return out
 
     if pair:
-        raise NotImplementedError(
-            "the f64 pair representation covers the no-scattering fast "
-            "path only; scattering fits use the complex path")
+        # -- full-precision scattering chain in real-pair arithmetic --
+        # B = 1/(1 + i x), x = tpk*taus, is rational: B, dB/dtaus =
+        # -i tpk B^2 and d2B/dtaus^2 = -2 tpk^2 B^3 all have closed real
+        # pairs, and the (tau, alpha) parameter dependence factors into
+        # per-channel real multipliers (taus_d, taus_2d) times shared
+        # harmonic reductions — same math as the complex branch below.
+        cp, sp = jnp.cos(ang), jnp.sin(ang)
+        taus = scattering_times(tau, alpha, freqs, nu_tau)
+        x = tpk[None, :] * taus[:, None]
+        den = 1.0 + x * x
+        br, bi = 1.0 / den, -x / den
+        # t = cross * conj(B); core = t * phsr
+        tr = cross_re * br + cross_im * bi
+        ti = cross_im * br - cross_re * bi
+        core_re = tr * cp - ti * sp
+        core_im = tr * sp + ti * cp
+        absB2 = br * br + bi * bi
+        C = jnp.sum(core_re, axis=-1) * inv_err2
+        S = jnp.sum(absB2 * abs_m2, axis=-1) * inv_err2
+        out = {"C": C, "S": S, "taus": taus}
+        if order < 1:
+            return out
+        pd = _phase_shift_derivs(freqs, nu_DM, nu_GM, P).astype(C.dtype)
+        taus_d = scattering_times_deriv(tau, freqs, nu_tau, log10_tau,
+                                        taus)
+        # dB/dtaus = -i tpk B^2 -> (tpk*B2i, -tpk*B2r)
+        B2r, B2i = br * br - bi * bi, 2.0 * br * bi
+        dBr, dBi = tpk * B2i, -tpk * B2r
+        # t1 = cross * conj(dB/dtaus), rotated by the phasor
+        t1r = cross_re * dBr + cross_im * dBi
+        t1i = cross_im * dBr - cross_re * dBi
+        w1_re = t1r * cp - t1i * sp
+        w1_im = t1r * sp + t1i * cp
+        T1 = -jnp.sum(tpk * core_im, axis=-1) * inv_err2
+        Q0 = jnp.sum(w1_re, axis=-1) * inv_err2            # [nchan]
+        dC = jnp.concatenate([T1[None] * pd, taus_d * Q0[None]])
+        # d|B|^2/dtaus = 2 (br dBr + bi dBi)
+        dabsB = 2.0 * (br * dBr + bi * dBi)
+        S1 = jnp.sum(dabsB * abs_m2, axis=-1) * inv_err2
+        dS = jnp.concatenate([jnp.zeros_like(pd), taus_d * S1[None]])
+        out.update(dC=dC, dS=dS)
+        if order < 2:
+            return out
+        taus_2d = scattering_times_2deriv(tau, freqs, nu_tau, log10_tau,
+                                          taus, taus_d)
+        # d2B/dtaus^2 = -2 tpk^2 B^3
+        B3r = B2r * br - B2i * bi
+        B3i = B2r * bi + B2i * br
+        d2Br, d2Bi = -2.0 * tpk ** 2 * B3r, -2.0 * tpk ** 2 * B3i
+        t2r = cross_re * d2Br + cross_im * d2Bi
+        t2i = cross_im * d2Br - cross_re * d2Bi
+        w2_re = t2r * cp - t2i * sp
+        T2 = -jnp.sum(tpk ** 2 * core_re, axis=-1) * inv_err2
+        Q1 = -jnp.sum(tpk * w1_im, axis=-1) * inv_err2     # V base
+        W2 = jnp.sum(w2_re, axis=-1) * inv_err2
+        d2C = jnp.zeros((5, 5, nchan), dtype=C.dtype)
+        d2C = d2C.at[:3, :3].set(T2[None, None] * pd[:, None]
+                                 * pd[None, :])
+        cross_CV = pd[:, None] * (taus_d * Q1[None])[None]  # [3, 2, nc]
+        d2C = d2C.at[:3, 3:].set(cross_CV)
+        d2C = d2C.at[3:, :3].set(jnp.swapaxes(cross_CV, 0, 1))
+        d2C = d2C.at[3:, 3:].set(
+            taus_d[:, None] * taus_d[None, :] * W2[None, None]
+            + taus_2d * Q0[None, None])
+        # d2|B|^2: 2(|dB|^2 + Re(B conj(d2B))) dt_i dt_j + d|B|^2 d2t_ij
+        absdB = dBr * dBr + dBi * dBi
+        ReBd2B = br * d2Br + bi * d2Bi
+        S2 = jnp.sum(2.0 * (absdB + ReBd2B) * abs_m2, axis=-1) * inv_err2
+        d2S = jnp.zeros((5, 5, nchan), dtype=C.dtype)
+        d2S = d2S.at[3:, 3:].set(
+            taus_d[:, None] * taus_d[None, :] * S2[None, None]
+            + taus_2d * S1[None, None])
+        out.update(d2C=d2C, d2S=d2S)
+        return out
+
     phsr = jax.lax.complex(jnp.cos(ang), jnp.sin(ang))
 
     # scattering chain in the data's real dtype (complex128-free on TPU)
@@ -633,23 +705,20 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
         dof = nbin * nchan_ok - (nfit + nchan_ok)
     # Full-precision (f64) fits on a backend without complex128 (TPU)
     # take the (re, im) pair path: DFT-matmul spectra + real-pair
-    # moments.  This is what holds TOA parity with the f64 oracle at
-    # <1 ns on device; complex64 would cap phase precision near 1e-5
-    # rot.  (Pair moments cover the no-scattering configuration only.)
-    # The default is *hybrid*: the bulk Newton iterations run on cheap
-    # complex64 spectra and a short f64 pair polish takes the solution
-    # the rest of the way — full-f64 accuracy at near-f32 speed.
-    # ``pair``: None = auto, False = complex only, True = all-f64 pair,
-    # "hybrid" = forced hybrid.
+    # moments (incl. the rational scattering chain).  This is what holds
+    # TOA parity with the f64 oracle at <1 ns on device; complex64 would
+    # cap phase precision near 1e-5 rot.  The default is *hybrid*: the
+    # bulk Newton iterations run on cheap complex64 spectra and a short
+    # f64 pair polish takes the solution the rest of the way — full-f64
+    # accuracy at near-f32 speed.  ``pair``: None = auto, False =
+    # complex only, True = all-f64 pair, "hybrid" = forced hybrid.
     if pair is None:
-        use_pair = (data_port.dtype == jnp.float64 and not scat
+        use_pair = (data_port.dtype == jnp.float64
                     and not backend_supports_complex128())
         hybrid = use_pair
     else:
         use_pair = bool(pair)
         hybrid = pair == "hybrid"
-    if use_pair and scat:
-        raise ValueError("pair=True covers no-scattering fits only")
     if use_pair:
         dre, dim = rfft_pair(data_port)
         mre, mim = rfft_pair(jnp.asarray(model_port, jnp.float64))

@@ -290,8 +290,28 @@ def test_pair_path_matches_complex128():
     np.testing.assert_allclose(np.asarray(r_p.scales),
                                np.asarray(r_c.scales), rtol=1e-9)
     np.testing.assert_allclose(float(r_p.snr), float(r_c.snr), rtol=1e-9)
-    # scattering configs reject the pair representation loudly
-    with pytest.raises(ValueError, match="no-scattering"):
-        fp.fit_portrait_full(data, model, [0.1, 0.0, 0.0, -2.0, -4.0], P0,
-                          freqs, fit_flags=(1, 1, 0, 1, 0), pair=True,
-                          errs=np.full(nchan, 0.01))
+    # the scattering chain has a real-pair form too: joint
+    # (phi, DM, tau, alpha) fits agree between representations
+    taus = np.asarray(scattering_times(3e-3, -4.0, freqs, 1500.0))
+    spFT = np.asarray(scattering_portrait_FT(taus, nbin))
+    scat_model = np.fft.irfft(spFT * np.fft.rfft(model, axis=-1), nbin,
+                              axis=-1)
+    sdata = np.asarray(rotate_data(scat_model, -0.05, -1e-3, P0, freqs,
+                                   freqs.mean())) \
+        + rng.normal(0, 0.005, (nchan, nbin))
+    init_s = np.array([0.05, 0.0, 0.0, np.log10(4e-3), -4.0])
+    kws = dict(fit_flags=(1, 1, 0, 1, 1), log10_tau=True, max_iter=50,
+               nu_fits=(1500.0, 1500.0, 1500.0),
+               nu_outs=(1500.0, 1500.0, 1500.0),
+               errs=np.full(nchan, 0.005))
+    s_c = fp.fit_portrait_full(sdata, model, init_s, P0, freqs, **kws)
+    s_p = fp.fit_portrait_full(sdata, model, init_s, P0, freqs,
+                               pair=True, **kws)
+    assert abs(float(s_c.phi - s_p.phi)) * P0 * 1e9 < 0.01
+    assert abs(float(s_c.tau - s_p.tau)) < 1e-8
+    assert abs(float(s_c.alpha - s_p.alpha)) < 1e-6
+    np.testing.assert_allclose(np.asarray(s_p.covariance_matrix),
+                               np.asarray(s_c.covariance_matrix),
+                               rtol=1e-6)
+    # recovered scattering is near truth in both
+    assert abs(10 ** float(s_p.tau) - 3e-3) / 3e-3 < 0.1

@@ -114,7 +114,9 @@ print("PHIS", " ".join("%.15f" % p for p in np.asarray(out.phi)))
 
     d = phis_of(r_dev.stdout) - phis_of(r_cpu.stdout)
     d = (d + 0.5) % 1.0 - 0.5
-    ns = np.abs(d).max() * 0.005 * 1e9
+    P0 = 0.005  # matches _PARITY_SETUP
+    assert "P0 = 0.005" in _PARITY_SETUP
+    ns = np.abs(d).max() * P0 * 1e9
     assert ns < 1.0, ns
 
 
