@@ -44,7 +44,8 @@ def main():
 
     from pulseportraiture_tpu.config import Dconst
     from pulseportraiture_tpu.fit.phase_shift import fit_phase_shift
-    from pulseportraiture_tpu.fit.portrait import fit_portrait_full_batch
+    from pulseportraiture_tpu.fit.portrait import (fit_portrait_full_batch,
+                                                   model_kmax)
     from pulseportraiture_tpu.ops.fourier import get_bin_centers, rotate_data
     from pulseportraiture_tpu.ops.profiles import gen_gaussian_portrait
 
@@ -65,14 +66,16 @@ def main():
     dtype = jnp.float32 if on_accel else jnp.float64
     fit_dtype = jnp.float64
 
-    model_params = np.array([0.0, 0.0, 0.35, -0.05, 0.05, 0.1, 1.0, -1.2],
-                            dtype=np.float32 if on_accel else np.float64)
-    freqs = np.linspace(1300.0, 1700.0, nchan).astype(model_params.dtype) \
-        + np.float32(400.0 / nchan / 2)
-    phases = np.asarray(get_bin_centers(nbin)).astype(model_params.dtype)
-    model = jnp.asarray(gen_gaussian_portrait("000", model_params, -4.0,
-                                              phases, freqs, 1500.0),
-                        dtype)
+    # the template is analytic: generate in f64 so its spectral tail is
+    # genuinely zero and model_kmax can truncate the harmonic axis
+    # (an f32-generated model's quantization noise floods the tail)
+    model_params = np.array([0.0, 0.0, 0.35, -0.05, 0.05, 0.1, 1.0, -1.2])
+    freqs = np.linspace(1300.0, 1700.0, nchan) + 400.0 / nchan / 2
+    phases = np.asarray(get_bin_centers(nbin), dtype=np.float64)
+    model64 = np.asarray(gen_gaussian_portrait("000", model_params, -4.0,
+                                               phases, freqs, 1500.0),
+                         dtype=np.float64)
+    model = jnp.asarray(model64, dtype)
 
     rng = np.random.default_rng(0)
     phis_inj = rng.uniform(-0.4, 0.4, nsub)
@@ -100,13 +103,17 @@ def main():
     Ps = jnp.full((chunk,), P0, jnp.float64)
     freqs_b = jnp.broadcast_to(freqs_j, (chunk, nchan))
     model_b = jnp.broadcast_to(model, (chunk, nchan, nbin))
-    model_b64 = model_b.astype(fit_dtype)
+    # f64 template broadcast straight from the clean f64 generation (an
+    # f32 round trip would re-flood the spectral tail with noise); the
+    # harmonic cutoff is computed once and passed explicitly
+    model_b64 = jnp.broadcast_to(jnp.asarray(model64), (chunk, nchan, nbin))
+    KMAX = model_kmax(model64)
 
     def fit_chunk(data, init):
         out = fit_portrait_full_batch(
             data.astype(fit_dtype), model_b64, init, Ps, freqs_b,
             errs=errs, fit_flags=(1, 1, 0, 0, 0), log10_tau=False,
-            max_iter=30)
+            max_iter=30, kmax=KMAX)
         return out
 
     # warm-up compile on the first chunk (guess + fit)
@@ -159,17 +166,17 @@ def main():
     init_par[:, 0] = phis_inj[:K_cpu]
     init_par[:, 1] = dDMs_inj[:K_cpu]
 
-    def pinned_fit(data, nsel, dtype_sel):
+    def pinned_fit(data, nsel, dtype_sel, kmax=None):
         return fit_portrait_full_batch(
-            jnp.asarray(data, dtype_sel), model_b[:nsel].astype(dtype_sel),
+            jnp.asarray(data, dtype_sel), model_b64[:nsel].astype(dtype_sel),
             init_par[:nsel], Ps[:nsel], freqs_b[:nsel],
             errs=errs[:nsel].astype(dtype_sel),
             fit_flags=(1, 1, 0, 0, 0), nu_fits=nus_pin[:nsel],
             nu_outs=(nus_pin[:nsel, 0], nus_pin[:nsel, 1],
                      nus_pin[:nsel, 2]),
-            log10_tau=False, max_iter=50)
+            log10_tau=False, max_iter=50, kmax=kmax)
 
-    dev_out = pinned_fit(data_par, K_cpu, fit_dtype)
+    dev_out = pinned_fit(data_par, K_cpu, fit_dtype, kmax=KMAX)
     dev_phi = np.asarray(dev_out.phi)
     dev_DM = np.asarray(dev_out.DM)
     # CPU f64 oracle: identical data/inits through the same kernel at
@@ -177,7 +184,8 @@ def main():
     data_np = np.asarray(data_par, np.float64)
     cpu_dev = jax.devices("cpu")[0]
     with jax.default_device(cpu_dev):
-        cpu_out = pinned_fit(data_np, K_cpu, jnp.float64)
+        cpu_out = pinned_fit(data_np, K_cpu, jnp.float64,
+                             kmax=nbin // 2 + 1)
         cpu_phi = np.asarray(cpu_out.phi)
         cpu_DM = np.asarray(cpu_out.DM)
     dphi = (dev_phi - cpu_phi + 0.5) % 1.0 - 0.5
@@ -231,7 +239,7 @@ def main():
             freqs_b, errs=errs, fit_flags=(1, 1, 0, 1, 1),
             nu_fits=nus_pin_s,
             nu_outs=(nus_pin_s[:, 0], nus_pin_s[:, 1], nus_pin_s[:, 2]),
-            log10_tau=True, max_iter=30)
+            log10_tau=True, max_iter=30, kmax=KMAX)
 
     jax.block_until_ready(scat_fit().phi)  # compile
     t0 = time.time()
@@ -256,7 +264,7 @@ def main():
 
     def ipta_run():
         return ipta_sweep_fit(
-            jnp.asarray(i_data, dtype), jnp.asarray(i_model, dtype),
+            jnp.asarray(i_data, dtype), jnp.asarray(i_model),
             np.zeros(5), np.full(np_ * ne, P0), jnp.asarray(i_freqs),
             errs=np.full((np_ * ne, inchan), noise),
             fit_flags=(1, 1, 0, 0, 0), log10_tau=False, max_iter=20)

@@ -219,9 +219,10 @@ def _moments(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
 
     phsr = jax.lax.complex(jnp.cos(ang), jnp.sin(ang))
 
-    # scattering chain in the data's real dtype (complex128-free on TPU)
+    # scattering chain in the data's real dtype (complex128-free on TPU);
+    # B sliced to cross's (possibly model_kmax-truncated) harmonic count
     taus = scattering_times(tau, alpha, freqs, nu_tau).astype(real_dtype)
-    B = scattering_portrait_FT(taus, nbin)
+    B = scattering_portrait_FT(taus, nbin)[..., :nharm]
 
     core = cross * jnp.conj(B) * phsr           # [nchan, nharm]
     C = jnp.sum(jnp.real(core), axis=-1) * inv_err2
@@ -659,12 +660,48 @@ def _solve(init_params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
     return out
 
 
+def model_kmax(model_port, tail=1e-18):
+    """Static harmonic cutoff from a *concrete* model portrait.
+
+    Returns the smallest K (rounded up to a multiple of 128, capped at
+    nharm) such that the model power in harmonics >= K is below ``tail``
+    of the total.  Harmonics where the template vanishes contribute
+    cross-power |d_k m_k*| suppressed by |m_k| itself — truncating at a
+    1e-18 power tail perturbs C/S (and thus phi) by < 1e-9 relative,
+    two orders below the 1 ns parity budget, while cutting the
+    per-iteration moment work by nharm/K (an order of magnitude for
+    smooth pulse shapes).  Returns None for traced inputs.
+    """
+    try:
+        m = model_port
+        # one batch row suffices (models broadcast over the batch) and
+        # keeps the host transfer at [nchan, nbin]
+        while getattr(m, "ndim", 0) > 2:
+            m = m[0]
+        m = np.asarray(m)
+    except Exception:  # traced / non-addressable sharded inputs
+        return None
+    mFT = np.fft.rfft(m.reshape(-1, m.shape[-1]), axis=-1)
+    mFT[:, 0] = 0.0
+    p = np.abs(mFT) ** 2
+    tot = p.sum()
+    if tot == 0.0:
+        return None
+    # cumulative tail power over all channels, from the top harmonic down
+    tail_power = np.cumsum(p.sum(axis=0)[::-1])[::-1]
+    above = np.flatnonzero(tail_power > tail * tot)
+    K = int(above[-1]) + 2 if len(above) else 1
+    nharm = p.shape[-1]
+    K = min(-(-K // 128) * 128, nharm)
+    return K
+
+
 def fit_portrait_full(data_port, model_port, init_params, P, freqs,
                       nu_fits=(None, None, None),
                       nu_outs=(None, None, None), errs=None, weights=None,
                       fit_flags=(1, 1, 1, 1, 1), bounds=None,
                       log10_tau=True, option=0, max_iter=50, is_toa=True,
-                      quiet=True, scat=None, pair=None):
+                      quiet=True, scat=None, pair=None, kmax=None):
     """Fit (phi, DM, GM, tau, alpha) between one data and model portrait.
 
     Behavioral equivalent of /root/reference/pptoaslib.py:928-1096,
@@ -719,13 +756,29 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
     else:
         use_pair = bool(pair)
         hybrid = pair == "hybrid"
+    if kmax is None:
+        kmax = model_kmax(model_port)
     if use_pair:
-        dre, dim = rfft_pair(data_port)
-        mre, mim = rfft_pair(jnp.asarray(model_port, jnp.float64))
+        # full-spectrum data power (chi2 normalization) via Parseval —
+        # exact in the time domain, so the DFT matmul below only needs
+        # the model-support harmonics: with X0 = sum x and Xny = the
+        # Nyquist coefficient sum x*(-1)^n,
+        #   sum_{k=1}^{n/2} |X_k|^2 = (n*sum x^2 - X0^2 + Xny^2) / 2
+        d64 = jnp.asarray(data_port, jnp.float64)
+        X0 = jnp.sum(d64, axis=-1)
+        Sd_chan = (nbin * jnp.sum(d64 * d64, axis=-1) - X0 ** 2) / 2.0
+        if nbin % 2 == 0:  # rFFT has a Nyquist bin only for even nbin
+            alt = jnp.asarray((-1.0) ** np.arange(nbin))
+            Xny = jnp.sum(d64 * alt, axis=-1)
+            Sd_chan = Sd_chan + Xny ** 2 / 2.0
+        Sd_chan = Sd_chan + (F0_fact ** 2) * X0 ** 2  # DC-policy term
+        Sd = jnp.sum(Sd_chan * inv_err2)
+        dre, dim = rfft_pair(d64, kmax=kmax)
+        mre, mim = rfft_pair(jnp.asarray(model_port, jnp.float64),
+                             kmax=kmax)
         # d * conj(m) as real pairs
         cross = (dre * mre + dim * mim, dim * mre - dre * mim)
         abs_m2 = mre ** 2 + mim ** 2
-        Sd = jnp.sum((dre ** 2 + dim ** 2) * inv_err2[:, None])
         if hybrid:
             cross32 = (jax.lax.complex(dre.astype(jnp.float32),
                                        dim.astype(jnp.float32))
@@ -738,9 +791,11 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
                             axis=-1).at[..., 0].multiply(F0_fact)
         mFFT = jnp.fft.rfft(as_fft_operand(model_port),
                             axis=-1).at[..., 0].multiply(F0_fact)
+        Sd = jnp.sum(jnp.abs(dFFT) ** 2 * inv_err2[:, None])
+        if kmax is not None:
+            dFFT, mFFT = dFFT[..., :kmax], mFFT[..., :kmax]
         cross = dFFT * jnp.conj(mFFT)
         abs_m2 = jnp.abs(mFFT) ** 2
-        Sd = jnp.sum(jnp.abs(dFFT) ** 2 * inv_err2[:, None])
 
     nu_fit_DM, nu_fit_GM, nu_fit_tau = [
         freqs.mean() if nf is None else nf for nf in nu_fits]
@@ -846,10 +901,10 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
 
 @partial(jax.jit, static_argnames=("fit_flags", "bounds", "log10_tau",
                                    "max_iter", "nu_outs_mask", "scat",
-                                   "pair"))
+                                   "pair", "kmax"))
 def _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b, errs_b,
                 weights_b, nu_fits_b, nu_outs_b, nu_outs_mask, fit_flags,
-                bounds, log10_tau, max_iter, scat, pair):
+                bounds, log10_tau, max_iter, scat, pair, kmax):
     def one(d, m, x0, p, fq, er, w, nf, no):
         wok = (w > 0.0).astype(fq.dtype)
         fq_mean = (fq * wok).sum() / jnp.maximum(wok.sum(), 1.0)
@@ -861,7 +916,7 @@ def _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b, errs_b,
                                  fit_flags=fit_flags, nu_fits=nu_fits,
                                  nu_outs=nu_outs, bounds=bounds,
                                  log10_tau=log10_tau, max_iter=max_iter,
-                                 scat=scat, pair=pair)
+                                 scat=scat, pair=pair, kmax=kmax)
 
     return jax.vmap(one)(data_ports, model_ports, init_b, Ps_b, freqs_b,
                          errs_b, weights_b, nu_fits_b, nu_outs_b)
@@ -872,7 +927,8 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
                             fit_flags=(1, 1, 0, 0, 0),
                             nu_fits=(None, None, None),
                             nu_outs=(None, None, None), bounds=None,
-                            log10_tau=True, max_iter=50, pair=None):
+                            log10_tau=True, max_iter=50, pair=None,
+                            kmax=None):
     """vmapped+jitted fit over a batch of subints: data [B, nchan, nbin].
 
     model_ports/freqs broadcast over the batch; returns a DataBunch of
@@ -881,6 +937,9 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
     (fit_flags, nu_fits, bounds, log10_tau, max_iter) is static: one
     compilation per configuration.
     """
+    # static harmonic cutoff from the (concrete, pre-broadcast) model
+    if kmax is None:
+        kmax = model_kmax(model_ports)
     data_ports = jnp.asarray(data_ports)
     B = data_ports.shape[0]
     model_ports = jnp.broadcast_to(jnp.asarray(model_ports),
@@ -936,7 +995,7 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
     return _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b,
                        errs_b, weights_b, nu_fits_b, nu_outs_b,
                        nu_outs_mask, flags_t, bounds_t, bool(log10_tau),
-                       int(max_iter), scat, pair)
+                       int(max_iter), scat, pair, kmax)
 
 
 def get_scales_full(params, data_port, model_port, P, freqs, nu_DM, nu_GM,

@@ -84,7 +84,7 @@ def _dft_tables(nbin):
     return np.cos(ang), np.sin(ang)
 
 
-def rfft_pair(x, zap_f0=True):
+def rfft_pair(x, zap_f0=True, kmax=None):
     """Float64 rFFT as a (re, im) real pair via a DFT matmul.
 
     The TPU-safe full-precision spectral path: complex128 does not
@@ -96,11 +96,15 @@ def rfft_pair(x, zap_f0=True):
 
     x: [..., nbin] real; returns (re, im) [..., nharm] float64 with the
     rFFT sign convention (X_k = sum_n x_n e^{-2 pi i k n / N}) and the
-    usual F0_fact DC policy.
+    usual F0_fact DC policy.  ``kmax`` computes only the lowest kmax
+    harmonics (the model-support truncation of fit.portrait.model_kmax),
+    cutting the contraction cost proportionally.
     """
     x = jnp.asarray(x, jnp.float64)
     nbin = x.shape[-1]
     C, S = _dft_tables(nbin)
+    if kmax is not None:
+        C, S = C[:kmax], S[:kmax]
     re = jnp.einsum("...n,kn->...k", x, jnp.asarray(C))
     im = -jnp.einsum("...n,kn->...k", x, jnp.asarray(S))
     if zap_f0:
