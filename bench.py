@@ -197,7 +197,7 @@ def main():
     parity_scipy = []
     for i in range(K_scipy):
         x, _ = oracle.oracle_fit(
-            data_np[i], np.asarray(model_b[i], np.float64),
+            data_np[i], model64,
             init_par[i], P0, np.asarray(freqs, np.float64),
             fit_flags=(1, 1, 0, 0, 0), log10_tau=False,
             noise=np.full(nchan, noise), nu_fits=nu0)
@@ -264,10 +264,11 @@ def main():
 
     def ipta_run():
         return ipta_sweep_fit(
-            jnp.asarray(i_data, dtype), jnp.asarray(i_model),
+            jnp.asarray(i_data, dtype), jnp.asarray(i_model, dtype),
             np.zeros(5), np.full(np_ * ne, P0), jnp.asarray(i_freqs),
             errs=np.full((np_ * ne, inchan), noise),
-            fit_flags=(1, 1, 0, 0, 0), log10_tau=False, max_iter=20)
+            fit_flags=(1, 1, 0, 0, 0), log10_tau=False, max_iter=20,
+            kmax=model_kmax(i_model))
 
     jax.block_until_ready(ipta_run().phi)  # compile
     t0 = time.time()

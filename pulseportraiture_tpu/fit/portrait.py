@@ -222,7 +222,7 @@ def _moments(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
     # scattering chain in the data's real dtype (complex128-free on TPU);
     # B sliced to cross's (possibly model_kmax-truncated) harmonic count
     taus = scattering_times(tau, alpha, freqs, nu_tau).astype(real_dtype)
-    B = scattering_portrait_FT(taus, nbin)[..., :nharm]
+    B = scattering_portrait_FT(taus, nbin, nharm=nharm)
 
     core = cross * jnp.conj(B) * phsr           # [nchan, nharm]
     C = jnp.sum(jnp.real(core), axis=-1) * inv_err2
@@ -934,8 +934,11 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
     model_ports/freqs broadcast over the batch; returns a DataBunch of
     stacked per-subint results (fields as fit_portrait_full).  This is
     the device entry the pipelines and benches drive.  fit config
-    (fit_flags, nu_fits, bounds, log10_tau, max_iter) is static: one
-    compilation per configuration.
+    (fit_flags, nu_fits, bounds, log10_tau, max_iter, kmax) is static:
+    one compilation per configuration (and per 128-harmonic kmax
+    bucket).  kmax=None derives the model-support harmonic cutoff from
+    one [nchan, nbin] row of the concrete model per call (a small
+    device->host transfer + host rfft); pass kmax explicitly to pin it.
     """
     # static harmonic cutoff from the (concrete, pre-broadcast) model
     if kmax is None:

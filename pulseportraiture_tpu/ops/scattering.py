@@ -95,14 +95,17 @@ def scattering_profile_FT(tau, nbin):
     return jax.lax.complex(1.0 / denom, -x / denom)
 
 
-def scattering_portrait_FT(taus, nbin):
+def scattering_portrait_FT(taus, nbin, nharm=None):
     """Per-channel scattering FT: [..., nchan, nharm].
 
     Equivalent of /root/reference/pplib.py:4086-4101 without the host-side
     ``np.any(taus)`` branch (tau=0 channels already yield ones).
+    ``nharm`` builds only the lowest harmonics (for callers working on a
+    model_kmax-truncated spectrum).
     """
     taus = as_fft_operand(taus)
-    nharm = nbin // 2 + 1
+    if nharm is None:
+        nharm = nbin // 2 + 1
     k = jnp.arange(nharm, dtype=taus.dtype)
     x = 2.0 * jnp.pi * k * taus[..., None]
     denom = 1.0 + x * x

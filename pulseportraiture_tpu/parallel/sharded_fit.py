@@ -23,7 +23,7 @@ def sharded_fit_portrait_batch(mesh, data_ports, model_ports, init_params,
                                Ps, freqs, errs=None, weights=None,
                                fit_flags=(1, 1, 0, 0, 0), nu_fits=None,
                                nu_outs=None, bounds=None, log10_tau=False,
-                               max_iter=50):
+                               max_iter=50, kmax=None):
     """Run fit_portrait_full_batch with inputs sharded on ``mesh``.
 
     data_ports [B, nchan, nbin] is split over ('subint', 'chan'); the
@@ -59,7 +59,7 @@ def sharded_fit_portrait_batch(mesh, data_ports, model_ports, init_params,
             data_ports, model_ports, init_params, Ps, freqs, errs=errs,
             weights=weights, fit_flags=fit_flags, nu_fits=nu_fits,
             nu_outs=nu_outs, bounds=bounds, log10_tau=log10_tau,
-            max_iter=max_iter)
+            max_iter=max_iter, kmax=kmax)
 
 
 def ipta_sweep_fit(data_ports, model_ports, init_params, Ps, freqs,

@@ -209,3 +209,63 @@ def test_viz_smoke(setup):
     p7 = str(tmp / "subint.png")
     gt.show_subint(0, 0, savefig=p7)
     assert os.path.getsize(p7) > 1000
+
+
+def test_cli_pptoas_flags_and_cuts(setup):
+    from pulseportraiture_tpu.cli.pptoas import main
+
+    tmp, gm, par, hot, clean = setup
+    tim = str(tmp / "flags.tim")
+    assert main(["-d", hot, "-m", gm, "-o", tim, "--no_bary",
+                 "--flags", "pta,TEST,version,0.9", "--nu_ref", "1500",
+                 "--print_phase", "--print_parangle", "--quiet"]) == 0
+    lines = open(tim).read().splitlines()
+    assert all("-pta TEST" in ln and "-version 0.9" in ln
+               for ln in lines)
+    assert all("-phs " in ln and "-par_angle" in ln for ln in lines)
+    # all TOAs referenced to the requested frequency
+    assert all(ln.split()[1] == "1500.00000000" for ln in lines)
+    # an absurd S/N cut writes nothing
+    cut = str(tmp / "cut.tim")
+    assert main(["-d", hot, "-m", gm, "-o", cut, "--snr_cut", "1e9",
+                 "--quiet"]) == 0
+    assert not os.path.exists(cut) or open(cut).read() == ""
+    # --narrowband --one_DM is rejected loudly
+    assert main(["-d", hot, "-m", gm, "--narrowband", "--one_DM"]) == 1
+
+
+def test_cli_ppalign_gaussian_init_and_template(setup):
+    from pulseportraiture_tpu.cli.ppalign import main
+    from pulseportraiture_tpu.io.psrfits import read_archive
+
+    tmp, gm, par, hot, clean = setup
+    a1 = str(tmp / "g1.fits")
+    a2 = str(tmp / "g2.fits")
+    make_fake_pulsar(gm, par, a1, nsub=1, nchan=16, nbin=128, nu0=1500.0,
+                     bw=800.0, tsub=60.0, phase=0.05, noise_stds=0.01,
+                     dedispersed=True, seed=8, quiet=True)
+    make_fake_pulsar(gm, par, a2, nsub=1, nchan=16, nbin=128, nu0=1500.0,
+                     bw=800.0, tsub=60.0, phase=-0.02, noise_stds=0.01,
+                     dedispersed=True, seed=9, quiet=True)
+    meta = str(tmp / "g.meta")
+    with open(meta, "w") as f:
+        f.write(a1 + "\n" + a2 + "\n")
+    # -g: align against a single Gaussian of given FWHM
+    outg = str(tmp / "avg_g.fits")
+    assert main(["-M", meta, "-o", outg, "-g", "0.05", "--niter", "2"]) \
+        == 0
+    assert read_archive(outg).data.shape[-1] == 128
+    # -I: align against an explicit template archive
+    outi = str(tmp / "avg_i.fits")
+    assert main(["-M", meta, "-o", outi, "-I", a1, "--niter", "1"]) == 0
+    assert read_archive(outi).data.shape[-1] == 128
+
+
+def test_cli_ppzap_hist(setup):
+    from pulseportraiture_tpu.cli.ppzap import main
+
+    tmp, gm, par, hot, clean = setup
+    out = str(tmp / "zap_h.cmds")
+    assert main(["-d", hot, "-m", gm, "-o", out, "--hist",
+                 "--quiet"]) == 0
+    assert os.path.exists(hot + "_ppzap_hist.png")
