@@ -262,13 +262,17 @@ def main():
               + i_rng.normal(0, noise, (np_ * ne, inchan, inbin))) \
         .astype(np.float32 if on_accel else np.float64)
 
+    i_kmax = model_kmax(i_model)
+    i_data_dev = jnp.asarray(i_data, dtype)
+    i_model_dev = jnp.asarray(i_model, dtype)
+    i_freqs_dev = jnp.asarray(i_freqs)
+    i_errs = np.full((np_ * ne, inchan), noise)
+
     def ipta_run():
         return ipta_sweep_fit(
-            jnp.asarray(i_data, dtype), jnp.asarray(i_model, dtype),
-            np.zeros(5), np.full(np_ * ne, P0), jnp.asarray(i_freqs),
-            errs=np.full((np_ * ne, inchan), noise),
-            fit_flags=(1, 1, 0, 0, 0), log10_tau=False, max_iter=20,
-            kmax=model_kmax(i_model))
+            i_data_dev, i_model_dev, np.zeros(5), np.full(np_ * ne, P0),
+            i_freqs_dev, errs=i_errs, fit_flags=(1, 1, 0, 0, 0),
+            log10_tau=False, max_iter=20, kmax=i_kmax)
 
     jax.block_until_ready(ipta_run().phi)  # compile
     t0 = time.time()

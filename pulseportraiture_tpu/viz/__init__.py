@@ -20,10 +20,22 @@ if not os.environ.get("DISPLAY"):
 import matplotlib.pyplot as plt
 import numpy as np
 
-__all__ = ["show_portrait", "show_profiles", "show_stacked_profiles",
-           "show_residual_plot", "show_eigenprofiles",
-           "show_spline_curve_projections", "show_model_fit",
-           "show_data_portrait", "show_subint", "show_fit"]
+__all__ = ["set_colormap", "show_portrait", "show_profiles",
+           "show_stacked_profiles", "show_residual_plot",
+           "show_eigenprofiles", "show_spline_curve_projections",
+           "show_model_fit", "show_data_portrait", "show_subint",
+           "show_fit"]
+
+
+def set_colormap(colormap):
+    """Set the default image colormap and recolor the current image, if
+    any (ref pplib.py:656-669)."""
+    plt.rcParams["image.cmap"] = colormap
+    im = plt.gci()
+    if im is not None:
+        im.set_cmap(colormap)
+        plt.draw_if_interactive()
+    return plt.get_cmap(colormap)
 
 
 def _finish(fig, savefig, show):

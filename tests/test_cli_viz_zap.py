@@ -220,6 +220,7 @@ def test_cli_pptoas_flags_and_cuts(setup):
                  "--flags", "pta,TEST,version,0.9", "--nu_ref", "1500",
                  "--print_phase", "--print_parangle", "--quiet"]) == 0
     lines = open(tim).read().splitlines()
+    assert len(lines) == 2  # guard: all() below must not be vacuous
     assert all("-pta TEST" in ln and "-version 0.9" in ln
                for ln in lines)
     assert all("-phs " in ln and "-par_angle" in ln for ln in lines)
