@@ -280,6 +280,49 @@ def main():
     jax.block_until_ready(iout.phi)
     ipta_dur = time.time() - t0
 
+    # ---- ppalign batch (BASELINE '500 homogeneous archives', scaled) --
+    import tempfile
+
+    from pulseportraiture_tpu.io.archive import make_fake_pulsar
+    from pulseportraiture_tpu.io.gmodel import write_model
+    from pulseportraiture_tpu.pipelines.align import align_archives
+
+    n_arch = 24 if on_accel else 8
+    adir = tempfile.mkdtemp(prefix="pp_bench_align_")
+    agm = os.path.join(adir, "b.gmodel")
+    write_model(agm, "bench", "000",
+                1500.0, np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0,
+                                  -0.5]),
+                np.ones(8, int), -4.0, 0, quiet=True)
+    apar = os.path.join(adir, "b.par")
+    with open(apar, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    a_rng = np.random.default_rng(4)
+    afiles = []
+    for i in range(n_arch):
+        out = os.path.join(adir, "e%03d.fits" % i)
+        make_fake_pulsar(agm, apar, out, nsub=4, nchan=64, nbin=256,
+                         nu0=1500.0, bw=800.0, tsub=60.0,
+                         phase=float(a_rng.uniform(-0.2, 0.2)),
+                         dDM=float(a_rng.normal(0, 1e-3)),
+                         noise_stds=0.01, dedispersed=True, seed=100 + i,
+                         quiet=True)
+        afiles.append(out)
+    # warm-up on a 2-archive subset so the timed run measures the
+    # pipeline, not the first compile of the (shape, config) programs
+    align_archives(afiles[:2], initial_guess=afiles[0], tscrunch=True,
+                   outfile=os.path.join(adir, "warm.fits"), niter=1,
+                   quiet=True)
+    t0 = time.time()
+    align_archives(afiles, initial_guess=afiles[0], tscrunch=True,
+                   outfile=os.path.join(adir, "avg.fits"), niter=1,
+                   quiet=True)
+    align_dur = time.time() - t0
+    import shutil
+
+    shutil.rmtree(adir, ignore_errors=True)
+
     # ---- rough sustained FLOP/s for the main config -------------------
     # per subint: rFFT (5 N log2 N per channel) + ~n_iter fused moment
     # passes of ~40 flops per (channel, harmonic)
@@ -313,6 +356,8 @@ def main():
                                       4),
             "ipta_fits_per_sec": round(np_ * ne / ipta_dur, 3),
             "ipta_config": f"{np_}x{ne}x{inchan}x{inbin}",
+            "align_archives_per_sec": round(n_arch / align_dur, 3),
+            "align_config": f"{n_arch}x4x64x256 incl. FITS IO",
             "gflops_approx": round(float(gflops), 1),
         },
     }

@@ -29,12 +29,8 @@ __all__ = ["set_colormap", "show_portrait", "show_profiles",
 
 def set_colormap(colormap):
     """Set the default image colormap and recolor the current image, if
-    any (ref pplib.py:656-669)."""
-    plt.rcParams["image.cmap"] = colormap
-    im = plt.gci()
-    if im is not None:
-        im.set_cmap(colormap)
-        plt.draw_if_interactive()
+    any (ref pplib.py:656-669).  Validates before mutating state."""
+    plt.set_cmap(colormap)  # validates the name, sets rcParams + gci
     return plt.get_cmap(colormap)
 
 

@@ -315,3 +315,42 @@ def test_pair_path_matches_complex128():
                                rtol=1e-6)
     # recovered scattering is near truth in both
     assert abs(10 ** float(s_p.tau) - 3e-3) / 3e-3 < 0.1
+
+
+def test_model_kmax_semantics():
+    """Harmonic cutoff: small for clean compact templates, full for
+    noisy ones, None for traced input."""
+    nchan, nbin = 8, 512
+    freqs = np.linspace(1300.0, 1700.0, nchan)
+    phases = np.asarray(get_bin_centers(nbin))
+    mp = np.array([0.0, 0.0, 0.35, 0.0, 0.05, 0.0, 1.0, 0.0])
+    clean = np.asarray(gen_gaussian_portrait("000", mp, -4.0, phases,
+                                             freqs, 1500.0),
+                       dtype=np.float64)
+    K = fp.model_kmax(clean)
+    assert K is not None and K <= 256  # compact support
+    assert K % 128 == 0
+    # a data-derived (noisy) template carries real tail power: no cut
+    noisy = clean + np.random.default_rng(0).normal(0, 1e-3,
+                                                    clean.shape)
+    assert fp.model_kmax(noisy) == nbin // 2 + 1
+    # traced input -> None (full axis)
+    import jax
+
+    out = []
+    jax.make_jaxpr(lambda m: out.append(fp.model_kmax(m)) or 0.0)(clean)
+    assert out == [None]
+    # fits with pinned vs auto kmax agree exactly
+    P0 = 0.005
+    data = np.asarray(rotate_data(clean, -0.1, -1e-3, P0, freqs,
+                                  freqs.mean())) \
+        + np.random.default_rng(1).normal(0, 0.01, clean.shape)
+    kw = dict(fit_flags=(1, 1, 0, 0, 0), log10_tau=False,
+              nu_fits=(1500.0, 1500.0, 1500.0),
+              nu_outs=(1500.0, 1500.0, 1500.0),
+              errs=np.full(nchan, 0.01))
+    r_auto = fp.fit_portrait_full(data, clean, [0.1, 0.0, 0, 0, 0], P0,
+                                  freqs, **kw)
+    r_full = fp.fit_portrait_full(data, clean, [0.1, 0.0, 0, 0, 0], P0,
+                                  freqs, kmax=nbin // 2 + 1, **kw)
+    assert abs(float(r_auto.phi - r_full.phi)) * P0 * 1e9 < 1e-3
