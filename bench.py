@@ -38,6 +38,64 @@ def _load_oracle():
     return mod
 
 
+_T0 = time.time()
+
+
+def _stage(msg):
+    """Progress marker on stderr (stdout carries only the JSON line)."""
+    print("[bench %7.1fs] %s" % (time.time() - _T0, msg), file=sys.stderr,
+          flush=True)
+
+
+def _align_batch(n_arch):
+    """Generate, warm up, and time the ppalign batch config; the temp
+    directory is removed even when a stage raises."""
+    import shutil
+    import tempfile
+
+    from pulseportraiture_tpu.io.archive import make_fake_pulsar
+    from pulseportraiture_tpu.io.gmodel import write_model
+    from pulseportraiture_tpu.pipelines.align import align_archives
+
+    adir = tempfile.mkdtemp(prefix="pp_bench_align_")
+    try:
+        agm = os.path.join(adir, "b.gmodel")
+        write_model(agm, "bench", "000",
+                    1500.0, np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0,
+                                      -0.5]),
+                    np.ones(8, int), -4.0, 0, quiet=True)
+        apar = os.path.join(adir, "b.par")
+        with open(apar, "w") as f:
+            f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                    "PEPOCH 56000.0\nDM 30.0\n")
+        a_rng = np.random.default_rng(4)
+        afiles = []
+        for i in range(n_arch):
+            out = os.path.join(adir, "e%03d.fits" % i)
+            make_fake_pulsar(agm, apar, out, nsub=4, nchan=64, nbin=256,
+                             nu0=1500.0, bw=800.0, tsub=60.0,
+                             phase=float(a_rng.uniform(-0.2, 0.2)),
+                             dDM=float(a_rng.normal(0, 1e-3)),
+                             noise_stds=0.01, dedispersed=True,
+                             seed=100 + i, quiet=True)
+            afiles.append(out)
+        # warm-up on a 2-archive subset so the timed run measures the
+        # pipeline, not the first compile of the (shape, config) programs
+        _stage('ppalign batch: warm-up')
+        align_archives(afiles[:2], initial_guess=afiles[0], tscrunch=True,
+                       outfile=os.path.join(adir, "warm.fits"), niter=1,
+                       quiet=True)
+        t0 = time.time()
+        align_archives(afiles, initial_guess=afiles[0], tscrunch=True,
+                       outfile=os.path.join(adir, "avg.fits"), niter=1,
+                       quiet=True)
+        align_dur = time.time() - t0
+        _stage('ppalign batch done in %.1fs' % align_dur)
+        return align_dur
+    finally:
+        shutil.rmtree(adir, ignore_errors=True)
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -98,6 +156,7 @@ def main():
         i1 = min(i0 + chunk, nsub)
         chunks.append(make_chunk(i0, i1, keys[ci]))
     jax.block_until_ready(chunks)
+    _stage('data generated on device')
 
     errs = jnp.full((chunk, nchan), noise, fit_dtype)
     Ps = jnp.full((chunk,), P0, jnp.float64)
@@ -124,9 +183,11 @@ def main():
                                noise=jnp.full(data.shape[0], noise,
                                               dtype)).phase
 
+    _stage('compiling guess + fit programs')
     g0 = jax.block_until_ready(guess_phase(chunks[0]))
     init0 = jnp.zeros((chunk, 5), jnp.float64).at[:, 0].set(g0)
     jax.block_until_ready(fit_chunk(chunks[0], init0).phi)
+    _stage('compiled; timing main config')
 
     # timed run over all chunks (seed + fit, end to end on device)
     t0 = time.time()
@@ -142,6 +203,7 @@ def main():
         nus.append(out.nu_DM)
     jax.block_until_ready(phis)
     duration = time.time() - t0
+    _stage('main config done in %.1fs' % duration)
 
     # accuracy vs injections: transform fitted phi back to the injection
     # reference frequency and compare [ns]
@@ -176,6 +238,7 @@ def main():
                      nus_pin[:nsel, 2]),
             log10_tau=False, max_iter=50, kmax=kmax)
 
+    _stage('parity: device pinned fit')
     dev_out = pinned_fit(data_par, K_cpu, fit_dtype, kmax=KMAX)
     dev_phi = np.asarray(dev_out.phi)
     dev_DM = np.asarray(dev_out.DM)
@@ -183,6 +246,7 @@ def main():
     # full precision on the host backend
     data_np = np.asarray(data_par, np.float64)
     cpu_dev = jax.devices("cpu")[0]
+    _stage('parity: CPU f64 oracle')
     with jax.default_device(cpu_dev):
         cpu_out = pinned_fit(data_np, K_cpu, jnp.float64,
                              kmax=nbin // 2 + 1)
@@ -193,6 +257,7 @@ def main():
     parity_cpu_ns = float(np.max(np.abs(dphi)) * P0 * 1e9)
 
     # SciPy oracle (independent optimizer) on a small subset
+    _stage('parity: SciPy oracle x%d' % K_scipy)
     oracle = _load_oracle()
     parity_scipy = []
     for i in range(K_scipy):
@@ -241,6 +306,7 @@ def main():
             nu_outs=(nus_pin_s[:, 0], nus_pin_s[:, 1], nus_pin_s[:, 2]),
             log10_tau=True, max_iter=30, kmax=KMAX)
 
+    _stage('scattering fit: compiling')
     jax.block_until_ready(scat_fit().phi)  # compile
     t0 = time.time()
     sout = scat_fit()
@@ -274,6 +340,7 @@ def main():
             i_freqs_dev, errs=i_errs, fit_flags=(1, 1, 0, 0, 0),
             log10_tau=False, max_iter=20, kmax=i_kmax)
 
+    _stage('IPTA sweep: compiling')
     jax.block_until_ready(ipta_run().phi)  # compile
     t0 = time.time()
     iout = ipta_run()
@@ -281,47 +348,7 @@ def main():
     ipta_dur = time.time() - t0
 
     # ---- ppalign batch (BASELINE '500 homogeneous archives', scaled) --
-    import tempfile
-
-    from pulseportraiture_tpu.io.archive import make_fake_pulsar
-    from pulseportraiture_tpu.io.gmodel import write_model
-    from pulseportraiture_tpu.pipelines.align import align_archives
-
-    n_arch = 24 if on_accel else 8
-    adir = tempfile.mkdtemp(prefix="pp_bench_align_")
-    agm = os.path.join(adir, "b.gmodel")
-    write_model(agm, "bench", "000",
-                1500.0, np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0,
-                                  -0.5]),
-                np.ones(8, int), -4.0, 0, quiet=True)
-    apar = os.path.join(adir, "b.par")
-    with open(apar, "w") as f:
-        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
-                "PEPOCH 56000.0\nDM 30.0\n")
-    a_rng = np.random.default_rng(4)
-    afiles = []
-    for i in range(n_arch):
-        out = os.path.join(adir, "e%03d.fits" % i)
-        make_fake_pulsar(agm, apar, out, nsub=4, nchan=64, nbin=256,
-                         nu0=1500.0, bw=800.0, tsub=60.0,
-                         phase=float(a_rng.uniform(-0.2, 0.2)),
-                         dDM=float(a_rng.normal(0, 1e-3)),
-                         noise_stds=0.01, dedispersed=True, seed=100 + i,
-                         quiet=True)
-        afiles.append(out)
-    # warm-up on a 2-archive subset so the timed run measures the
-    # pipeline, not the first compile of the (shape, config) programs
-    align_archives(afiles[:2], initial_guess=afiles[0], tscrunch=True,
-                   outfile=os.path.join(adir, "warm.fits"), niter=1,
-                   quiet=True)
-    t0 = time.time()
-    align_archives(afiles, initial_guess=afiles[0], tscrunch=True,
-                   outfile=os.path.join(adir, "avg.fits"), niter=1,
-                   quiet=True)
-    align_dur = time.time() - t0
-    import shutil
-
-    shutil.rmtree(adir, ignore_errors=True)
+    align_dur = _align_batch(n_arch=24 if on_accel else 8)
 
     # ---- rough sustained FLOP/s for the main config -------------------
     # per subint: rFFT (5 N log2 N per channel) + ~n_iter fused moment

@@ -1,9 +1,9 @@
 """ppgauss command-line tool: build Gaussian-component portrait models.
 
 Flag-compatible re-implementation of the reference executable
-(/root/reference/ppgauss.py:658-800); the interactive GaussianSelector
-GUI is replaced by the automatic seeding in fit.gauss, so --autogauss
-covers the non-interactive path.
+(/root/reference/ppgauss.py:658-800).  Seeding is automatic by default
+(fit.gauss peak-pick, or --autogauss for a single component); pass
+--interactive for the hand-fitting GaussianSelector GUI (viz.selector).
 Run as ``python -m pulseportraiture_tpu.cli.ppgauss``.
 """
 
@@ -62,10 +62,17 @@ def build_parser():
     p.add_argument("--fgauss", action="store_true",
                    help="Fiducial Gaussian: fit all component location "
                         "slopes except the first's.")
-    p.add_argument("--autogauss", dest="auto_gauss", default=0.0,
-                   type=float, metavar="wid",
-                   help="Fit one automatic Gaussian with this initial "
-                        "width [rot].")
+    seed_mode = p.add_mutually_exclusive_group()
+    seed_mode.add_argument("--autogauss", dest="auto_gauss", default=0.0,
+                           type=float, metavar="wid",
+                           help="Fit one automatic Gaussian with this "
+                                "initial width [rot].")
+    seed_mode.add_argument("--interactive", action="store_true",
+                           help="Hand-fit the seed components in the "
+                                "matplotlib GaussianSelector GUI "
+                                "(left-drag to sketch, middle-click to "
+                                "fit, right-click to remove, 'q' to "
+                                "finish).")
     p.add_argument("--norm", dest="normalize", default=None,
                    help="Per-channel normalization: 'mean', 'max', "
                         "'prof', 'rms', or 'abs'.")
@@ -119,6 +126,7 @@ def main(argv=None):
                                niter=args.niter,
                                fiducial_gaussian=args.fgauss,
                                auto_gauss=args.auto_gauss,
+                               interactive=args.interactive,
                                writemodel=True, outfile=outfile,
                                writeerrfile=True, errfile=args.errfile,
                                model_name=args.model_name,

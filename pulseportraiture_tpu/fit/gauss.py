@@ -26,7 +26,14 @@ from .lm import lm_solve
 from .phase_shift import fit_phase_shift
 
 __all__ = ["fit_gaussian_profile", "fit_gaussian_portrait",
-           "auto_gauss_seed", "peak_pick_seed"]
+           "auto_gauss_seed", "peak_pick_seed", "dc_seed"]
+
+
+def dc_seed(profile):
+    """DC-level seed: the 10th-percentile sample of the profile (the
+    reference GUI's DCguess, /root/reference/ppgauss.py:419)."""
+    profile = np.asarray(profile)
+    return float(np.sort(profile)[len(profile) // 10 + 1])
 
 
 def fit_gaussian_profile(data, init_params, errs, fit_flags=None,
@@ -156,7 +163,7 @@ def auto_gauss_seed(profile, errs, wid_guess=0.05, tau=0.0,
     """
     profile = np.asarray(profile)
     nbin = len(profile)
-    dc_guess = sorted(profile)[nbin // 10 + 1]
+    dc_guess = dc_seed(profile)
     amp = profile.max()
     first = amp * np.asarray(gaussian_profile(nbin, 0.5, wid_guess))
     loc = 0.5 + float(np.asarray(fit_phase_shift(
@@ -180,7 +187,7 @@ def peak_pick_seed(profile, errs, max_ngauss=6, snr_stop=5.0, tau=0.0,
     profile = np.asarray(profile, dtype=np.float64)
     nbin = len(profile)
     err_level = float(np.median(np.atleast_1d(np.asarray(errs))))
-    dc_guess = sorted(profile)[nbin // 10 + 1]
+    dc_guess = dc_seed(profile)
     comps = []
     best = None
     resid = profile - dc_guess

@@ -3,9 +3,10 @@
 TPU-native equivalent of the reference's Gaussian modeling path
 (/root/reference/ppgauss.py:55-372 ``make_gaussian_model``/
 ``model_iteration``/``check_convergence``/``write_model``/
-``write_errfile``).  The interactive GaussianSelector GUI is replaced by
-non-interactive seeding (fit.gauss.auto_gauss_seed / peak_pick_seed);
-the lmfit portrait fit by the batched JAX Levenberg-Marquardt; the
+``write_errfile``).  Seeding is non-interactive by default
+(fit.gauss.auto_gauss_seed / peak_pick_seed) with the hand-fitting
+GaussianSelector GUI available via ``interactive=True`` (viz.selector);
+the lmfit portrait fit becomes the batched JAX Levenberg-Marquardt; the
 convergence check reuses the 2-parameter device fit kernel.
 """
 
@@ -30,17 +31,24 @@ class GaussianModelPortrait(DataPortrait):
     reference's ppgauss.DataPortrait subclass surface."""
 
     def fit_profile(self, profile, errs=None, tau=0.0, fixscat=True,
-                    auto_gauss=0.0, max_ngauss=6, quiet=True):
+                    auto_gauss=0.0, max_ngauss=6, interactive=False,
+                    quiet=True):
         """Seed Gaussian components from an averaged profile.
 
         Replaces the interactive GaussianSelector launch
         (/root/reference/ppgauss.py:28-53): ``auto_gauss`` != 0 fits one
-        component of that width guess; otherwise iterative
+        component of that width guess; ``interactive`` opens the
+        matplotlib picker (viz.selector); otherwise iterative
         peak-pick-fit-subtract finds up to ``max_ngauss`` components.
         """
         if errs is None:
             errs = float(np.median(self.noise_stdsxs))
-        if auto_gauss:
+        if interactive:
+            from ..viz.selector import select_gaussians
+
+            fit = select_gaussians(profile, errs, tau=tau,
+                                   fixscat=fixscat, quiet=quiet)
+        elif auto_gauss:
             fit = auto_gauss_seed(profile, errs, wid_guess=auto_gauss,
                                   tau=tau, fit_scattering=not fixscat)
         else:
@@ -57,7 +65,8 @@ class GaussianModelPortrait(DataPortrait):
                             scattering_index=scattering_alpha,
                             model_code=default_model, niter=0,
                             fiducial_gaussian=False, auto_gauss=0.0,
-                            max_ngauss=6, writemodel=False, outfile=None,
+                            max_ngauss=6, interactive=False,
+                            writemodel=False, outfile=None,
                             writeerrfile=False, errfile=None,
                             model_name=None, quiet=True):
         """Iterate evolving-Gaussian portrait fits to convergence.
@@ -111,7 +120,8 @@ class GaussianModelPortrait(DataPortrait):
                 profile = band_port.mean(axis=0)
                 self.fit_profile(profile, tau=tau, fixscat=fixscat,
                                  auto_gauss=auto_gauss,
-                                 max_ngauss=max_ngauss, quiet=quiet)
+                                 max_ngauss=max_ngauss,
+                                 interactive=interactive, quiet=quiet)
             else:
                 self.nu_ref = ref_prof[0] or self.nu0
                 self.ngauss = (len(self.init_params) - 2) // 3

@@ -19,7 +19,8 @@ import jax.numpy as jnp
 from ..config import as_fft_operand
 
 __all__ = ["get_noise", "get_noise_PS", "get_noise_fit", "get_SNR",
-           "find_kc", "half_triangle_function"]
+           "find_kc", "half_triangle_function", "wiener_filter",
+           "brickwall_filter", "fit_brickwall", "wiener_smooth"]
 
 
 def get_noise(data, method="PS", **kwargs):
@@ -124,6 +125,78 @@ def get_noise_fit(data, fact=1.1, fn="exp_dc"):
         return one(pows)
     flat = jax.vmap(one)(pows.reshape(-1, npow))
     return flat.reshape(data.shape[:-1])
+
+
+def _profile_spectrum(prof):
+    """rFFT and |rfft|^2/nbin power of a profile (batched)."""
+    prof = jnp.asarray(prof)
+    FFT = jnp.fft.rfft(as_fft_operand(prof), axis=-1)
+    pows = jnp.real(FFT * jnp.conj(FFT)) / prof.shape[-1]
+    return FFT, pows
+
+
+def _wiener_from_pows(pows, noise):
+    sig = jnp.maximum(pows - noise ** 2, 0.0)
+    return sig / (sig + noise ** 2)
+
+
+def wiener_filter(prof, noise):
+    """Per-harmonic Wiener filter H_k = S_k / (S_k + N_k) for a noisy
+    profile.
+
+    A *working* version of the reference's under-construction filter
+    (/root/reference/pplib.py:1393-1408, marked "#FIX does not work"):
+    in the |rfft|^2/nbin convention the white-noise floor per harmonic
+    is noise^2, and the *signal* power is the measured power minus that
+    floor (clipped at zero) — the reference used the total power as S,
+    which biases H toward 1 everywhere.  Batched over leading dims.
+    """
+    return _wiener_from_pows(_profile_spectrum(prof)[1], noise)
+
+
+def brickwall_filter(N, kc):
+    """Binary low-pass filter: ones below harmonic kc, zeros above
+    (equivalent of /root/reference/pplib.py:1410-1418; jit-safe for
+    traced kc, batched over kc's leading dims)."""
+    return jnp.where(jnp.arange(N) < jnp.asarray(kc)[..., None], 1.0, 0.0)
+
+
+def fit_brickwall(prof, noise):
+    """Best-fit brickwall cutoff kc to the profile's Wiener filter.
+
+    Minimizes ||wiener_filter - brickwall(kc)||^2 over kc, evaluated in
+    closed form with cumulative sums (the L2-optimal binary approximation
+    of the filter) instead of the reference's O(N^2) host loop
+    (/root/reference/pplib.py:1420-1434, "#FIX this is obviously
+    wrong" — its objective was right, but it compared against the broken
+    wiener_filter).  Returns the harmonic index kc.
+    """
+    return _fit_brickwall_from_wf(wiener_filter(prof, noise))
+
+
+def _fit_brickwall_from_wf(wf):
+    # X2(kc) = sum_{i<kc} (wf_i - 1)^2 + sum_{i>=kc} wf_i^2
+    ones_cost = jnp.concatenate([jnp.zeros(wf.shape[:-1] + (1,)),
+                                 jnp.cumsum((wf - 1.0) ** 2, axis=-1)],
+                                axis=-1)
+    tot = jnp.sum(wf ** 2, axis=-1, keepdims=True)
+    zeros_cost = tot - jnp.concatenate(
+        [jnp.zeros(wf.shape[:-1] + (1,)),
+         jnp.cumsum(wf ** 2, axis=-1)], axis=-1)
+    return jnp.argmin(ones_cost + zeros_cost, axis=-1).astype(jnp.int32)
+
+
+def wiener_smooth(prof, noise, brickwall=False):
+    """Denoise a profile by its Wiener (or best-fit brickwall) filter —
+    the application the reference's under-construction filters were
+    building toward.  Returns the filtered profile."""
+    prof = jnp.asarray(prof)
+    nbin = prof.shape[-1]
+    FFT, pows = _profile_spectrum(prof)
+    H = _wiener_from_pows(pows, noise)
+    if brickwall:
+        H = brickwall_filter(nbin // 2 + 1, _fit_brickwall_from_wf(H))
+    return jnp.fft.irfft(FFT * H, nbin, axis=-1).astype(prof.dtype)
 
 
 def get_SNR(prof, fudge=3.25, noise_method="PS"):

@@ -270,3 +270,73 @@ def test_cli_ppzap_hist(setup):
     assert main(["-d", hot, "-m", gm, "-o", out, "--hist",
                  "--quiet"]) == 0
     assert os.path.exists(hot + "_ppzap_hist.png")
+
+
+def test_gaussian_selector_state_machine():
+    """Selector state transitions: sketch -> fit -> remove, display-free."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    from pulseportraiture_tpu.ops.profiles import gen_gaussian_profile
+    from pulseportraiture_tpu.viz.selector import GaussianSelector
+
+    nbin = 256
+    true = [0.01, 0.0, 0.30, 0.04, 1.0, 0.62, 0.08, 0.5]
+    prof = np.asarray(gen_gaussian_profile(true, nbin))
+    rng = np.random.default_rng(7)
+    noise = 0.01
+    data = prof + rng.normal(0, noise, nbin)
+
+    sel = GaussianSelector(data, noise, show_instructions=False)
+    # sketch both components with deliberately sloppy drags
+    sel.add_from_drag(0.27, 0.34, 0.9)
+    sel.add_from_drag(0.57, 0.66, 0.45)
+    assert sel.ngauss == 2 and len(sel.init_params) == 8
+    fit = sel.fit()
+    locs = sorted([sel.components[0][0], sel.components[1][0]])
+    assert abs(locs[0] - 0.30) < 0.005
+    assert abs(locs[1] - 0.62) < 0.005
+    assert fit.chi2 / fit.dof < 1.5
+    # remove invalidates the fit; result() refits the remaining one
+    sel.remove_last()
+    assert sel.ngauss == 1 and sel.last_fit is None
+    assert sel.result() is not None
+    sel.finish()
+    assert sel.done
+
+
+def test_gaussian_selector_events():
+    """Drive the selector through real matplotlib events (Agg backend)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    from matplotlib.backend_bases import KeyEvent, MouseButton, MouseEvent
+
+    from pulseportraiture_tpu.ops.profiles import gen_gaussian_profile
+    from pulseportraiture_tpu.viz.selector import GaussianSelector
+
+    nbin = 128
+    prof = np.asarray(gen_gaussian_profile([0.0, 0.0, 0.5, 0.06, 1.0],
+                                           nbin))
+    rng = np.random.default_rng(3)
+    data = prof + rng.normal(0, 0.02, nbin)
+    sel = GaussianSelector(data, 0.02, show_instructions=False)
+
+    def mouse(name, x, y, button):
+        # pixel coords for (x, y) in the profile axes' data space
+        px, py = sel.ax_prof.transData.transform((x, y))
+        ev = MouseEvent(name, sel.canvas, px, py, button=button)
+        sel.canvas.callbacks.process(name, ev)
+
+    mouse("button_press_event", 0.44, 0.2, MouseButton.LEFT)
+    mouse("motion_notify_event", 0.52, 0.8, MouseButton.LEFT)
+    mouse("button_release_event", 0.56, 0.9, MouseButton.LEFT)
+    assert sel.ngauss == 1
+    mouse("button_press_event", 0.5, 0.5, MouseButton.MIDDLE)
+    assert sel.last_fit is not None
+    assert abs(sel.components[0][0] - 0.5) < 0.01
+    mouse("button_press_event", 0.5, 0.5, MouseButton.RIGHT)
+    assert sel.ngauss == 0
+    sel.canvas.callbacks.process(
+        "key_press_event", KeyEvent("key_press_event", sel.canvas, "q"))
+    assert sel.done

@@ -126,3 +126,57 @@ def test_powlaw_freqs_equal_flux():
     fluxes = [float(pl.powlaw_integral(edges[i + 1], edges[i], 1500.0, 1.0,
                                        -1.4)) for i in range(8)]
     np.testing.assert_allclose(fluxes, fluxes[0], rtol=1e-10)
+
+
+def test_wiener_filter_shape_and_range(rng):
+    from pulseportraiture_tpu.ops.profiles import gen_gaussian_profile
+
+    nbin = 256
+    prof = np.asarray(gen_gaussian_profile([0.0, 0.0, 0.5, 0.05, 1.0],
+                                           nbin))
+    noise = 0.02
+    wf = np.asarray(nz.wiener_filter(prof + rng.normal(0, noise, nbin),
+                                     noise))
+    assert wf.shape == (nbin // 2 + 1,)
+    assert np.all(wf >= 0.0) and np.all(wf <= 1.0)
+    # strong low harmonics pass, noise-floor tail is suppressed
+    assert wf[1:6].min() > 0.95
+    assert np.median(wf[nbin // 4:]) < 0.5
+
+
+def test_wiener_smooth_reduces_error(rng):
+    from pulseportraiture_tpu.ops.profiles import gen_gaussian_profile
+
+    nbin = 512
+    true = np.asarray(gen_gaussian_profile([0.0, 0.0, 0.3, 0.04, 1.0,
+                                            0.6, 0.1, 0.4], nbin))
+    noise = 0.05
+    data = true + rng.normal(0, noise, nbin)
+    # the brickwall variant does better here: the per-harmonic Wiener
+    # weights are noisy (power estimated from one realization), while
+    # the binary cutoff zeroes the whole noise floor
+    for brick, fac in ((False, 0.6), (True, 0.4)):
+        sm = np.asarray(nz.wiener_smooth(data, noise, brickwall=brick))
+        rms_raw = np.sqrt(np.mean((data - true) ** 2))
+        rms_sm = np.sqrt(np.mean((sm - true) ** 2))
+        assert rms_sm < fac * rms_raw, (brick, rms_sm, rms_raw)
+
+
+def test_fit_brickwall_finds_cutoff(rng):
+    # band-limited signal: exactly kc_true nonzero harmonics
+    nbin, kc_true = 256, 12
+    spec = np.zeros(nbin // 2 + 1, complex)
+    spec[:kc_true] = 40.0 * np.exp(2j * np.pi * rng.uniform(0, 1, kc_true))
+    prof = np.fft.irfft(spec, nbin)
+    noise = 0.1
+    kc = int(nz.fit_brickwall(prof + rng.normal(0, noise, nbin), noise))
+    assert abs(kc - kc_true) <= 2, kc
+    # batched path agrees
+    kcs = np.asarray(nz.fit_brickwall(
+        np.stack([prof + rng.normal(0, noise, nbin) for _ in range(3)]),
+        noise))
+    assert kcs.shape == (3,)
+    assert np.all(np.abs(kcs - kc_true) <= 2)
+    bw = np.asarray(nz.brickwall_filter(nbin // 2 + 1, kcs))
+    assert bw.shape == (3, nbin // 2 + 1)
+    assert np.all(bw.sum(axis=-1) == kcs)
