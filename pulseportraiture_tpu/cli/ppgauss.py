@@ -118,19 +118,26 @@ def main(argv=None):
         outfile = args.outfile
         if outfile is None:
             outfile = datafile + ".gmodel"
-        dp.make_gaussian_model(modelfile=None, ref_prof=(nu_ref, bw_ref),
-                               tau=tau, fixloc=args.fixloc,
-                               fixwid=args.fixwid, fixamp=args.fixamp,
-                               fixscat=fixscat, fixalpha=args.fixalpha,
-                               model_code=args.model_code,
-                               niter=args.niter,
-                               fiducial_gaussian=args.fgauss,
-                               auto_gauss=args.auto_gauss,
-                               interactive=args.interactive,
-                               writemodel=True, outfile=outfile,
-                               writeerrfile=True, errfile=args.errfile,
-                               model_name=args.model_name,
-                               quiet=args.quiet)
+        try:
+            dp.make_gaussian_model(modelfile=None,
+                                   ref_prof=(nu_ref, bw_ref),
+                                   tau=tau, fixloc=args.fixloc,
+                                   fixwid=args.fixwid, fixamp=args.fixamp,
+                                   fixscat=fixscat, fixalpha=args.fixalpha,
+                                   model_code=args.model_code,
+                                   niter=args.niter,
+                                   fiducial_gaussian=args.fgauss,
+                                   auto_gauss=args.auto_gauss,
+                                   interactive=args.interactive,
+                                   writemodel=True, outfile=outfile,
+                                   writeerrfile=True, errfile=args.errfile,
+                                   model_name=args.model_name,
+                                   quiet=args.quiet)
+        except RuntimeError as e:
+            # e.g. --interactive on a headless matplotlib backend, or a
+            # selector session closed with nothing sketched
+            print(str(e), file=sys.stderr)
+            return 1
     if args.figure:
         from ..viz import show_model_fit
 

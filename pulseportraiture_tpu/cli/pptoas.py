@@ -27,6 +27,10 @@ def build_parser():
                    help="Output .tim file (appends). [default=stdout]")
     p.add_argument("--narrowband", action="store_true",
                    help="Make narrowband (per-channel) TOAs instead.")
+    p.add_argument("--psrchive", action="store_true",
+                   help="Cross-check mode: narrowband TOAs via the "
+                        "external PSRCHIVE 'pat' machinery (requires the "
+                        "optional psrchive python bindings).")
     p.add_argument("--errfile", metavar="errfile", default=None,
                    help="Write fitted DM errors to this file (for "
                         "princeton-format TOAs). Appends.")
@@ -111,6 +115,20 @@ def main(argv=None):
 
     gt = GetTOAs(datafiles=args.datafiles, modelfile=args.modelfile,
                  quiet=args.quiet)
+    if args.psrchive:
+        try:
+            gt.get_psrchive_TOAs(tscrunch=args.tscrunch, quiet=args.quiet)
+        except RuntimeError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        lines = [ln for arch_lines in gt.psrchive_toas
+                 for ln in arch_lines]
+        if args.outfile:
+            with open(args.outfile, "a") as f:
+                f.write("\n".join(lines) + "\n")
+        else:
+            print("\n".join(lines))
+        return 0
     if not args.narrowband:
         gt.get_TOAs(tscrunch=args.tscrunch, nu_refs=nu_refs, DM0=DM0,
                     bary=args.bary, fit_DM=args.fit_DM, fit_GM=args.fit_GM,

@@ -11,11 +11,14 @@ equivalents (average_archives; ops.wavelet smoothing for psrsmooth -W).
 
 import numpy as np
 
+import jax
+import jax.numpy as jnp
+
+from ..config import as_fft_operand
 from ..fit.phase_shift import fit_phase_shift
 from ..fit.portrait import fit_portrait_full_batch
-from ..fit.transforms import guess_fit_freq
 from ..io.archive import load_data, parse_metafile
-from ..ops.fourier import rotate_data
+from ..ops.fourier import apply_phasor, phase_shifts, rotate_data
 from ..ops.normalize import normalize_portrait
 from ..ops.profiles import gaussian_profile
 
@@ -123,6 +126,136 @@ def average_archives(datafiles, outfile, palign=False, tscrunch=True,
     return outfile
 
 
+@jax.jit
+def _rotate_batch(data, phis, DMs, Ps, freqs, nu_refs):
+    """Rotate [B, (npol,) nchan, nbin] by per-subint (phi, DM) in ONE
+    device call — the latency-critical op of the align loop (each
+    archive used to pay its own device round trips)."""
+    data = jnp.asarray(data)
+    shifts = phase_shifts(jnp.asarray(phis)[:, None],
+                          jnp.asarray(DMs)[:, None], 0.0,
+                          jnp.asarray(freqs),
+                          jnp.asarray(nu_refs)[:, None], jnp.inf,
+                          jnp.asarray(Ps)[:, None])        # [B, nchan]
+    if data.ndim == 4:
+        shifts = shifts[:, None, :]
+    FT = jnp.fft.rfft(as_fft_operand(data), axis=-1)
+    return jnp.fft.irfft(apply_phasor(FT, shifts), n=data.shape[-1],
+                         axis=-1)
+
+
+def _guess_fit_freqs_np(freqs, SNRs, mask):
+    """Masked SNR*nu^-2-weighted frequency per subint (numpy batch of
+    fit.transforms.guess_fit_freq; host-side — it feeds device calls).
+    Rows with no valid channels fall back to the unmasked mean frequency
+    (their weights are zero everywhere downstream)."""
+    any_ok = (mask > 0).any(axis=-1)
+    big = np.where(mask > 0, freqs, np.nan)
+    with np.errstate(all="ignore"):
+        nu0 = np.where(
+            any_ok,
+            0.5 * (np.nanmin(np.where(any_ok[:, None], big, 0.0), axis=-1)
+                   + np.nanmax(np.where(any_ok[:, None], big, 0.0),
+                               axis=-1)),
+            freqs.mean(axis=-1))
+    w = np.where(mask > 0, SNRs * freqs ** -2.0, 0.0)
+    nu = nu0 + np.sum((freqs - nu0[:, None]) * w, axis=-1) / \
+        np.maximum(w.sum(axis=-1), 1e-300)
+    return np.where(any_ok, nu, freqs.mean(axis=-1))
+
+
+def _chunked_blocks(entries, model_port, dnchan, nchan, nbin, npol,
+                    chunk_max):
+    """Yield fixed-size [chunk_max, ...] blocks assembled from per-entry
+    slices — entries are never concatenated whole (a 500-archive group
+    would transiently hold gigabytes), and every block shares one padded
+    shape so the jitted programs compile once regardless of archive
+    count.  Padding rows carry zero data, zero weights, and the template
+    as their model (so the fit stays finite); their zero weights drop
+    them from the accumulation."""
+    rows = [(i, j) for i, e in enumerate(entries)
+            for j in range(len(e["Ps"]))]
+    for b0 in range(0, len(rows), chunk_max):
+        blk = rows[b0:b0 + chunk_max]
+        B = chunk_max
+        full = np.zeros((B, npol, dnchan, nbin))
+        pad_model = model_port if dnchan == nchan \
+            else model_port[np.arange(dnchan) % nchan]
+        model_b = np.broadcast_to(pad_model, (B, dnchan, nbin)).copy()
+        freqs_b = np.ones((B, dnchan))
+        errs_b = np.ones((B, dnchan))
+        SNRs_b = np.zeros((B, dnchan))
+        Ps_b = np.ones(B)
+        wok = np.zeros((B, dnchan))
+        DMg = np.zeros(B)
+        owners = np.zeros(B, dtype=int)
+        for r, (i, j) in enumerate(blk):
+            e = entries[i]
+            full[r] = e["full"][j]
+            cm = e["chan_map"]
+            model_b[r] = model_port if cm is None else model_port[cm]
+            freqs_b[r] = e["freqs"][j]
+            errs_b[r] = e["errs"][j]
+            SNRs_b[r] = e["SNRs"][j]
+            Ps_b[r] = e["Ps"][j]
+            wok[r] = e["wok"][j]
+            DMg[r] = e["DM"]
+            owners[r] = i
+        yield full, model_b, freqs_b, errs_b, SNRs_b, Ps_b, wok, DMg, \
+            owners
+
+
+def _align_fit_accumulate(full, model_b, freqs_b, errs_b, SNRs_b, Ps_b,
+                          wok, DMg, owners, chan_maps, fit_dm, max_iter,
+                          nbin, npol, aligned_port, total_weights):
+    """One batched align pass over a [B, npol, nchan, nbin] subint block:
+    seed (dedisperse + profile FFTFIT), (phi, DM) portrait fit, rotate,
+    and accumulate into aligned_port/total_weights (in place)."""
+    ports = full[:, 0]
+    nu_fit = _guess_fit_freqs_np(freqs_b, SNRs_b, wok)
+    rot = np.asarray(_rotate_batch(ports, np.zeros(len(Ps_b)), DMg, Ps_b,
+                                   freqs_b, nu_fit))
+    denom = np.maximum(wok.sum(-1), 1.0)[:, None]
+    rot_profs = (rot * wok[..., None]).sum(1) / denom
+    model_profs = (model_b * wok[..., None]).sum(1) / denom
+    g = fit_phase_shift(rot_profs, model_profs,
+                        noise=np.median(errs_b, axis=-1), Ns=nbin)
+    init = np.zeros((len(Ps_b), 5))
+    init[:, 0] = np.nan_to_num(np.asarray(g.phase))
+    init[:, 1] = DMg
+    out = fit_portrait_full_batch(
+        ports, model_b, init, Ps_b, freqs_b, errs=errs_b, weights=wok,
+        fit_flags=(1, int(bool(fit_dm)), 0, 0, 0),
+        nu_fits=np.stack([nu_fit] * 3, axis=1), log10_tau=False,
+        max_iter=max_iter)
+    scales_f = np.asarray(out.scales)
+    # padded / fully-zapped rows can carry non-finite fit results; their
+    # weights are zero, but 0*nan would still poison the accumulation
+    phi_f = np.nan_to_num(np.asarray(out.phi))
+    DM_f = np.nan_to_num(np.asarray(out.DM))
+    nu_f = np.nan_to_num(np.asarray(out.nu_DM), nan=1.0)
+    rotated = np.nan_to_num(np.asarray(_rotate_batch(
+        full, phi_f, DM_f, Ps_b, freqs_b, nu_f)))
+    errs_safe = np.where(wok > 0, errs_b, 1.0)  # dead channels: no 1/0
+    w_bc = np.nan_to_num(
+        np.where(wok > 0, scales_f / errs_safe ** 2, 0.0))  # [B, nchan]
+    same = all(chan_maps[i] is None for i in set(owners.tolist()))
+    if same:
+        aligned_port += np.einsum("bc,bpcn->pcn", w_bc, rotated)
+        total_weights += w_bc.sum(0)[:, None]
+    else:
+        for j in range(len(Ps_b)):
+            cm = chan_maps[owners[j]]
+            okc = wok[j] > 0
+            tchan = np.flatnonzero(okc) if cm is None else cm[okc]
+            wcol = w_bc[j][okc][:, None]
+            for ipol in range(npol):
+                np.add.at(aligned_port[ipol], tchan,
+                          wcol * rotated[j, ipol, okc])
+            np.add.at(total_weights, tchan,
+                      np.broadcast_to(wcol, (len(tchan), nbin)))
+
+
 def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
                    pscrunch=True, SNR_cutoff=0.0, outfile=None, norm=None,
                    rot_phase=0.0, place=None, niter=1, quiet=True,
@@ -159,12 +292,21 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
     skip_these = set()
     aligned_port = np.zeros((npol, nchan, nbin))
     total_weights = np.zeros((nchan, nbin))
+    model_mask = np.zeros(nchan)
+    model_mask[model_data.ok_ichans[0]] = 1.0
+    # device-call budget: archives are loaded on the host, concatenated
+    # into per-(nchan) groups, and every group runs the whole iteration
+    # in a handful of batched device programs (rotate / seed / fit /
+    # rotate) instead of several calls per archive — at 500 homogeneous
+    # archives the difference is ~2000 tunnel round trips vs ~8
+    chunk_max = 128
     for count in range(1, niter + 1):
         if not quiet:
             print(f"Doing iteration {count}...")
         aligned_port[:] = 0.0
         total_weights[:] = 0.0
         use_files = [f for f in datafiles if f not in skip_these]
+        groups = {}
         for datafile in use_files:
             try:
                 d = load_data(datafile, state=state, dedisperse=False,
@@ -185,14 +327,8 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
             ok = np.asarray(d.ok_isubs)
             if not len(ok):
                 continue
-            B = len(ok)
             wok = (d.weights[ok] > 0.0).astype(float)
-            # mask channels missing from the template too
-            model_mask = np.zeros(nchan)
-            model_mask[model_data.ok_ichans[0]] = 1.0
             if same_freqs:
-                model_b = np.broadcast_to(model_port,
-                                          (B, nchan, nbin)).copy()
                 wok = wok * model_mask[None, :]
                 chan_map = None
             else:
@@ -200,55 +336,20 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
                 chan_map = np.argmin(np.abs(
                     model_data.freqs[0][None, :]
                     - d.freqs[0][:, None]), axis=1)
-                model_b = np.broadcast_to(model_port[chan_map],
-                                          (B, d.nchan, nbin)).copy()
-            ports = d.subints[ok, 0]
-            freqs_b = d.freqs[ok]
-            errs_b = d.noise_stds[ok, 0]
-            SNRs_b = d.SNRs[ok, 0]
-            Ps_b = d.Ps[ok]
-            DM_guess = d.DM
+            groups.setdefault(d.freqs.shape[-1], []).append(dict(
+                full=np.asarray(d.subints[ok]), freqs=np.asarray(d.freqs[ok]),
+                errs=np.asarray(d.noise_stds[ok, 0]),
+                SNRs=np.asarray(d.SNRs[ok, 0]), Ps=np.asarray(d.Ps[ok]),
+                wok=wok, chan_map=chan_map, DM=float(d.DM)))
 
-            nu_fit = np.array([
-                float(np.asarray(guess_fit_freq(freqs_b[i][wok[i] > 0],
-                                                SNRs_b[i][wok[i] > 0])))
-                for i in range(B)])
-            rot = np.stack([
-                np.asarray(rotate_data(ports[i], 0.0, DM_guess,
-                                       float(Ps_b[i]), freqs_b[i],
-                                       nu_fit[i])) for i in range(B)])
-            rot_profs = (rot * wok[..., None]).sum(1) / \
-                np.maximum(wok.sum(-1), 1.0)[:, None]
-            model_profs = (model_b * wok[..., None]).sum(1) / \
-                np.maximum(wok.sum(-1), 1.0)[:, None]
-            g = fit_phase_shift(rot_profs, model_profs,
-                                noise=np.median(errs_b, axis=-1), Ns=nbin)
-            init = np.zeros((B, 5))
-            init[:, 0] = np.asarray(g.phase)
-            init[:, 1] = DM_guess
-            out = fit_portrait_full_batch(
-                ports, model_b, init, Ps_b, freqs_b, errs=errs_b,
-                weights=wok, fit_flags=(1, int(bool(fit_dm)), 0, 0, 0),
-                nu_fits=np.stack([nu_fit] * 3, axis=1),
-                log10_tau=False, max_iter=max_iter)
-            phases_f = np.asarray(out.phi)
-            DMs_f = np.asarray(out.DM)
-            nu_refs_f = np.asarray(out.nu_DM)
-            scales_f = np.asarray(out.scales)
-
-            full = d.subints[ok]  # [B, npol, nchan, nbin]
-            for j in range(B):
-                okc = wok[j] > 0
-                w = np.outer(scales_f[j][okc] / errs_b[j][okc] ** 2,
-                             np.ones(nbin))
-                rotated = np.asarray(rotate_data(
-                    full[j][:, okc], phases_f[j], DMs_f[j],
-                    float(Ps_b[j]), freqs_b[j][okc], nu_refs_f[j]))
-                tchan = np.flatnonzero(okc) if chan_map is None \
-                    else chan_map[okc]
-                for ipol in range(npol):
-                    aligned_port[ipol, tchan] += w * rotated[ipol]
-                total_weights[tchan] += w
+        for dnchan, entries in groups.items():
+            for block in _chunked_blocks(entries, model_port, dnchan,
+                                         nchan, nbin, npol, chunk_max):
+                _align_fit_accumulate(
+                    *block, chan_maps=[e["chan_map"] for e in entries],
+                    fit_dm=fit_dm, max_iter=max_iter, nbin=nbin,
+                    npol=npol, aligned_port=aligned_port,
+                    total_weights=total_weights)
         nz = total_weights > 0
         for ipol in range(npol):
             aligned_port[ipol][nz] /= total_weights[nz]

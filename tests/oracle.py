@@ -64,9 +64,12 @@ def oracle_fit(data_port, model_port, init_params, P, freqs,
     # parity criterion.  fatol must stay above the fp noise floor of the
     # chi2 sum (~ulp(|f|) ~ 1e-11 for |f| ~ 1e5): an unreachable
     # absolute fatol makes Nelder-Mead burn its full maxfev budget.
+    # maxiter/maxfev bound the occasional pathological simplex (~10 min
+    # at bench scale otherwise); the Powell pass polishes from wherever
+    # Nelder-Mead stops, so a capped run still lands on the minimum.
     res = opt.minimize(fun, x0[flags], method="Nelder-Mead",
                        options={"xatol": 1e-10, "fatol": 1e-10,
-                                "maxiter": 20000, "maxfev": 20000})
+                                "maxiter": 3000, "maxfev": 3000})
     res = opt.minimize(fun, res.x, method="Powell",
                        options={"xtol": 1e-12, "ftol": 1e-12})
     x = x0.copy()

@@ -340,3 +340,33 @@ def test_gaussian_selector_events():
     sel.canvas.callbacks.process(
         "key_press_event", KeyEvent("key_press_event", sel.canvas, "q"))
     assert sel.done
+
+
+def test_cli_pptoas_psrchive_mode(setup):
+    """--psrchive without the optional bindings fails with a clear
+    message (the cross-check path is external by design)."""
+    from pulseportraiture_tpu.cli.pptoas import main
+
+    tmp, gm, par, hot, clean = setup
+    try:
+        import psrchive  # noqa: F401
+        have = True
+    except ImportError:
+        have = False
+    rc = main(["-d", clean, "-m", gm, "--psrchive",
+               "-o", str(tmp / "psr.tim"), "--quiet"])
+    assert rc == (0 if have else 1)
+
+
+def test_cli_ppgauss_interactive_headless(setup):
+    """--interactive on a headless backend exits 1 with a clear message
+    instead of a traceback."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    from pulseportraiture_tpu.cli.ppgauss import main
+
+    tmp, gm, par, hot, clean = setup
+    rc = main(["-d", clean, "--interactive",
+               "-o", str(tmp / "i.gmodel")])
+    assert rc == 1

@@ -81,3 +81,33 @@ def test_align_archives_niter3_nonzero(setup, tmp_path):
     assert np.abs(aligned).max() > 0
     prof = aligned[0].mean(axis=0)
     assert prof.max() / np.abs(prof).mean() > 3
+
+
+def test_align_archives_mixed_channelization(setup, tmp_path):
+    """Archives whose channelization differs from the template go
+    through the nearest-frequency channel mapping (ref
+    ppalign.py:165-172) — and can mix with same-frequency archives in
+    one run."""
+    tmp, files, gmodel = setup
+    par = str(tmp / "fake.par")
+    rng = np.random.default_rng(17)
+    coarse = []
+    for i in range(2):
+        out = str(tmp_path / f"coarse_{i}.fits")
+        make_fake_pulsar(gmodel, par, out, nsub=2, nchan=8, nbin=128,
+                         nu0=1500.0, bw=400.0, tsub=30.0,
+                         phase=float(rng.uniform(-0.3, 0.3)),
+                         dDM=float(rng.normal(0, 1e-3)),
+                         noise_stds=0.05, dedispersed=False,
+                         seed=400 + i, quiet=True)
+        coarse.append(out)
+    out = str(tmp_path / "mixed.fits")
+    outfile, port, weights = align_archives(
+        files + coarse, initial_guess=files[0], tscrunch=False,
+        outfile=out, niter=2, quiet=True)
+    # every template channel collected weight from some archive
+    assert (weights.sum(axis=-1) > 0).all()
+    d = load_data(out, quiet=True)
+    assert d.nbin == 128 and d.nchan == 16
+    # the aligned average is sharp (SNR well above a single epoch's)
+    assert d.prof_SNR > 50

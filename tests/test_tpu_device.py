@@ -22,6 +22,12 @@ def _device_env():
     # sitecustomize is what registers the TPU platform plugin)
     env.pop("JAX_PLATFORMS", None)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # share the persistent XLA cache with bench.py: device compiles cost
+    # minutes through the TPU tunnel, and these programs are identical
+    # from run to run
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(REPO, ".jax_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
     return env
 
 
