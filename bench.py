@@ -98,10 +98,11 @@ def _align_batch(n_arch):
                              noise_stds=0.01, dedispersed=True,
                              seed=100 + i, quiet=True)
             afiles.append(out)
-        # warm-up on a 2-archive subset so the timed run measures the
-        # pipeline, not the first compile of the (shape, config) programs
+        # warm-up over the SAME archive set so the timed run reuses the
+        # compiled block programs (block shape depends on the padded
+        # row count, so a smaller warm-up would compile the wrong shape)
         _stage('ppalign batch: warm-up')
-        align_archives(afiles[:2], initial_guess=afiles[0], tscrunch=True,
+        align_archives(afiles, initial_guess=afiles[0], tscrunch=True,
                        outfile=os.path.join(adir, "warm.fits"), niter=1,
                        quiet=True)
         t0 = time.time()
@@ -131,9 +132,12 @@ def main():
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
     if on_accel:
-        # chunk sized to HBM for the f64 pair path (~150 MB/subint of
-        # program temporaries at 512x2048)
-        nsub, nchan, nbin, chunk = 1000, 512, 2048, 40
+        # chunk: throughput is dispatch-latency-bound through the TPU
+        # tunnel (per-chunk wall time is ~flat from 40 to 100 subints),
+        # so bigger is better until the remote compile helper runs out
+        # of memory for the f64 pair program (chunk=200 fails to
+        # compile at 512x2048); 100 is the measured sweet spot
+        nsub, nchan, nbin, chunk = 1000, 512, 2048, 100
     else:  # CPU smoke config (first-slice scale from BASELINE.md)
         nsub, nchan, nbin, chunk = 64, 128, 1024, 32
     P0 = 0.005
@@ -301,7 +305,9 @@ def main():
     parity_scipy_ns = float(np.max(parity_scipy))
 
     # ---- scattering joint fit (flags 11011, log10 tau) ----------------
-    scat_B = chunk
+    # the scattering chain carries ~3x the per-subint temporaries of the
+    # phase+DM fit; batch 100 exhausts HBM at 512x2048, 40 fits
+    scat_B = min(chunk, 40)
     tau_inj = 3e-3  # rot at nu0
     from pulseportraiture_tpu.ops.scattering import (scattering_portrait_FT,
                                                      scattering_times)
@@ -330,8 +336,9 @@ def main():
     def scat_fit():
         # full f64 (hybrid pair path covers the scattering chain too)
         return fit_portrait_full_batch(
-            jnp.asarray(scat_data, fit_dtype), model_b64, scat_init, Ps,
-            freqs_b, errs=errs, fit_flags=(1, 1, 0, 1, 1),
+            jnp.asarray(scat_data, fit_dtype), model_b64[:scat_B],
+            scat_init, Ps[:scat_B], freqs_b[:scat_B],
+            errs=errs[:scat_B], fit_flags=(1, 1, 0, 1, 1),
             nu_fits=nus_pin_s,
             nu_outs=(nus_pin_s[:, 0], nus_pin_s[:, 1], nus_pin_s[:, 2]),
             log10_tau=True, max_iter=30, kmax=KMAX)

@@ -129,6 +129,25 @@ def as_fft_operand(x):
     return x.astype(fft_real_dtype(x.dtype))
 
 
+def host_stats_device():
+    """Context manager placing small statistics on the local CPU backend.
+
+    Per-archive load-time estimates (noise, S/N) are tiny computations;
+    on a remote-tunnel TPU each one costs a full dispatch+transfer round
+    trip (~150 ms here) that dwarfs the math.  Archive loading wraps
+    them in this context so IO-side code never blocks on the
+    accelerator; the batched fit pipelines are unaffected.  Falls back
+    to a no-op when no CPU backend is registered.
+    """
+    import contextlib
+
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        return contextlib.nullcontext()
+    return jax.default_device(cpu)
+
+
 def host_array(x):
     """Device array -> numpy, transferring complex values as two real
     planes.
@@ -163,4 +182,5 @@ __all__ = [
     "backend_supports_complex128",
     "fft_real_dtype",
     "as_fft_operand",
+    "host_stats_device",
 ]

@@ -11,6 +11,7 @@ import os
 
 import numpy as np
 
+from ..config import host_stats_device
 from ..ops.fourier import get_bin_centers
 from ..ops.noise import get_SNR, get_noise
 from ..utils.databunch import DataBunch
@@ -92,7 +93,8 @@ def load_data(filename, state=None, dedisperse=False, dededisperse=False,
     nchan = arch.nchan
     freqs = arch.freqs.copy()
     nbin = arch.nbin
-    phases = np.asarray(get_bin_centers(nbin))
+    with host_stats_device():
+        phases = np.asarray(get_bin_centers(nbin))
     subints = arch.data.copy()
     Ps = arch.Ps.copy()
     if len(Ps) < nsub:  # tscrunch keeps one
@@ -102,7 +104,12 @@ def load_data(filename, state=None, dedisperse=False, dededisperse=False,
     weights = arch.weights.copy()
     weights_norm = np.where(weights == 0.0, 0.0, 1.0)
 
-    noise_stds = np.asarray(get_noise(subints, method=noise_method))
+    # per-archive noise/SNR estimates run on the local CPU backend: each
+    # is a tiny computation whose remote-device round trip would
+    # dominate archive loading (cf. the reference's own load-time SNR
+    # complaint, pplib.py:2763-2772)
+    with host_stats_device():
+        noise_stds = np.asarray(get_noise(subints, method=noise_method))
     ok_isubs = np.compress(weights_norm.mean(axis=1),
                            range(arch.nsub))
     ok_ichans = [np.compress(weights_norm[isub], range(nchan))
@@ -110,7 +117,8 @@ def load_data(filename, state=None, dedisperse=False, dededisperse=False,
     masks = np.einsum("ij,k->ijk", weights_norm, np.ones(nbin))
     masks = np.einsum("j,ikl->ijkl", np.ones(npol), masks)
     if get_SNRs:
-        SNRs = np.asarray(get_SNR(subints))
+        with host_stats_device():
+            SNRs = np.asarray(get_SNR(subints))
     else:
         SNRs = np.zeros([arch.nsub, npol, nchan])
 
@@ -127,8 +135,9 @@ def load_data(filename, state=None, dedisperse=False, dededisperse=False,
     work.tscrunch()
     work.fscrunch()
     prof = work.data[0, 0, 0]
-    prof_noise = float(np.asarray(get_noise(prof)))
-    prof_SNR = float(np.asarray(get_SNR(prof)))
+    with host_stats_device():
+        prof_noise = float(np.asarray(get_noise(prof)))
+        prof_SNR = float(np.asarray(get_SNR(prof)))
 
     return DataBunch(
         arch=arch if return_arch else None, backend=arch.backend,

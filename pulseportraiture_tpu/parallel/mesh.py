@@ -10,10 +10,17 @@ explicit two-axis device mesh:
 * 'chan'   — model parallelism over frequency channels.  The chi-squared
   channel reductions become XLA all-reduces over ICI, inserted by GSPMD
   from the sharding annotations (no hand-written collectives).
+* 'bin'    — sequence parallelism over the phase-bin axis (the
+  framework's "long-context" axis, SURVEY.md §5.7).  On the f64 pair
+  path the spectra come from a DFT matmul contracting over nbin, so a
+  bin-sharded portrait turns into a sharded contraction + psum; the
+  complex path's batched FFT gathers the axis first.  Useful when
+  nbin is very large (searchmode/baseband-folded portraits) or as the
+  third way to spread one fit over many chips.
 
 On a single host this maps onto one slice's chips; multi-host layouts
-put 'subint' on DCN and keep 'chan' inside a slice so the per-iteration
-psum rides ICI.
+put 'subint' on DCN and keep 'chan'/'bin' inside a slice so the
+per-iteration psums ride ICI.
 """
 
 import numpy as np
@@ -24,26 +31,30 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["make_mesh", "shard_batch", "batch_sharding", "P"]
 
 
-def make_mesh(n_subint=None, n_chan=1, devices=None):
-    """Mesh with axes ('subint', 'chan').
+def make_mesh(n_subint=None, n_chan=1, n_bin=1, devices=None):
+    """Mesh with axes ('subint', 'chan', 'bin').
 
-    Defaults to all devices on the subint (data) axis; set n_chan > 1 to
-    split the channel reductions across devices as well.
+    Defaults to all devices on the subint (data) axis; set n_chan > 1
+    to split the channel reductions, and n_bin > 1 to split the
+    phase-bin (sequence) axis as well.
     """
     if devices is None:
         devices = jax.devices()
     n = len(devices)
     if n_subint is None:
-        n_subint = n // n_chan
-    if n_subint * n_chan != n:
-        raise ValueError(f"mesh {n_subint}x{n_chan} != {n} devices")
-    dev_array = np.asarray(devices).reshape(n_subint, n_chan)
-    return Mesh(dev_array, axis_names=("subint", "chan"))
+        n_subint = n // (n_chan * n_bin)
+    if n_subint * n_chan * n_bin != n:
+        raise ValueError(
+            f"mesh {n_subint}x{n_chan}x{n_bin} != {n} devices")
+    dev_array = np.asarray(devices).reshape(n_subint, n_chan, n_bin)
+    return Mesh(dev_array, axis_names=("subint", "chan", "bin"))
 
 
-def batch_sharding(mesh, with_chan_axis=True):
+def batch_sharding(mesh, with_chan_axis=True, with_bin_axis=True):
     """NamedSharding for a [B, nchan, nbin] fit batch on ``mesh``."""
-    spec = P("subint", "chan" if with_chan_axis else None, None)
+    spec = P("subint", "chan" if with_chan_axis else None,
+             "bin" if with_bin_axis and "bin" in mesh.axis_names
+             else None)
     return NamedSharding(mesh, spec)
 
 

@@ -23,13 +23,17 @@ def sharded_fit_portrait_batch(mesh, data_ports, model_ports, init_params,
                                Ps, freqs, errs=None, weights=None,
                                fit_flags=(1, 1, 0, 0, 0), nu_fits=None,
                                nu_outs=None, bounds=None, log10_tau=False,
-                               max_iter=50, kmax=None):
+                               max_iter=50, pair=None, kmax=None):
     """Run fit_portrait_full_batch with inputs sharded on ``mesh``.
 
-    data_ports [B, nchan, nbin] is split over ('subint', 'chan'); the
-    batch size B must divide by the mesh's subint axis and nchan by its
-    chan axis.  Outputs follow the inputs' sharding (per-subint results
-    live on the subint shards).
+    data_ports [B, nchan, nbin] is split over ('subint', 'chan', 'bin');
+    the batch size B must divide by the mesh's subint axis, nchan by its
+    chan axis, and nbin by its bin axis.  Outputs follow the inputs'
+    sharding (per-subint results live on the subint shards).  With a
+    non-trivial 'bin' axis and the pair path (``pair=True``/"hybrid", or
+    f64 data on a c128-less backend), the DFT-matmul spectra contract
+    over the sharded phase-bin axis — sequence parallelism with a GSPMD
+    psum.
     """
     sh3 = batch_sharding(mesh)
     sh2 = NamedSharding(mesh, P("subint", "chan"))
@@ -59,19 +63,19 @@ def sharded_fit_portrait_batch(mesh, data_ports, model_ports, init_params,
             data_ports, model_ports, init_params, Ps, freqs, errs=errs,
             weights=weights, fit_flags=fit_flags, nu_fits=nu_fits,
             nu_outs=nu_outs, bounds=bounds, log10_tau=log10_tau,
-            max_iter=max_iter, kmax=kmax)
+            max_iter=max_iter, pair=pair, kmax=kmax)
 
 
 def ipta_sweep_fit(data_ports, model_ports, init_params, Ps, freqs,
                    errs=None, weights=None, fit_flags=(1, 1, 0, 0, 0),
-                   n_chan_shards=1, **kw):
+                   n_chan_shards=1, n_bin_shards=1, **kw):
     """IPTA-scale sweep: [npulsar*nepoch, nchan, nbin] batch sharded over
     all available devices (BASELINE.md '20 pulsars x 10 epochs' config).
 
     Flattens any leading (pulsar, epoch) structure into the subint axis;
     callers reshape the stacked outputs back.
     """
-    mesh = make_mesh(n_chan=n_chan_shards)
+    mesh = make_mesh(n_chan=n_chan_shards, n_bin=n_bin_shards)
     data = jnp.asarray(data_ports)
     lead = data.shape[:-2]
     B = int(jnp.prod(jnp.asarray(lead)))

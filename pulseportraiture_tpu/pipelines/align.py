@@ -164,45 +164,49 @@ def _guess_fit_freqs_np(freqs, SNRs, mask):
     return np.where(any_ok, nu, freqs.mean(axis=-1))
 
 
-def _chunked_blocks(entries, model_port, dnchan, nchan, nbin, npol,
+def _pad_rows(nrows, chunk_max):
+    """Block size for ``nrows`` live rows: the next power of two (>= 8),
+    capped at chunk_max — a handful of compiled shapes total."""
+    b = 8
+    while b < nrows:
+        b *= 2
+    return min(b, chunk_max)
+
+
+def _assemble_block(rows, model_port, dnchan, nchan, nbin, npol,
                     chunk_max):
-    """Yield fixed-size [chunk_max, ...] blocks assembled from per-entry
-    slices — entries are never concatenated whole (a 500-archive group
-    would transiently hold gigabytes), and every block shares one padded
-    shape so the jitted programs compile once regardless of archive
-    count.  Padding rows carry zero data, zero weights, and the template
-    as their model (so the fit stays finite); their zero weights drop
-    them from the accumulation."""
-    rows = [(i, j) for i, e in enumerate(entries)
-            for j in range(len(e["Ps"]))]
-    for b0 in range(0, len(rows), chunk_max):
-        blk = rows[b0:b0 + chunk_max]
-        B = chunk_max
-        full = np.zeros((B, npol, dnchan, nbin))
-        pad_model = model_port if dnchan == nchan \
-            else model_port[np.arange(dnchan) % nchan]
-        model_b = np.broadcast_to(pad_model, (B, dnchan, nbin)).copy()
-        freqs_b = np.ones((B, dnchan))
-        errs_b = np.ones((B, dnchan))
-        SNRs_b = np.zeros((B, dnchan))
-        Ps_b = np.ones(B)
-        wok = np.zeros((B, dnchan))
-        DMg = np.zeros(B)
-        owners = np.zeros(B, dtype=int)
-        for r, (i, j) in enumerate(blk):
-            e = entries[i]
-            full[r] = e["full"][j]
-            cm = e["chan_map"]
-            model_b[r] = model_port if cm is None else model_port[cm]
-            freqs_b[r] = e["freqs"][j]
-            errs_b[r] = e["errs"][j]
-            SNRs_b[r] = e["SNRs"][j]
-            Ps_b[r] = e["Ps"][j]
-            wok[r] = e["wok"][j]
-            DMg[r] = e["DM"]
-            owners[r] = i
-        yield full, model_b, freqs_b, errs_b, SNRs_b, Ps_b, wok, DMg, \
-            owners
+    """One padded [B, ...] block from a list of (entry, j) subint rows.
+
+    Padding rows carry zero data, zero weights, and the template as
+    their model (so the fit stays finite); their zero weights drop them
+    from the accumulation."""
+    B = _pad_rows(len(rows), chunk_max)
+    full = np.zeros((B, npol, dnchan, nbin))
+    pad_model = model_port if dnchan == nchan \
+        else model_port[np.arange(dnchan) % nchan]
+    model_b = np.broadcast_to(pad_model, (B, dnchan, nbin)).copy()
+    freqs_b = np.ones((B, dnchan))
+    errs_b = np.ones((B, dnchan))
+    SNRs_b = np.zeros((B, dnchan))
+    Ps_b = np.ones(B)
+    wok = np.zeros((B, dnchan))
+    DMg = np.zeros(B)
+    chan_maps = []
+    owners = np.zeros(B, dtype=int)
+    for r, (e, j) in enumerate(rows):
+        full[r] = e["full"][j]
+        cm = e["chan_map"]
+        model_b[r] = model_port if cm is None else model_port[cm]
+        freqs_b[r] = e["freqs"][j]
+        errs_b[r] = e["errs"][j]
+        SNRs_b[r] = e["SNRs"][j]
+        Ps_b[r] = e["Ps"][j]
+        wok[r] = e["wok"][j]
+        DMg[r] = e["DM"]
+        chan_maps.append(cm)
+        owners[r] = r
+    return (full, model_b, freqs_b, errs_b, SNRs_b, Ps_b, wok, DMg,
+            owners), chan_maps
 
 
 def _align_fit_accumulate(full, model_b, freqs_b, errs_b, SNRs_b, Ps_b,
@@ -306,7 +310,26 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
         aligned_port[:] = 0.0
         total_weights[:] = 0.0
         use_files = [f for f in datafiles if f not in skip_these]
-        groups = {}
+        # streaming assembly: rows queue per channelization; full blocks
+        # flush as soon as chunk_max rows are pending, so memory stays
+        # bounded by ~chunk_max subints + the archive being loaded (the
+        # 500-archive case never holds 500 archives at once)
+        pending = {}
+
+        def flush(dnchan, force=False):
+            rows = pending.get(dnchan, [])
+            while len(rows) >= chunk_max or (force and rows):
+                take, rows = rows[:chunk_max], rows[chunk_max:]
+                block, cmaps = _assemble_block(
+                    take, model_port, dnchan, nchan, nbin, npol,
+                    chunk_max)
+                _align_fit_accumulate(
+                    *block, chan_maps=cmaps, fit_dm=fit_dm,
+                    max_iter=max_iter, nbin=nbin, npol=npol,
+                    aligned_port=aligned_port,
+                    total_weights=total_weights)
+            pending[dnchan] = rows
+
         for datafile in use_files:
             try:
                 d = load_data(datafile, state=state, dedisperse=False,
@@ -336,20 +359,18 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
                 chan_map = np.argmin(np.abs(
                     model_data.freqs[0][None, :]
                     - d.freqs[0][:, None]), axis=1)
-            groups.setdefault(d.freqs.shape[-1], []).append(dict(
+            entry = dict(
                 full=np.asarray(d.subints[ok]), freqs=np.asarray(d.freqs[ok]),
                 errs=np.asarray(d.noise_stds[ok, 0]),
                 SNRs=np.asarray(d.SNRs[ok, 0]), Ps=np.asarray(d.Ps[ok]),
-                wok=wok, chan_map=chan_map, DM=float(d.DM)))
+                wok=wok, chan_map=chan_map, DM=float(d.DM))
+            dnchan = d.freqs.shape[-1]
+            pending.setdefault(dnchan, []).extend(
+                (entry, j) for j in range(len(ok)))
+            flush(dnchan)
 
-        for dnchan, entries in groups.items():
-            for block in _chunked_blocks(entries, model_port, dnchan,
-                                         nchan, nbin, npol, chunk_max):
-                _align_fit_accumulate(
-                    *block, chan_maps=[e["chan_map"] for e in entries],
-                    fit_dm=fit_dm, max_iter=max_iter, nbin=nbin,
-                    npol=npol, aligned_port=aligned_port,
-                    total_weights=total_weights)
+        for dnchan in list(pending):
+            flush(dnchan, force=True)
         nz = total_weights > 0
         for ipol in range(npol):
             aligned_port[ipol][nz] /= total_weights[nz]

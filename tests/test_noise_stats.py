@@ -180,3 +180,15 @@ def test_fit_brickwall_finds_cutoff(rng):
     bw = np.asarray(nz.brickwall_filter(nbin // 2 + 1, kcs))
     assert bw.shape == (3, nbin // 2 + 1)
     assert np.all(bw.sum(axis=-1) == kcs)
+
+
+def test_ism_misc_formulas():
+    # mean_C2N/dDM against the published formulas directly
+    nu, D, Ds, bws = 1400.0, 1.2, 0.6, 5.0
+    c2n = float(pl.mean_C2N(nu, D, bws))
+    assert np.isclose(c2n, 2e-14 * nu ** (11 / 3) * D ** (-11 / 6)
+                      * bws ** (-5 / 6), rtol=1e-12)
+    d = float(pl.dDM(D, Ds, nu, bws))
+    assert np.isclose(d, 10 ** 4.45 * (c2n * D) * Ds ** (5 / 6)
+                      * nu ** (-11 / 6), rtol=1e-12)
+    assert d > 0

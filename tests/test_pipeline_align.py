@@ -111,3 +111,19 @@ def test_align_archives_mixed_channelization(setup, tmp_path):
     assert d.nbin == 128 and d.nchan == 16
     # the aligned average is sharp (SNR well above a single epoch's)
     assert d.prof_SNR > 50
+
+
+def test_psrsmooth_archive(setup, tmp_path):
+    """-W equivalent: wavelet-denoised archive has the same shape and a
+    higher S/N average profile than the raw one."""
+    from pulseportraiture_tpu.pipelines.align import psrsmooth_archive
+
+    tmp, files, gmodel = setup
+    out = psrsmooth_archive(files[0],
+                            outfile=str(tmp_path / "smoothed.fits"))
+    raw = load_data(files[0], tscrunch=True, pscrunch=True, quiet=True)
+    sm = load_data(out, tscrunch=True, pscrunch=True, quiet=True)
+    assert sm.subints.shape == raw.subints.shape
+    # denoising cuts the off-pulse noise level
+    assert float(np.median(sm.noise_stds[0, 0])) < \
+        0.8 * float(np.median(raw.noise_stds[0, 0]))
