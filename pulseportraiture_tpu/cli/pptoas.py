@@ -6,6 +6,7 @@ Run as ``python -m pulseportraiture_tpu.cli.pptoas``.
 """
 
 import argparse
+import os
 import sys
 
 import numpy as np
@@ -79,6 +80,14 @@ def build_parser():
                         "TOA lines, e.g. pta,NANOGrav,version,0.1")
     p.add_argument("--snr_cut", dest="snr_cutoff", default=0.0, type=float,
                    help="S/N cutoff for written TOAs.")
+    p.add_argument("--checkpoint", metavar="timfile", default=None,
+                   help="Crash-resume mode: append TOAs to this .tim "
+                        "file after EVERY archive and skip archives "
+                        "already in it on a re-run.  The checkpoint "
+                        "file IS the output (-o is ignored); "
+                        "incompatible with --snr_cut/--one_DM/"
+                        "-f princeton/--narrowband, which post-process "
+                        "the full TOA list.")
     p.add_argument("--showplot", dest="show_plot", action="store_true",
                    help="Show fitted data/model/residual plots.")
     p.add_argument("--quiet", action="store_true", help="Suppress output.")
@@ -93,6 +102,22 @@ def main(argv=None):
     if args.narrowband and args.one_DM:
         print("--one_DM applies to wideband (per-subint DM) TOAs only.")
         return 1
+    if args.checkpoint is not None:
+        incompatible = [flag for flag, on in [
+            ("--narrowband", args.narrowband),
+            ("--snr_cut", args.snr_cutoff > 0.0),
+            ("--one_DM", args.one_DM),
+            ("-f princeton", args.format == "princeton")] if on]
+        if incompatible:
+            print("--checkpoint writes raw TOA lines incrementally and "
+                  "cannot be combined with post-processing flags: "
+                  + ", ".join(incompatible), file=sys.stderr)
+            return 1
+        if args.outfile is not None and \
+                os.path.realpath(args.outfile) != \
+                os.path.realpath(args.checkpoint):
+            print("--checkpoint supersedes -o: TOAs go to %s only."
+                  % args.checkpoint, file=sys.stderr)
 
     from ..io.timfile import write_TOAs
     from ..pipelines.toas import GetTOAs
@@ -138,7 +163,10 @@ def main(argv=None):
                     print_flux=args.print_flux,
                     print_parangle=args.print_parangle,
                     addtnl_toa_flags=addtnl_toa_flags,
-                    show_plot=args.show_plot, quiet=args.quiet)
+                    show_plot=args.show_plot, quiet=args.quiet,
+                    checkpoint=args.checkpoint)
+        if args.checkpoint is not None:
+            return 0  # the checkpoint file is the output
     else:
         gt.get_narrowband_TOAs(tscrunch=args.tscrunch,
                                fit_scat=args.fit_scat,

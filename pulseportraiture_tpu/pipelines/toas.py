@@ -10,6 +10,7 @@ names and per-archive list structure so downstream tooling (zap, plots,
 tim writing) carries over.
 """
 
+import os
 import time
 
 import numpy as np
@@ -182,12 +183,18 @@ class GetTOAs:
                  print_parangle=False, add_instrumental_response=False,
                  addtnl_toa_flags={}, method="trust-ncg", bounds=None,
                  nu_fits=None, show_plot=False, quiet=None,
-                 max_iter=50):
+                 max_iter=50, checkpoint=None):
         """Measure TOAs; results accumulate on self (reference-named).
 
         Equivalent of /root/reference/pptoas.py:150-738; ``method`` is
         accepted for API parity (the batched Newton solver replaces the
         scipy method choices).
+
+        ``checkpoint``: path to a .tim file appended after EVERY archive
+        (the reference writes its .tim only at the end, so a crashed
+        multi-archive run loses all TOAs — SURVEY.md §5.3).  On entry,
+        archives already present in the checkpoint are skipped, so a
+        killed run resumes where it stopped.
         """
         if quiet is None:
             quiet = self.quiet
@@ -208,7 +215,19 @@ class GetTOAs:
         start = time.time()
 
         datafiles = self.datafiles if datafile is None else [datafile]
+        done_archives = set()
+        if checkpoint is not None and os.path.isfile(checkpoint):
+            with open(checkpoint) as cf:
+                for ln in cf:
+                    tok = ln.split()
+                    if tok and tok[0] not in ("FORMAT", "C", "#"):
+                        done_archives.add(tok[0])
         for iarch, datafile in enumerate(datafiles):
+            if datafile in done_archives:
+                if not quiet:
+                    print(f"{datafile} already in checkpoint "
+                          f"{checkpoint}; skipping it.")
+                continue
             data = self._load_archive(datafile, tscrunch, quiet)
             if data is None:
                 continue
@@ -567,6 +586,10 @@ class GetTOAs:
             self.nfevals.append(nfevals)
             self.rcs.append(rcs)
             self.fit_durations.append(fit_duration)
+            if checkpoint is not None:
+                write_TOAs([t for t in self.TOA_list
+                            if t.archive == datafile],
+                           outfile=checkpoint, append=True)
             if not quiet:
                 print("--------------------------")
                 print(datafile)

@@ -370,3 +370,25 @@ def test_cli_ppgauss_interactive_headless(setup):
     rc = main(["-d", clean, "--interactive",
                "-o", str(tmp / "i.gmodel")])
     assert rc == 1
+
+
+def test_cli_pptoas_checkpoint(setup, tmp_path):
+    """--checkpoint is the output, resumes across runs, and rejects
+    post-processing flags."""
+    from pulseportraiture_tpu.cli.pptoas import main
+
+    tmp, gm, par, hot, clean = setup
+    ckpt = str(tmp_path / "ck.tim")
+    assert main(["-d", clean, "-m", gm, "--checkpoint", ckpt,
+                 "--quiet"]) == 0
+    n1 = sum(1 for ln in open(ckpt) if ln.strip())
+    assert n1 >= 1
+    # re-run: archive already checkpointed, nothing appended
+    assert main(["-d", clean, "-m", gm, "--checkpoint", ckpt,
+                 "--quiet"]) == 0
+    assert sum(1 for ln in open(ckpt) if ln.strip()) == n1
+    # incompatible post-processing flags are rejected up front
+    for extra in (["--snr_cut", "5"], ["--one_DM"],
+                  ["-f", "princeton"], ["--narrowband"]):
+        assert main(["-d", clean, "-m", gm, "--checkpoint", ckpt,
+                     "--quiet"] + extra) == 1

@@ -200,3 +200,66 @@ def test_calculate_toa():
     # two-part difference: .mjd() floats cannot resolve sub-ns at 56000
     dsec = (t1.day - e.day) * 86400.0 + (t1.secs - e.secs)
     assert abs(dsec / P - phi_exp) < 1e-9
+
+
+def test_get_toas_odd_nbin(tmp_path):
+    """Odd phase-bin counts (no rFFT Nyquist bin) run end to end."""
+    from pulseportraiture_tpu.io.archive import make_fake_pulsar
+    from pulseportraiture_tpu.io.gmodel import write_model
+
+    gm = str(tmp_path / "o.gmodel")
+    write_model(gm, "o", "000", 1500.0,
+                np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5]),
+                np.ones(8, int), -4.0, 0, quiet=True)
+    par = str(tmp_path / "o.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 100.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    fits = str(tmp_path / "odd.fits")
+    make_fake_pulsar(gm, par, fits, nsub=1, nchan=8, nbin=129,
+                     nu0=1500.0, bw=400.0, tsub=60.0, noise_stds=0.01,
+                     dedispersed=False, seed=0, quiet=True)
+    gt = GetTOAs(fits, gm, quiet=True)
+    gt.get_TOAs(quiet=True)
+    assert len(gt.TOA_list) == 1
+    assert np.isfinite(gt.TOA_list[0].TOA_error)
+
+
+def test_get_toas_checkpoint_resume(tmp_path):
+    """TOAs append to the checkpoint per archive, and a re-run skips
+    archives already written (crash-resume semantics)."""
+    from pulseportraiture_tpu.io.archive import make_fake_pulsar
+    from pulseportraiture_tpu.io.gmodel import write_model
+
+    gm = str(tmp_path / "c.gmodel")
+    write_model(gm, "c", "000", 1500.0,
+                np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5]),
+                np.ones(8, int), -4.0, 0, quiet=True)
+    par = str(tmp_path / "c.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 100.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    files = []
+    for i in range(3):
+        fits = str(tmp_path / ("c%d.fits" % i))
+        make_fake_pulsar(gm, par, fits, nsub=2, nchan=8, nbin=128,
+                         nu0=1500.0, bw=400.0, tsub=60.0, noise_stds=0.01,
+                         dedispersed=False, seed=20 + i, quiet=True)
+        files.append(fits)
+    ckpt = str(tmp_path / "resume.tim")
+
+    # "crashed" first run: only the first archive processed
+    gt1 = GetTOAs(files[0], gm, quiet=True)
+    gt1.get_TOAs(quiet=True, checkpoint=ckpt)
+    lines1 = [ln for ln in open(ckpt) if ln.strip()]
+    assert len(lines1) == 2 and all(ln.split()[0] == files[0]
+                                    for ln in lines1)
+
+    # resumed run over all three: archive 0 skipped, 1-2 appended
+    gt2 = GetTOAs(files, gm, quiet=True)
+    gt2.get_TOAs(quiet=True, checkpoint=ckpt)
+    assert gt2.order == files[1:]  # first archive resumed, not refit
+    lines2 = [ln for ln in open(ckpt) if ln.strip()]
+    assert len(lines2) == 6
+    assert [ln.split()[0] for ln in lines2] == \
+        [files[0]] * 2 + [files[1]] * 2 + [files[2]] * 2
