@@ -12,6 +12,8 @@ parameter sets batches model evaluation inside the Levenberg-Marquardt
 model fitter.
 """
 
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -33,8 +35,10 @@ __all__ = [
     "evolve_parameter",
 ]
 
-# FWHM = 2*sqrt(2*ln 2) * sigma
-FWHM_FACT = 2.0 * jnp.sqrt(2.0 * jnp.log(2.0))
+# FWHM = 2*sqrt(2*ln 2) * sigma — a plain float, NOT a jnp constant:
+# module-level jnp ops dispatch to the default backend at import time,
+# which must never happen (import must be device-free).
+FWHM_FACT = 2.0 * math.sqrt(2.0 * math.log(2.0))
 
 
 def gaussian_function(xs, loc, wid, norm=False):

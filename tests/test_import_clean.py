@@ -1,0 +1,41 @@
+"""Importing the package must never touch a device.
+
+Round-2 regression: a module-level ``jnp`` constant
+(ops/profiles.py FWHM_FACT) dispatched to the default backend at import
+time and killed the driver's multi-chip dry run on an environment-side
+libtpu mismatch before any mesh work began.  Guard: importing every
+package module in a clean subprocess must initialize zero jax backends.
+"""
+
+import subprocess
+import sys
+
+_CHECK = """
+import importlib, pkgutil
+import pulseportraiture_tpu
+for m in pkgutil.walk_packages(pulseportraiture_tpu.__path__,
+                               'pulseportraiture_tpu.'):
+    try:
+        importlib.import_module(m.name)
+    except ImportError as e:
+        # optional extras (e.g. matplotlib for viz) may be absent; that
+        # is not a device-hygiene failure
+        print('skipped %s: %s' % (m.name, e))
+try:
+    from jax._src import xla_bridge
+    backends = getattr(xla_bridge, '_backends', None)
+except ImportError:
+    backends = None
+if backends is None:
+    print('jax internals moved; backend check skipped')
+else:
+    assert not backends, (
+        'import-time device dispatch: backends initialized = %r'
+        % list(backends))
+"""
+
+
+def test_package_import_initializes_no_backends():
+    proc = subprocess.run([sys.executable, "-c", _CHECK],
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr
