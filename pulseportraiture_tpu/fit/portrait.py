@@ -376,12 +376,21 @@ def _covariance_with_scales(H5, cross_hess, S, ifit, ok):
 
 
 def _np_real_positive_roots(coeffs):
-    """Host callback: real, positive roots of a polynomial (np.roots)."""
-    r = np.roots(np.asarray(coeffs, dtype=np.float64))
-    r = np.real(r[np.imag(r) == 0.0])
-    r = r[r > 0.0]
-    out = np.full(8, np.nan)
-    out[:min(len(r), 8)] = r[:8]
+    """Host callback: real, positive roots of polynomials (np.roots).
+
+    Accepts [..., ncoef] stacked coefficient rows (a batched fit makes
+    ONE host round trip for the whole batch — vmap_method="expand_dims"
+    below — instead of one per subint, which through a remote-device
+    tunnel would serialize the batch on ~100 ms dispatches each).
+    """
+    coeffs = np.asarray(coeffs, dtype=np.float64)
+    lead = coeffs.shape[:-1]
+    out = np.full(lead + (8,), np.nan)
+    for idx in np.ndindex(*lead):
+        r = np.roots(coeffs[idx])
+        r = np.real(r[np.imag(r) == 0.0])
+        r = r[r > 0.0]
+        out[idx][:min(len(r), 8)] = r[:8]
     return out
 
 
@@ -389,7 +398,7 @@ def _roots_callback(coeffs):
     return jax.pure_callback(
         _np_real_positive_roots,
         jax.ShapeDtypeStruct((8,), jnp.float64), coeffs,
-        vmap_method="sequential")
+        vmap_method="expand_dims")
 
 
 def _closest_root(roots, target, fallback):
@@ -901,11 +910,23 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
 
 @partial(jax.jit, static_argnames=("fit_flags", "bounds", "log10_tau",
                                    "max_iter", "nu_outs_mask", "scat",
-                                   "pair", "kmax"))
+                                   "pair", "kmax", "scan_size", "cast"))
 def _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b, errs_b,
                 weights_b, nu_fits_b, nu_outs_b, nu_outs_mask, fit_flags,
-                bounds, log10_tau, max_iter, scat, pair, kmax):
+                bounds, log10_tau, max_iter, scat, pair, kmax, scan_size,
+                cast):
+    # a 2-D model is shared by the whole batch (vmap in_axes=None /
+    # scan-body closure) — it is never materialized at [B, nchan, nbin]
+    shared_model = model_ports.ndim == 2
+
     def one(d, m, x0, p, fq, er, w, nf, no):
+        if cast is not None:
+            # cast at the point of use: storage (often f32 through the
+            # device tunnel) and fit precision decouple, and under scan
+            # only one chunk's f64 copy is ever live
+            d = d.astype(cast)
+            m = m.astype(cast)
+            er = er.astype(cast)
         wok = (w > 0.0).astype(fq.dtype)
         fq_mean = (fq * wok).sum() / jnp.maximum(wok.sum(), 1.0)
         nu_fits = tuple(jnp.where(jnp.isnan(nf[i]), fq_mean, nf[i])
@@ -918,8 +939,35 @@ def _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b, errs_b,
                                  log10_tau=log10_tau, max_iter=max_iter,
                                  scat=scat, pair=pair, kmax=kmax)
 
-    return jax.vmap(one)(data_ports, model_ports, init_b, Ps_b, freqs_b,
-                         errs_b, weights_b, nu_fits_b, nu_outs_b)
+    vfit = jax.vmap(one, in_axes=(0, None if shared_model else 0,
+                                  0, 0, 0, 0, 0, 0, 0))
+    batched = (data_ports, init_b, Ps_b, freqs_b, errs_b, weights_b,
+               nu_fits_b, nu_outs_b)
+    if scan_size is None:
+        return vfit(data_ports, model_ports, *batched[1:])
+    # chunked scan: one compiled program the size of a scan_size-batch
+    # fit processes the whole batch in a single dispatch — the compile
+    # footprint of the biggest fit programs stays bounded while the
+    # per-chunk device-call latency (the throughput killer through a
+    # remote-dispatch tunnel) is paid once, not B/scan_size times
+    B = data_ports.shape[0]
+    n = B // scan_size
+
+    def resh(a):
+        return a.reshape((n, scan_size) + a.shape[1:])
+
+    if shared_model:
+        def body(carry, xs):
+            return carry, vfit(xs[0], model_ports, *xs[1:])
+        xs = tuple(map(resh, batched))
+    else:
+        def body(carry, xs):
+            return carry, vfit(xs[0], xs[1], *xs[2:])
+        xs = (resh(data_ports), resh(model_ports)) + tuple(
+            map(resh, batched[1:]))
+    _, out = jax.lax.scan(body, 0, xs)
+    return jax.tree_util.tree_map(
+        lambda a: a.reshape((n * scan_size,) + a.shape[2:]), out)
 
 
 def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
@@ -928,7 +976,7 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
                             nu_fits=(None, None, None),
                             nu_outs=(None, None, None), bounds=None,
                             log10_tau=True, max_iter=50, pair=None,
-                            kmax=None):
+                            kmax=None, scan_size=None, cast=None):
     """vmapped+jitted fit over a batch of subints: data [B, nchan, nbin].
 
     model_ports/freqs broadcast over the batch; returns a DataBunch of
@@ -939,14 +987,30 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
     bucket).  kmax=None derives the model-support harmonic cutoff from
     one [nchan, nbin] row of the concrete model per call (a small
     device->host transfer + host rfft); pass kmax explicitly to pin it.
+
+    ``scan_size``: process the batch as a lax.scan over vmapped chunks
+    of this size inside ONE compiled program — the compile footprint
+    stays that of a scan_size-batch fit while the whole batch costs a
+    single dispatch (the win on remote-dispatch device tunnels).  The
+    batch is padded to a chunk multiple with copies of its last subint
+    and the padding is dropped from the outputs.  Note: fit_flags
+    combinations whose nu_zero needs the polynomial-roots host callback
+    (e.g. (1,1,1,0,0)) make one callback per scan step.
+
+    ``cast``: cast data/model/errs to this dtype *inside* the program —
+    storage dtype (e.g. f32 on device) and fit precision (f64 pair
+    path) decouple without ever materializing a full-batch f64 copy.
     """
     # static harmonic cutoff from the (concrete, pre-broadcast) model
     if kmax is None:
         kmax = model_kmax(model_ports)
     data_ports = jnp.asarray(data_ports)
     B = data_ports.shape[0]
-    model_ports = jnp.broadcast_to(jnp.asarray(model_ports),
-                                   data_ports.shape)
+    model_ports = jnp.asarray(model_ports)
+    if model_ports.ndim == 3 and model_ports.shape[0] == 1:
+        model_ports = model_ports[0]
+    elif model_ports.ndim == 3 and model_ports.shape[0] != B:
+        model_ports = jnp.broadcast_to(model_ports, data_ports.shape)
     freqs = jnp.asarray(freqs)
     freqs_b = jnp.broadcast_to(freqs, (B, freqs.shape[-1])) \
         if freqs.ndim == 1 else freqs
@@ -995,10 +1059,37 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
         nu_outs_b = jnp.broadcast_to(jnp.asarray(nu_outs,
                                                  dtype=jnp.float64),
                                      (B, 3))
-    return _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b,
-                       errs_b, weights_b, nu_fits_b, nu_outs_b,
-                       nu_outs_mask, flags_t, bounds_t, bool(log10_tau),
-                       int(max_iter), scat, pair, kmax)
+    if scan_size is not None:
+        scan_size = int(scan_size)
+        if B <= scan_size:
+            scan_size = None
+    batched = [data_ports, init_b, Ps_b, freqs_b, errs_b, weights_b,
+               nu_fits_b, nu_outs_b]
+    if model_ports.ndim == 3:
+        batched.insert(1, model_ports)
+    if scan_size is not None and B % scan_size != 0:
+        pad = scan_size - B % scan_size
+
+        def _pad(a):
+            return jnp.concatenate(
+                [a, jnp.broadcast_to(a[-1:], (pad,) + a.shape[1:])],
+                axis=0)
+
+        batched = [_pad(a) for a in batched]
+    if model_ports.ndim == 3:
+        data_ports, model_ports, init_b, Ps_b, freqs_b, errs_b, \
+            weights_b, nu_fits_b, nu_outs_b = batched
+    else:
+        data_ports, init_b, Ps_b, freqs_b, errs_b, weights_b, \
+            nu_fits_b, nu_outs_b = batched
+    cast_t = None if cast is None else jnp.dtype(cast).name
+    out = _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b,
+                      errs_b, weights_b, nu_fits_b, nu_outs_b,
+                      nu_outs_mask, flags_t, bounds_t, bool(log10_tau),
+                      int(max_iter), scat, pair, kmax, scan_size, cast_t)
+    if data_ports.shape[0] != B:  # drop scan padding
+        out = jax.tree_util.tree_map(lambda a: a[:B], out)
+    return out
 
 
 def get_scales_full(params, data_port, model_port, P, freqs, nu_DM, nu_GM,

@@ -354,3 +354,81 @@ def test_model_kmax_semantics():
     r_full = fp.fit_portrait_full(data, clean, [0.1, 0.0, 0, 0, 0], P0,
                                   freqs, kmax=nbin // 2 + 1, **kw)
     assert abs(float(r_auto.phi - r_full.phi)) * P0 * 1e9 < 1e-3
+
+
+def test_batched_polynomial_nu_zero_flags_11100(rng):
+    """flags (1,1,1,0,0) routes nu_zero through the degree-6 polynomial
+    root solve; at batch 64 the whole batch must make ONE host callback
+    (vmap_method='expand_dims'), and each batched nu_zero must match the
+    unbatched single-fit value."""
+    B = 64
+    model = make_model()
+    phis = rng.uniform(-0.2, 0.2, B)
+    dDMs = rng.uniform(-1e-3, 1e-3, B)
+    datas = np.stack([
+        np.asarray(rotate_data(model, -phis[i], -dDMs[i], P0, FREQS,
+                               np.mean(FREQS))) for i in range(B)])
+    datas = datas + rng.normal(0, 0.01, datas.shape)
+    init = np.zeros((B, 5))
+    init[:, 0] = phis
+    out = fp.fit_portrait_full_batch(
+        datas, model[None], init, P0, FREQS,
+        errs=np.full((B, NCHAN), 0.01), fit_flags=(1, 1, 1, 0, 0),
+        log10_tau=False, max_iter=50)
+    assert np.isfinite(np.asarray(out.phi)).all()
+    assert np.isfinite(np.asarray(out.nu_DM)).all()
+    # nu_zero must be a genuine in-band polynomial root, not the
+    # fit-frequency fallback
+    assert (np.asarray(out.nu_DM) > FREQS.min() / 4).all()
+    assert (np.asarray(out.nu_DM) < FREQS.max() * 4).all()
+    # batched == unbatched for a few subints
+    for i in (0, 31, 63):
+        one = fp.fit_portrait_full(
+            datas[i], model, init[i], P0, FREQS,
+            errs=np.full(NCHAN, 0.01), fit_flags=(1, 1, 1, 0, 0),
+            log10_tau=False, max_iter=50)
+        np.testing.assert_allclose(float(np.asarray(out.nu_DM)[i]),
+                                   float(one.nu_DM), rtol=1e-8)
+        np.testing.assert_allclose(float(np.asarray(out.phi)[i]),
+                                   float(one.phi), atol=1e-9)
+
+
+def test_scan_size_and_cast_match_plain_batch(rng):
+    """The chunked-scan path (scan_size, incl. padding) and the in-graph
+    cast must reproduce the plain vmapped batch exactly."""
+    B = 10  # scan_size=4 -> 3 chunks with 2 padded rows
+    model = make_model()
+    phis = rng.uniform(-0.2, 0.2, B)
+    dDMs = rng.uniform(-1e-3, 1e-3, B)
+    datas = np.stack([
+        np.asarray(rotate_data(model, -phis[i], -dDMs[i], P0, FREQS,
+                               np.mean(FREQS))) for i in range(B)])
+    datas = (datas + rng.normal(0, 0.01, datas.shape)).astype(np.float64)
+    init = np.zeros((B, 5))
+    init[:, 0] = phis
+    kw = dict(errs=np.full((B, NCHAN), 0.01), fit_flags=(1, 1, 0, 0, 0),
+              log10_tau=False, max_iter=50)
+    ref = fp.fit_portrait_full_batch(datas, model[None], init, P0, FREQS,
+                                     **kw)
+    scanned = fp.fit_portrait_full_batch(datas, model[None], init, P0,
+                                         FREQS, scan_size=4, **kw)
+    np.testing.assert_allclose(np.asarray(scanned.phi),
+                               np.asarray(ref.phi), rtol=0, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(scanned.DM),
+                               np.asarray(ref.DM), rtol=0, atol=1e-12)
+    assert scanned.phi.shape == (B,)
+    # f32 storage + in-graph cast to f64 == f64 storage
+    cast_out = fp.fit_portrait_full_batch(
+        datas.astype(np.float32), model[None].astype(np.float32), init,
+        P0, FREQS, scan_size=4, cast=np.float64, **kw)
+    # the f32 round trip of the *data* perturbs inputs at ~1e-7; the fit
+    # result must stay consistent well below the reported errors
+    np.testing.assert_allclose(np.asarray(cast_out.phi),
+                               np.asarray(ref.phi), atol=5e-6)
+    assert cast_out.phi.dtype == np.float64
+    # per-batch (non-shared) models through the scan path
+    models_b = np.broadcast_to(model, datas.shape).copy()
+    per_model = fp.fit_portrait_full_batch(datas, models_b, init, P0,
+                                           FREQS, scan_size=4, **kw)
+    np.testing.assert_allclose(np.asarray(per_model.phi),
+                               np.asarray(ref.phi), rtol=0, atol=1e-12)
