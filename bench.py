@@ -6,8 +6,11 @@ within 1 ns of the SciPy reference.  Prints ONE JSON line:
 {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": ...}.
 
 vs_baseline is measured throughput / target throughput (1000 fits/60 s);
-> 1 beats the north-star target.  The fit batch is processed in chunks
-sized to HBM; every chunk reuses one compiled executable.
+> 1 beats the north-star target.  The whole batch runs as ONE device
+dispatch: a lax.scan over vmapped fixed-size chunks inside a single
+compiled program (fit_portrait_full_batch(scan_size=...)), so the
+compile footprint stays bounded while no per-chunk dispatch latency is
+paid.
 
 extra carries the other BASELINE.md configs and the accuracy criterion:
 - parity_scipy_max_ns / parity_cpu_f64_max_ns: max |device - oracle| TOA
@@ -132,14 +135,16 @@ def main():
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
     if on_accel:
-        # chunk: throughput is dispatch-latency-bound through the TPU
-        # tunnel (per-chunk wall time is ~flat from 40 to 100 subints),
-        # so bigger is better until the remote compile helper runs out
-        # of memory for the f64 pair program (chunk=200 fails to
-        # compile at 512x2048); 100 is the measured sweet spot
-        nsub, nchan, nbin, chunk = 1000, 512, 2048, 100
+        # scan: the whole batch runs as ONE dispatch — a lax.scan over
+        # vmapped 100-subint chunks inside a single compiled program
+        # (fit_portrait_full_batch(scan_size=...)).  The compile
+        # footprint stays that of a 100-subint program (chunk=200
+        # monolithic fails the remote compile helper; measured r03),
+        # while the tunnel's ~0.3 s dispatch latency is paid once, not
+        # nsub/100 times
+        nsub, nchan, nbin, scan = 1000, 512, 2048, 100
     else:  # CPU smoke config (first-slice scale from BASELINE.md)
-        nsub, nchan, nbin, chunk = 64, 128, 1024, 32
+        nsub, nchan, nbin, scan = 64, 128, 1024, 32
     P0 = 0.005
     noise = 0.05
     # generation/storage dtype; the timed fits run in FULL f64 on every
@@ -174,33 +179,34 @@ def main():
         noise_arr = noise * jax.random.normal(key, base.shape, dtype)
         return (base + noise_arr).astype(dtype)
 
-    # generate all chunks up front (device arrays)
-    keys = jax.random.split(jax.random.key(1), (nsub + chunk - 1) // chunk)
-    chunks = []
-    for ci, i0 in enumerate(range(0, nsub, chunk)):
-        i1 = min(i0 + chunk, nsub)
-        chunks.append(make_chunk(i0, i1, keys[ci]))
-    jax.block_until_ready(chunks)
+    # generate in scan-sized blocks (bounds rotate_data's spectral
+    # temporaries), then concatenate into one device-resident batch
+    keys = jax.random.split(jax.random.key(1), (nsub + scan - 1) // scan)
+    blocks = []
+    for ci, i0 in enumerate(range(0, nsub, scan)):
+        i1 = min(i0 + scan, nsub)
+        blocks.append(make_chunk(i0, i1, keys[ci]))
+    data_all = jnp.concatenate(blocks, axis=0)
+    del blocks
+    jax.block_until_ready(data_all)
     _stage('data generated on device')
 
-    errs = jnp.full((chunk, nchan), noise, fit_dtype)
-    Ps = jnp.full((chunk,), P0, jnp.float64)
-    freqs_b = jnp.broadcast_to(freqs_j, (chunk, nchan))
-    model_b = jnp.broadcast_to(model, (chunk, nchan, nbin))
-    # f64 template broadcast straight from the clean f64 generation (an
-    # f32 round trip would re-flood the spectral tail with noise); the
-    # harmonic cutoff is computed once and passed explicitly
-    model_b64 = jnp.broadcast_to(jnp.asarray(model64), (chunk, nchan, nbin))
+    errs = jnp.full((nsub, nchan), noise, fit_dtype)
+    Ps = jnp.full((nsub,), P0, jnp.float64)
+    # f64 template straight from the clean f64 generation (an f32 round
+    # trip would re-flood the spectral tail with noise); shared 2-D —
+    # never materialized per-subint; harmonic cutoff computed once
+    model64_dev = jnp.asarray(model64)
     KMAX = model_kmax(model64)
 
-    def fit_chunk(data, init):
-        out = fit_portrait_full_batch(
-            data.astype(fit_dtype), model_b64, init, Ps, freqs_b,
-            errs=errs, fit_flags=(1, 1, 0, 0, 0), log10_tau=False,
-            max_iter=30, kmax=KMAX)
-        return out
+    def fit_all(data, init):
+        # storage stays f32; the scan body casts each chunk to f64 for
+        # the pair-path fit (cast=), so no full-batch f64 copy exists
+        return fit_portrait_full_batch(
+            data, model64_dev, init, Ps, freqs_j, errs=errs,
+            fit_flags=(1, 1, 0, 0, 0), log10_tau=False,
+            max_iter=30, kmax=KMAX, scan_size=scan, cast=fit_dtype)
 
-    # warm-up compile on the first chunk (guess + fit)
     def guess_phase(data):
         prof = data.mean(axis=1)
         mprof = jnp.broadcast_to(model.mean(axis=0), prof.shape)
@@ -209,30 +215,22 @@ def main():
                                               dtype)).phase
 
     _stage('compiling guess + fit programs')
-    g0 = jax.block_until_ready(guess_phase(chunks[0]))
-    init0 = jnp.zeros((chunk, 5), jnp.float64).at[:, 0].set(g0)
-    jax.block_until_ready(fit_chunk(chunks[0], init0).phi)
+    g0 = jax.block_until_ready(guess_phase(data_all))
+    init0 = jnp.zeros((nsub, 5), jnp.float64).at[:, 0].set(g0)
+    jax.block_until_ready(fit_all(data_all, init0).phi)
     _stage('compiled; timing main config')
 
-    # timed run over all chunks (seed + fit, end to end on device);
+    # timed end-to-end on device (seed + scanned fit = 2 dispatches);
     # best of two passes — the TPU tunnel's dispatch latency varies
     # with ambient host load, and the sustained-throughput number is
     # the less-loaded pass
     durations = []
     for ipass in range(2):
         t0 = time.time()
-        phis, DMs, phi_errs = [], [], []
-        nus = []
-        for data in chunks:
-            g = guess_phase(data)
-            init = jnp.zeros((data.shape[0], 5),
-                             jnp.float64).at[:, 0].set(g)
-            out = fit_chunk(data, init)
-            phis.append(out.phi)
-            DMs.append(out.DM)
-            phi_errs.append(out.phi_err)
-            nus.append(out.nu_DM)
-        jax.block_until_ready(phis)
+        g = guess_phase(data_all)
+        init = jnp.zeros((nsub, 5), jnp.float64).at[:, 0].set(g)
+        out = fit_all(data_all, init)
+        jax.block_until_ready(out.phi)
         durations.append(time.time() - t0)
         _stage('main config pass %d done in %.1fs'
                % (ipass + 1, durations[-1]))
@@ -240,10 +238,10 @@ def main():
 
     # accuracy vs injections: transform fitted phi back to the injection
     # reference frequency and compare [ns]
-    phi = np.concatenate([np.asarray(p) for p in phis])
-    DM = np.concatenate([np.asarray(d) for d in DMs])
-    nu_ref = np.concatenate([np.asarray(n) for n in nus])
-    phi_err = np.concatenate([np.asarray(e) for e in phi_errs])
+    phi = np.asarray(out.phi)
+    DM = np.asarray(out.DM)
+    nu_ref = np.asarray(out.nu_DM)
+    phi_err = np.asarray(out.phi_err)
     nu0 = float(freqs.mean())
     phi_at_nu0 = phi + Dconst * DM / P0 * (nu0 ** -2.0 - nu_ref ** -2.0)
     resid = (phi_at_nu0 - phis_inj + 0.5) % 1.0 - 0.5
@@ -253,9 +251,9 @@ def main():
 
     # ---- parity vs oracles (the BASELINE <1 ns criterion) -------------
     # pin nu_fit = nu_out = nu0 on all paths so phi/DM compare directly
-    K_cpu = min(32, chunk)
+    K_cpu = min(32, scan)
     K_scipy = 4
-    data_par = chunks[0][:K_cpu]
+    data_par = data_all[:K_cpu]
     nus_pin = np.tile([nu0, nu0, nu0], (K_cpu, 1))
     init_par = np.zeros((K_cpu, 5))
     init_par[:, 0] = phis_inj[:K_cpu]
@@ -263,8 +261,8 @@ def main():
 
     def pinned_fit(data, nsel, dtype_sel, kmax=None):
         return fit_portrait_full_batch(
-            jnp.asarray(data, dtype_sel), model_b64[:nsel].astype(dtype_sel),
-            init_par[:nsel], Ps[:nsel], freqs_b[:nsel],
+            jnp.asarray(data, dtype_sel), model64_dev.astype(dtype_sel),
+            init_par[:nsel], Ps[:nsel], freqs_j,
             errs=errs[:nsel].astype(dtype_sel),
             fit_flags=(1, 1, 0, 0, 0), nu_fits=nus_pin[:nsel],
             nu_outs=(nus_pin[:nsel, 0], nus_pin[:nsel, 1],
@@ -305,9 +303,11 @@ def main():
     parity_scipy_ns = float(np.max(parity_scipy))
 
     # ---- scattering joint fit (flags 11011, log10 tau) ----------------
-    # the scattering chain carries ~3x the per-subint temporaries of the
-    # phase+DM fit; batch 100 exhausts HBM at 512x2048, 40 fits
-    scat_B = min(chunk, 40)
+    # full north-star scale: all nsub subints in ONE scanned dispatch on
+    # device-resident data (r02 timed a 335 MB host->device transfer
+    # inside this stage and read 0.726 fits/s; the kernel itself runs
+    # at ~100 fits/s once the data lives on device)
+    scat_B = nsub if on_accel else min(nsub, 32)  # CPU: smoke scale
     tau_inj = 3e-3  # rot at nu0
     from pulseportraiture_tpu.ops.scattering import (scattering_portrait_FT,
                                                      scattering_times)
@@ -317,14 +317,26 @@ def main():
     spFT = scattering_portrait_FT(taus_chan, nbin)
     scat_model = jnp.fft.irfft(spFT * jnp.fft.rfft(model, axis=-1),
                                nbin, axis=-1).astype(dtype)
-    ph_s = jnp.asarray(phis_inj[:scat_B])
-    dm_s = jnp.asarray(dDMs_inj[:scat_B])
-    scat_base = jax.vmap(
-        lambda p, d: rotate_data(scat_model, -p, -d, P0, freqs_j,
-                                 nu0))(ph_s, dm_s)
-    scat_data = np.asarray(scat_base) + np.asarray(
-        noise * jax.random.normal(jax.random.key(3), scat_base.shape,
-                                  dtype))
+    del data_all  # free the main-config batch before building this one
+
+    def make_scat_block(i0, i1, key):
+        ph = jnp.asarray(phis_inj[i0:i1])
+        dm = jnp.asarray(dDMs_inj[i0:i1])
+        base = jax.vmap(
+            lambda p, d: rotate_data(scat_model, -p, -d, P0, freqs_j,
+                                     nu0))(ph, dm)
+        return (base + noise * jax.random.normal(key, base.shape,
+                                                 dtype)).astype(dtype)
+
+    skeys = jax.random.split(jax.random.key(3),
+                             (scat_B + scan - 1) // scan)
+    blocks = []
+    for ci, i0 in enumerate(range(0, scat_B, scan)):
+        blocks.append(make_scat_block(i0, min(i0 + scan, scat_B),
+                                      skeys[ci]))
+    scat_data = jnp.concatenate(blocks, axis=0)
+    del blocks
+    jax.block_until_ready(scat_data)
     scat_init = np.zeros((scat_B, 5))
     scat_init[:, 0] = phis_inj[:scat_B]
     scat_init[:, 1] = dDMs_inj[:scat_B]
@@ -334,21 +346,27 @@ def main():
     nus_pin_s = np.tile([nu0, nu0, nu0], (scat_B, 1))
 
     def scat_fit():
-        # full f64 (hybrid pair path covers the scattering chain too)
+        # full f64 (hybrid pair path covers the scattering chain too);
+        # f32 storage, per-chunk in-scan cast as in the main config
         return fit_portrait_full_batch(
-            jnp.asarray(scat_data, fit_dtype), model_b64[:scat_B],
-            scat_init, Ps[:scat_B], freqs_b[:scat_B],
+            scat_data, model64_dev, scat_init, Ps[:scat_B], freqs_j,
             errs=errs[:scat_B], fit_flags=(1, 1, 0, 1, 1),
             nu_fits=nus_pin_s,
             nu_outs=(nus_pin_s[:, 0], nus_pin_s[:, 1], nus_pin_s[:, 2]),
-            log10_tau=True, max_iter=30, kmax=KMAX)
+            log10_tau=True, max_iter=30, kmax=KMAX, scan_size=scan,
+            cast=fit_dtype)
 
     _stage('scattering fit: compiling')
     jax.block_until_ready(scat_fit().phi)  # compile
-    t0 = time.time()
-    sout = scat_fit()
-    jax.block_until_ready(sout.phi)
-    scat_dur = time.time() - t0
+    scat_durs = []
+    for ipass in range(2):
+        t0 = time.time()
+        sout = scat_fit()
+        jax.block_until_ready(sout.phi)
+        scat_durs.append(time.time() - t0)
+        _stage('scattering pass %d done in %.1fs'
+               % (ipass + 1, scat_durs[-1]))
+    scat_dur = min(scat_durs)
     tau_fit = np.median(10 ** np.asarray(sout.tau))
 
     # ---- IPTA sweep: 20 pulsars x 10 epochs (sharded path) ------------
@@ -385,7 +403,10 @@ def main():
     ipta_dur = time.time() - t0
 
     # ---- ppalign batch (BASELINE '500 homogeneous archives', scaled) --
-    n_arch = 24 if on_accel else 8
+    # 100 archives exercises the streaming-block host-memory bound
+    # (pipelines/align.py caps resident subints per block); generation
+    # (host-side FITS writing) is outside the timed region
+    n_arch = 100 if on_accel else 8
     align_dur = _align_batch(n_arch=n_arch)
 
     # ---- rough sustained FLOP/s for the main config -------------------
@@ -418,6 +439,8 @@ def main():
             "parity_cpu_f64_max_dDM": round(float(np.max(np.abs(
                 dev_DM - cpu_DM))), 9),
             "scat_fits_per_sec": round(scat_B / scat_dur, 3),
+            "scat_config": f"{scat_B}x{nchan}x{nbin}",
+            "scat_duration_sec": round(scat_dur, 3),
             "scat_tau_rel_err": round(abs(tau_fit - tau_inj) / tau_inj,
                                       4),
             "ipta_fits_per_sec": round(np_ * ne / ipta_dur, 3),
