@@ -18,6 +18,7 @@ from ..utils.databunch import DataBunch
 from ..utils.mjd import MJD
 from ..utils.telescopes import telescope_code_dict
 from .gmodel import read_model
+from .polyco import polyco_from_spin
 from .psrfits import Archive, read_archive
 
 __all__ = ["load_data", "unload_new_archive", "make_fake_pulsar",
@@ -142,7 +143,9 @@ def load_data(filename, state=None, dedisperse=False, dededisperse=False,
     return DataBunch(
         arch=arch if return_arch else None, backend=arch.backend,
         backend_delay=arch.backend_delay, bw=bw,
-        doppler_factors=doppler_factors, DM=DM, dmc=dmc, epochs=epochs,
+        doppler_factors=doppler_factors,
+        doppler_degraded=getattr(arch, "doppler_degraded", False),
+        DM=DM, dmc=dmc, epochs=epochs,
         filename=getattr(arch, "filename", str(filename)),
         flux_prof=flux_profile, freqs=freqs, frontend=arch.frontend,
         integration_length=integration_length, masks=masks, nbin=nbin,
@@ -208,19 +211,48 @@ def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
                              (nchan,))
     par = read_par(ephemeris)
     P0 = float(par.P0)
+    F0 = float(par.F0)
+    F1 = float(par.get("F1", 0.0))
     DM = float(par.get("DM", 0.0))
     PEPOCH = float(par.get("PEPOCH", 56000.0))
     if start_MJD is None:
         start_MJD = MJD.from_mjd(PEPOCH)
     epochs = [start_MJD.add_seconds(tsub / 2.0 + isub * tsub)
               for isub in range(nsub)]
+    # per-subint folding periods from the (F0, F1) spin model at each
+    # epoch — matching the reference's per-Integration
+    # get_folding_period() (/root/reference/pplib.py:2733, :3343); a
+    # matching POLYCO predictor is attached so the period drift
+    # round-trips through the PSRFITS layer
+    if F1 != 0.0:
+        polyco = polyco_from_spin(F0, F1, PEPOCH, psr=str(
+            par.get("PSR", par.get("PSRJ", "FAKE"))))
+        Ps_sub = polyco.periods([ep.mjd() for ep in epochs])
+    else:
+        polyco = None
+        Ps_sub = np.full(nsub, P0)
+    # Phase-align each subint epoch to the spin model, as folding with a
+    # predictor does (PSRCHIVE archives are phase-connected: bin 0 of
+    # every subint corresponds to predictor pulse-phase zero near its
+    # epoch).  Without this the synthetic TOAs cannot time coherently
+    # across epochs (the notebook's tempo GLS stage would see uniform
+    # junk residuals).
+    pe_day = int(PEPOCH)
+    pe_sec = (PEPOCH - pe_day) * 86400.0
+    dts = np.array([(ep.day - pe_day) * 86400.0 + (ep.secs - pe_sec)
+                    for ep in epochs])
+    spin_phase = F0 * dts + 0.5 * F1 * dts * dts
+    epochs = [ep.add_seconds(-float((spin_phase[i] % 1.0) * Ps_sub[i]))
+              for i, ep in enumerate(epochs)]
+    if polyco is not None:  # periods exactly at the (shifted) epochs
+        Ps_sub = polyco.periods([ep.mjd() for ep in epochs])
     if weights is None:
         weights = np.ones([nsub, nchan])
 
     key = jax.random.key(seed)
     data = np.zeros([nsub, npol, nchan, nbin])
     for isub in range(nsub):
-        P = P0
+        P = float(Ps_sub[isub])
         _, _, model = read_model(modelfile, phases_arr, freqs, P,
                                  quiet=True)
         model = np.asarray(model)
@@ -252,12 +284,12 @@ def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
             noise * noise_stds[:, None]
 
     ephem_text = open(ephemeris).read()
-    arch = Archive(data, freqs, weights, np.full(nsub, P0), epochs,
+    arch = Archive(data, freqs, weights, Ps_sub, epochs,
                    np.full(nsub, tsub), DM=DM,
                    state=("Intensity" if npol == 1 else state),
                    dedispersed=True, source=str(par.get("PSR", "FAKE")),
                    telescope=telescope, nu0=nu0, bw=bw,
-                   ephemeris_text=ephem_text)
+                   ephemeris_text=ephem_text, polyco=polyco)
     # The model is built at its intrinsic (aligned) phases = the
     # dedispersed frame; inject the (phase, dDM) rotation, then store
     # dispersed or dedispersed as requested.
@@ -265,7 +297,7 @@ def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
         if xs is None:
             arch.data = np.asarray(
                 rotate_data(arch.data, -phase, -dDM,
-                            np.full(nsub, P0), freqs, nu0))
+                            Ps_sub, freqs, nu0))
     if not dedispersed:
         arch.dededisperse()
     arch.unload(outfile, quiet=quiet)

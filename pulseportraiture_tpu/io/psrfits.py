@@ -11,15 +11,26 @@ a PSRPARAM table carrying the ephemeris text; a SUBINT BINTABLE with
 TSUBINT, OFFS_SUB, PERIOD, DAT_FREQ, DAT_WTS, DAT_SCL, DAT_OFFS and
 int16 DATA (TDIM (nbin, nchan, npol)), physical = DATA*SCL + OFFS.  This
 matches the fold-mode PSRFITS core used by PSRCHIVE (scale/offset
-semantics and column names per the PSRFITS definition); PERIOD is
-carried as an explicit column rather than via polycos.
+semantics and column names per the PSRFITS definition).
+
+Folding periods: real fold-mode archives carry a POLYCO or T2PREDICT
+HDU and the period drifts across subints (the reference reads
+``get_folding_period()`` per Integration, /root/reference/pplib.py:2733,
+:3343).  The reader resolves per-subint periods in priority order:
+explicit PERIOD column > POLYCO HDU evaluated at each epoch > T2PREDICT
+HDU > single-F0 ephemeris fallback (with a warning).  The writer emits
+a POLYCO HDU whenever ``Archive.polyco`` is set.
 """
+
+import sys
 
 import numpy as np
 
 from ..utils.databunch import DataBunch
 from ..utils.mjd import MJD
 from .fits import HDU, Header, read_fits, write_bintable_hdu, write_fits
+from .polyco import (ChebyModelSet, Polyco, PolycoSegment,
+                     parse_t2predict_text)
 
 __all__ = ["Archive", "read_archive", "write_archive_file"]
 
@@ -54,7 +65,8 @@ class Archive:
                  source="FAKE", telescope="GBT", frontend="unknown",
                  backend="unknown", backend_delay=0.0, nu0=None, bw=None,
                  ephemeris_text="", doppler_factors=None,
-                 parallactic_angles=None, filename=""):
+                 parallactic_angles=None, filename="", polyco=None,
+                 doppler_degraded=False):
         self.data = np.asarray(data, dtype=np.float64)
         self.nsub, self.npol, self.nchan, self.nbin = self.data.shape
         self.freqs = np.asarray(freqs, dtype=np.float64)
@@ -82,6 +94,9 @@ class Archive:
         # from the observatory + source geometry (the reference gets
         # them from PSRCHIVE, pplib.py:2697-2708); unity/zero fallback
         # when the coordinates are unknown.
+        # True when the factors are the fabricated unity fallback (set
+        # below, or propagated by a caller copying a degraded archive)
+        self.doppler_degraded = bool(doppler_degraded)
         if doppler_factors is None or parallactic_angles is None:
             from ..utils.ephem import doppler_parangle_for_archive
 
@@ -91,14 +106,21 @@ class Archive:
                 self.epochs, ephemeris_text, telescope,
                 warn=doppler_factors is None)
             if doppler_factors is None:
-                doppler_factors = dfs if dfs is not None \
-                    else np.ones(self.nsub)
+                if dfs is None:
+                    # unity fallback: downstream bary=True corrections
+                    # silently become topocentric — record it so TOAs
+                    # can carry a -pp_topo flag
+                    self.doppler_degraded = True
+                    doppler_factors = np.ones(self.nsub)
+                else:
+                    doppler_factors = dfs
             if parallactic_angles is None:
                 parallactic_angles = pas if pas is not None \
                     else np.zeros(self.nsub)
         self.doppler_factors = np.asarray(doppler_factors)
         self.parallactic_angles = np.asarray(parallactic_angles)
         self.filename = filename
+        self.polyco = polyco  # Polyco predictor the data was folded with
 
     def copy(self):
         return Archive(self.data.copy(), self.freqs.copy(),
@@ -112,7 +134,8 @@ class Archive:
                        bw=self.bw, ephemeris_text=self.ephemeris_text,
                        doppler_factors=self.doppler_factors.copy(),
                        parallactic_angles=self.parallactic_angles.copy(),
-                       filename=self.filename)
+                       filename=self.filename, polyco=self.polyco,
+                       doppler_degraded=self.doppler_degraded)
 
     # -- state ----------------------------------------------------------
     def convert_state(self, state):
@@ -209,8 +232,15 @@ class Archive:
         self.filename = filename
 
 
-def write_archive_file(arch, filename, nbits=16, quiet=True):
-    """Encode an Archive to a PSRFITS file (int16 + per-profile scale)."""
+def write_archive_file(arch, filename, nbits=16, quiet=True,
+                       period_column=True):
+    """Encode an Archive to a PSRFITS file (int16 + per-profile scale).
+
+    ``period_column=False`` omits the explicit PERIOD column, as
+    psrchive/dspsr-produced archives do — per-subint periods must then
+    come from the POLYCO HDU (written when ``arch.polyco`` is set) or
+    the ephemeris.
+    """
     nsub, npol, nchan, nbin = arch.data.shape
     start = arch.epochs[0] - float(arch.durations[0]) / 2.0 / 86400.0
 
@@ -250,13 +280,40 @@ def write_archive_file(arch, filename, nbits=16, quiet=True):
     q = np.clip(q, -(2 ** (nbits - 1) - 1), 2 ** (nbits - 1) - 1)
     enc = q.astype(np.int16)
 
+    if getattr(arch, "polyco", None) is not None:
+        segs = arch.polyco.segments
+        ncoef = max(len(s.coeffs) for s in segs)
+        hdus.append(write_bintable_hdu("POLYCO", {
+            "NSPAN": np.array([s.nspan for s in segs], np.float64),
+            "NCOEF": np.array([len(s.coeffs) for s in segs], np.int16),
+            "NSITE": np.array([s.site.ljust(8)[:8] for s in segs], "S8"),
+            "REF_FREQ": np.array([s.ref_freq for s in segs], np.float64),
+            "REF_MJD": np.array([s.tmid for s in segs], np.float64),
+            "REF_PHS": np.array([s.rphase for s in segs], np.float64),
+            "REF_F0": np.array([s.f0ref for s in segs], np.float64),
+            "LGFITERR": np.array([s.log10_fit_err for s in segs],
+                                 np.float64),
+            "COEFF": np.stack([np.pad(s.coeffs,
+                                      (0, ncoef - len(s.coeffs)))
+                               for s in segs]).astype(np.float64),
+        }))
+
     offs_sub = np.array([ep - start for ep in arch.epochs])  # seconds
     columns = {
         "TSUBINT": arch.durations.astype(np.float64),
         "OFFS_SUB": offs_sub.astype(np.float64),
-        "PERIOD": arch.Ps.astype(np.float64),
-        "DOPPLER": arch.doppler_factors.astype(np.float64),
-        "PAR_ANG": arch.parallactic_angles.astype(np.float64),
+    }
+    if period_column:
+        columns["PERIOD"] = arch.Ps.astype(np.float64)
+    if not getattr(arch, "doppler_degraded", False):
+        # never persist the fabricated unity/zero fallback as if it were
+        # measured: a degraded archive re-reads as degraded (and flags
+        # its bary TOAs) instead of laundering ones into the file
+        columns.update({
+            "DOPPLER": arch.doppler_factors.astype(np.float64),
+            "PAR_ANG": arch.parallactic_angles.astype(np.float64),
+        })
+    columns.update({
         "DAT_FREQ": arch.freqs.astype(np.float64),
         "DAT_WTS": arch.weights.astype(np.float32),
         "DAT_OFFS": offs.reshape(nsub, npol * nchan).astype(np.float32),
@@ -264,7 +321,7 @@ def write_archive_file(arch, filename, nbits=16, quiet=True):
         # FITS TDIM is reversed relative to the numpy shape:
         # (nbin, nchan, npol) in the header
         "DATA": enc,
-    }
+    })
     extra = [
         ("INT_TYPE", "TIME", "Time axis"),
         ("INT_UNIT", "SEC", ""),
@@ -289,12 +346,50 @@ def write_archive_file(arch, filename, nbits=16, quiet=True):
         print("Unloaded %s." % filename)
 
 
+def _polyco_from_hdu(hdu):
+    """POLYCO BINTABLE -> Polyco (one segment per row)."""
+    cols = hdu.columns
+    nseg = hdu.header["NAXIS2"]
+    coeff = np.asarray(cols["COEFF"], dtype=np.float64).reshape(nseg, -1)
+    ncoef = np.asarray(cols.get("NCOEF", [coeff.shape[1]] * nseg),
+                       dtype=np.int64).reshape(nseg)
+    sites = cols.get("NSITE", [b"@"] * nseg)
+    segs = []
+    for i in range(nseg):
+        site = sites[i]
+        site = site.decode() if isinstance(site, bytes) else str(site)
+        segs.append(PolycoSegment(
+            float(np.ravel(cols["REF_MJD"])[i]),
+            float(np.ravel(cols["REF_PHS"])[i]),
+            float(np.ravel(cols["REF_F0"])[i]),
+            coeff[i, :ncoef[i]],
+            nspan=float(np.ravel(cols.get("NSPAN", [1440] * nseg))[i]),
+            ref_freq=float(np.ravel(cols.get("REF_FREQ",
+                                             [0.0] * nseg))[i]),
+            site=site.strip(),
+            log10_fit_err=float(np.ravel(cols.get("LGFITERR",
+                                                  [0.0] * nseg))[i])))
+    return Polyco(segs)
+
+
+def _t2predict_from_hdu(hdu):
+    """T2PREDICT BINTABLE (text rows) -> ChebyModelSet."""
+    col = hdu.columns.get("PREDICT")
+    if col is None:
+        return None
+    text = "\n".join(v.decode() if isinstance(v, bytes) else str(v)
+                     for v in np.ravel(col))
+    return parse_t2predict_text(text)
+
+
 def read_archive(filename):
     """Decode a PSRFITS file into an Archive."""
     hdus = read_fits(filename)
     primary = hdus[0].header
     subint = None
     ephemeris_text = ""
+    polyco = None
+    t2pred = None
     for hdu in hdus[1:]:
         name = str(hdu.header.get("EXTNAME", "")).strip()
         if name == "SUBINT":
@@ -305,6 +400,10 @@ def read_archive(filename):
                 ephemeris_text = "\n".join(
                     v.decode() if isinstance(v, bytes) else str(v)
                     for v in col)
+        elif name == "POLYCO":
+            polyco = _polyco_from_hdu(hdu)
+        elif name in ("T2PREDICT", "T2PRED"):
+            t2pred = _t2predict_from_hdu(hdu)
     if subint is None:
         raise ValueError(f"{filename}: no SUBINT HDU found.")
     sh = subint.header
@@ -336,10 +435,22 @@ def read_archive(filename):
     offs_sub = np.asarray(cols.get("OFFS_SUB", np.zeros(nsub)),
                           dtype=np.float64)
     epochs = [start.add_seconds(float(o)) for o in offs_sub]
+    # folding periods, in priority order: explicit PERIOD column >
+    # POLYCO evaluated at each subint epoch > T2PREDICT > single-F0
+    # ephemeris fallback (warned: real periods drift across subints,
+    # ref /root/reference/pplib.py:2733)
     if "PERIOD" in cols:
         Ps = np.asarray(cols["PERIOD"], dtype=np.float64).reshape(nsub)
+    elif polyco is not None:
+        Ps = polyco.periods([ep.mjd() for ep in epochs])
+    elif t2pred is not None:
+        nu_pred = float(primary.get("OBSFREQ",
+                                    np.asarray(freqs).mean()))
+        Ps = t2pred.periods([ep.mjd() for ep in epochs], nu_pred)
     else:
-        # fall back to ephemeris F0
+        print(f"Warning: {filename} has no PERIOD column and no "
+              "POLYCO/T2PREDICT HDU; folding all subints at the "
+              "ephemeris F0 (periods do not drift).", file=sys.stderr)
         Ps = np.full(nsub, _period_from_ephemeris(ephemeris_text))
     pol_type = str(sh.get("POL_TYPE", "AA+BB")).strip()
     state = str(sh.get("STATE", "")).strip() or \
@@ -364,7 +475,7 @@ def read_archive(filename):
         nu0=float(primary.get("OBSFREQ", freqs.mean())),
         bw=float(primary.get("OBSBW", 0.0)) or None,
         ephemeris_text=ephemeris_text, doppler_factors=dop,
-        parallactic_angles=par, filename=filename)
+        parallactic_angles=par, filename=filename, polyco=polyco)
 
 
 def _period_from_ephemeris(text):
