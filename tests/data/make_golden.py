@@ -1,0 +1,209 @@
+"""Generate the vendored psrchive-style PSRFITS fixture (golden bytes).
+
+Hand-rolls the FITS structure with raw struct packing — deliberately NOT
+via pulseportraiture_tpu.io.fits — so the committed binary is an
+independent encoding of the conventions psrchive/dspsr-produced fold
+archives use and this repo's own writer does not:
+
+* descending-frequency band (negative CHAN_BW, DAT_FREQ high -> low),
+* 4-pol Coherence data, POL_TYPE = AABBCRCI,
+* signed int16 DATA with non-trivial per-profile DAT_SCL / DAT_OFFS,
+* per-subint DAT_FREQ rows,
+* NO explicit PERIOD column — folding periods come from a POLYCO HDU,
+* column names/orders per the PSRFITS definition used by PSRCHIVE
+  (ref /root/reference/pplib.py:2650-2820 consumes these via PSRCHIVE).
+
+Run from the repo root:  python tests/data/make_golden.py
+Writes psrchive_style.fits + psrchive_style_expected.npz next to itself.
+"""
+
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+NSUB, NPOL, NCHAN, NBIN = 2, 4, 4, 32
+F0, F1, PEPOCH = 218.8118439, -4.083e-16, 55555.0
+DM = 12.5
+STT_IMJD, STT_SMJD, STT_OFFS = 55555, 43200, 0.125
+TSUB = 600.0
+FREQS = np.array([1725.0, 1675.0, 1625.0, 1575.0])  # descending
+EPHEM_LINES = [
+    "PSRJ            J1234+5678",
+    "RAJ             12:34:00.0",
+    "DECJ            56:78:00.0",  # deliberately odd; unused in checks
+    "F0              %.7f" % F0,
+    "F1              %.3e" % F1,
+    "PEPOCH          %.1f" % PEPOCH,
+    "DM              %.1f" % DM,
+]
+
+
+def card(key, value, comment=""):
+    if isinstance(value, bool):
+        v = "T" if value else "F"
+        body = "%-8s= %20s" % (key, v)
+    elif isinstance(value, (int, np.integer)):
+        body = "%-8s= %20d" % (key, value)
+    elif isinstance(value, float):
+        body = "%-8s= %20s" % (key, repr(value))
+    else:
+        body = "%-8s= %-20s" % (key, "'%s'" % str(value).ljust(8))
+    if comment:
+        body += " / " + comment
+    return body[:80].ljust(80)
+
+
+def header_block(cards):
+    text = "".join(cards) + "END".ljust(80)
+    pad = (-len(text)) % 2880
+    return (text + " " * pad).encode("ascii")
+
+
+def data_block(raw):
+    pad = (-len(raw)) % 2880
+    return raw + b"\x00" * pad
+
+
+def bintable(name, cols, extra_cards=()):
+    """cols: list of (ttype, tform, tdim_or_None, bytes-per-row list)."""
+    nrows = len(cols[0][3])
+    row_bytes = sum(len(c[3][0]) for c in cols)
+    cards = [
+        card("XTENSION", "BINTABLE", "binary table extension"),
+        card("BITPIX", 8), card("NAXIS", 2),
+        card("NAXIS1", row_bytes), card("NAXIS2", nrows),
+        card("PCOUNT", 0), card("GCOUNT", 1),
+        card("TFIELDS", len(cols)),
+    ]
+    for i, (ttype, tform, tdim, _) in enumerate(cols, 1):
+        cards.append(card("TTYPE%d" % i, ttype))
+        cards.append(card("TFORM%d" % i, tform))
+        if tdim:
+            cards.append(card("TDIM%d" % i, tdim))
+    cards.append(card("EXTNAME", name))
+    cards.extend(extra_cards)
+    raw = b"".join(b"".join(c[3][r] for c in cols) for r in range(nrows))
+    return header_block(cards) + data_block(raw)
+
+
+def main():
+    rng = np.random.default_rng(12345)
+
+    # analytic 4-pol profiles: AA/BB strong pulses, CR/CI weak
+    phases = (np.arange(NBIN) + 0.5) / NBIN
+    pulse = np.exp(-0.5 * ((phases - 0.25) / 0.04) ** 2)
+    data_phys = np.zeros((NSUB, NPOL, NCHAN, NBIN))
+    for isub in range(NSUB):
+        for ipol in range(NPOL):
+            for ichan in range(NCHAN):
+                amp = (1.0 + 0.2 * ichan) if ipol < 2 else 0.12
+                base = 0.5 + 0.1 * ipol
+                data_phys[isub, ipol, ichan] = base + amp * pulse
+    data_phys += rng.normal(0, 0.01, data_phys.shape)
+
+    # int16 encode with nontrivial scales/offsets (signed, zero margin
+    # conventions differ from this repo's writer on purpose)
+    dmax = data_phys.max(axis=-1)
+    dmin = data_phys.min(axis=-1)
+    scl = (dmax - dmin) / 60000.0
+    offs = (dmax + dmin) / 2.0
+    q = np.rint((data_phys - offs[..., None]) / scl[..., None])
+    q = np.clip(q, -32767, 32767).astype(np.int16)
+    # the file stores DAT_SCL/DAT_OFFS as float32 ('E' columns): the
+    # exact decode is against the f32-rounded values
+    scl32 = scl.astype(np.float32).astype(np.float64)
+    offs32 = offs.astype(np.float32).astype(np.float64)
+    data_quant = q * scl32[..., None] + offs32[..., None]
+
+    weights = np.ones((NSUB, NCHAN))
+    weights[:, 2] = 0.0  # one zapped channel
+
+    # ---- primary HDU ----
+    primary = header_block([
+        card("SIMPLE", True, "file conforms to FITS standard"),
+        card("BITPIX", 8), card("NAXIS", 0),
+        card("EXTEND", True),
+        card("HDRVER", "6.1"), card("FITSTYPE", "PSRFITS"),
+        card("OBS_MODE", "PSR"),
+        card("TELESCOP", "GBT"), card("FRONTEND", "Rcvr1_2"),
+        card("BACKEND", "GUPPI"), card("BE_DELAY", 0.0),
+        card("OBSFREQ", 1650.0), card("OBSBW", -200.0),
+        card("OBSNCHAN", NCHAN), card("SRC_NAME", "J1234+5678"),
+        card("STT_IMJD", STT_IMJD), card("STT_SMJD", STT_SMJD),
+        card("STT_OFFS", STT_OFFS),
+    ])
+
+    # ---- PSRPARAM ----
+    w = max(len(ln) for ln in EPHEM_LINES)
+    param_rows = [[ln.ljust(w).encode("ascii")] for ln in EPHEM_LINES]
+    psrparam = bintable("PSRPARAM", [
+        ("PARAM", "%dA" % w, None, [r[0] for r in param_rows]),
+    ])
+
+    # ---- POLYCO (single segment, tempo convention) ----
+    # f0ref at tmid; coeffs [c0, c1, c2] with c2 = 1800*F1 (exact for a
+    # quadratic spin-down, see io/polyco.polyco_from_spin)
+    tmid = PEPOCH
+    be = np.dtype(">f8")
+    polyco = bintable("POLYCO", [
+        ("NSPAN", "1D", None, [np.array(1440.0, be).tobytes()] * 1),
+        ("NCOEF", "1I", None, [np.array(3, ">i2").tobytes()] * 1),
+        ("NSITE", "8A", None, [b"@       "]),
+        ("REF_FREQ", "1D", None,
+         [np.array(1650.0, be).tobytes()]),
+        ("REF_MJD", "1D", None, [np.array(tmid, be).tobytes()]),
+        ("REF_PHS", "1D", None, [np.array(0.0, be).tobytes()]),
+        ("REF_F0", "1D", None, [np.array(F0, be).tobytes()]),
+        ("LGFITERR", "1D", None,
+         [np.array(-6.0, be).tobytes()]),
+        ("COEFF", "3D", None,
+         [np.array([0.0, 0.0, 1800.0 * F1]).astype(be).tobytes()]),
+    ])
+
+    # ---- SUBINT ----
+    offs_sub = np.array([TSUB / 2 + i * TSUB for i in range(NSUB)])
+    rows = []
+    for isub in range(NSUB):
+        rows.append((
+            np.array(TSUB, be).tobytes(),
+            np.array(offs_sub[isub], be).tobytes(),
+            FREQS.astype(be).tobytes(),
+            weights[isub].astype(">f4").tobytes(),
+            offs[isub].reshape(-1).astype(">f4").tobytes(),
+            scl[isub].reshape(-1).astype(">f4").tobytes(),
+            q[isub].reshape(-1).astype(">i2").tobytes(),
+        ))
+    subint = bintable("SUBINT", [
+        ("TSUBINT", "1D", None, [r[0] for r in rows]),
+        ("OFFS_SUB", "1D", None, [r[1] for r in rows]),
+        ("DAT_FREQ", "%dD" % NCHAN, None, [r[2] for r in rows]),
+        ("DAT_WTS", "%dE" % NCHAN, None, [r[3] for r in rows]),
+        ("DAT_OFFS", "%dE" % (NPOL * NCHAN), None, [r[4] for r in rows]),
+        ("DAT_SCL", "%dE" % (NPOL * NCHAN), None, [r[5] for r in rows]),
+        ("DATA", "%dI" % (NPOL * NCHAN * NBIN),
+         "(%d,%d,%d)" % (NBIN, NCHAN, NPOL), [r[6] for r in rows]),
+    ], extra_cards=[
+        card("INT_TYPE", "TIME"), card("INT_UNIT", "SEC"),
+        card("SCALE", "FluxDen"), card("POL_TYPE", "AABBCRCI"),
+        card("NPOL", NPOL), card("TBIN", (1.0 / F0) / NBIN),
+        card("NBIN", NBIN), card("NCHAN", NCHAN),
+        card("CHAN_BW", -50.0), card("DM", DM),
+        card("NBITS", 1), card("NSBLK", 1),
+        card("EPOCHS", "MIDTIME"),
+    ])
+
+    with open(os.path.join(HERE, "psrchive_style.fits"), "wb") as f:
+        f.write(primary + psrparam + polyco + subint)
+
+    np.savez(os.path.join(HERE, "psrchive_style_expected.npz"),
+             data=data_quant, freqs=FREQS, weights=weights,
+             offs_sub=offs_sub, tsub=TSUB, F0=F0, F1=F1, PEPOCH=PEPOCH,
+             DM=DM, stt=np.array([STT_IMJD, STT_SMJD, STT_OFFS]))
+    print("wrote psrchive_style.fits (%d bytes)"
+          % os.path.getsize(os.path.join(HERE, "psrchive_style.fits")))
+
+
+if __name__ == "__main__":
+    main()
