@@ -141,6 +141,35 @@ def main(argv=None):
     gt = GetTOAs(datafiles=args.datafiles, modelfile=args.modelfile,
                  quiet=args.quiet)
     if args.psrchive:
+        # cross-check mode delegates to external PSRCHIVE 'pat': the
+        # fit-configuration and post-processing flags below have no
+        # effect there — reject them instead of silently ignoring them
+        ignored = [flag for flag, on in [
+            ("--narrowband", args.narrowband),
+            ("--checkpoint", args.checkpoint is not None),
+            ("--snr_cut", args.snr_cutoff > 0.0),
+            ("--one_DM", args.one_DM),
+            ("-f princeton", args.format == "princeton"),
+            ("--errfile", args.errfile is not None),
+            ("--nu_ref", args.nu_ref_DM is not None),
+            ("--DM", args.DM0 is not None),
+            ("--no_bary", not args.bary),
+            ("--fit_scat", args.fit_scat),
+            ("--fit_dt4", args.fit_GM),
+            ("--fix_DM", not args.fit_DM),
+            ("--no_logscat", not args.log10_tau),
+            ("--scat_guess", args.scat_guess is not None),
+            ("--fix_alpha", args.fix_alpha),
+            ("--nu_tau", args.nu_ref_tau is not None),
+            ("--print_phase", args.print_phase),
+            ("--print_flux", args.print_flux),
+            ("--print_parangle", args.print_parangle),
+            ("--flags", bool(args.toa_flags)),
+            ("--showplot", args.show_plot)] if on]
+        if ignored:
+            print("--psrchive (external 'pat' cross-check) does not "
+                  "support: " + ", ".join(ignored), file=sys.stderr)
+            return 1
         try:
             gt.get_psrchive_TOAs(tscrunch=args.tscrunch, quiet=args.quiet)
         except RuntimeError as e:
@@ -148,6 +177,10 @@ def main(argv=None):
             return 1
         lines = [ln for arch_lines in gt.psrchive_toas
                  for ln in arch_lines]
+        if not lines:
+            print("no TOAs returned by the psrchive machinery.",
+                  file=sys.stderr)
+            return 1
         if args.outfile:
             with open(args.outfile, "a") as f:
                 f.write("\n".join(lines) + "\n")

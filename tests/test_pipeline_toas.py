@@ -248,18 +248,113 @@ def test_get_toas_checkpoint_resume(tmp_path):
         files.append(fits)
     ckpt = str(tmp_path / "resume.tim")
 
+    def toa_lines(path):
+        return [ln for ln in open(path)
+                if ln.split() and ln.split()[0] not in ("FORMAT", "C", "#")]
+
     # "crashed" first run: only the first archive processed
     gt1 = GetTOAs(files[0], gm, quiet=True)
     gt1.get_TOAs(quiet=True, checkpoint=ckpt)
-    lines1 = [ln for ln in open(ckpt) if ln.strip()]
+    lines1 = toa_lines(ckpt)
     assert len(lines1) == 2 and all(ln.split()[0] == files[0]
                                     for ln in lines1)
+    # each archive block ends with its completeness marker
+    assert any(ln.split()[:2] == ["C", "pp_done"] for ln in open(ckpt))
 
-    # resumed run over all three: archive 0 skipped, 1-2 appended
-    gt2 = GetTOAs(files, gm, quiet=True)
+    # resumed run over all three: archive 0 skipped, 1-2 appended —
+    # via a different path spelling (relative vs absolute must not
+    # trigger a duplicate refit)
+    import os
+    rel_first = os.path.relpath(files[0])
+    gt2 = GetTOAs([rel_first] + files[1:], gm, quiet=True)
     gt2.get_TOAs(quiet=True, checkpoint=ckpt)
     assert gt2.order == files[1:]  # first archive resumed, not refit
-    lines2 = [ln for ln in open(ckpt) if ln.strip()]
+    lines2 = toa_lines(ckpt)
     assert len(lines2) == 6
     assert [ln.split()[0] for ln in lines2] == \
         [files[0]] * 2 + [files[1]] * 2 + [files[2]] * 2
+
+    # crash mid-write: an archive block without its pp_done marker (or
+    # with a wrong count) is dropped and refit, never silently skipped
+    # or duplicated
+    with open(ckpt) as f:
+        content = f.readlines()
+    # truncate: drop the last marker and one TOA line of the last archive
+    truncated = [ln for ln in content
+                 if not (ln.split()[:2] == ["C", "pp_done"]
+                         and ln.split()[2] == files[2])]
+    truncated = truncated[:-1]
+    with open(ckpt, "w") as f:
+        f.writelines(truncated)
+    gt3 = GetTOAs(files, gm, quiet=True)
+    gt3.get_TOAs(quiet=True, checkpoint=ckpt)
+    assert gt3.order == [files[2]]  # only the partial archive refit
+    lines3 = toa_lines(ckpt)
+    assert len(lines3) == 6  # no duplicates, no lost subints
+    assert [ln.split()[0] for ln in lines3] == \
+        [files[0]] * 2 + [files[1]] * 2 + [files[2]] * 2
+
+
+def test_degraded_doppler_flagged(tmp_path):
+    """When the ephemeris lacks coordinates the Doppler factors degrade
+    to unity; a bary=True TOA must carry -pp_topo 1 (VERDICT r02 #6),
+    and a coordinate-bearing archive must not."""
+    import warnings
+
+    from pulseportraiture_tpu.io.archive import make_fake_pulsar
+    from pulseportraiture_tpu.io.gmodel import write_model
+
+    gm = str(tmp_path / "t.gmodel")
+    write_model(gm, "t", "000", 1500.0,
+                np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5]),
+                np.ones(8, int), -4.0, 0, quiet=True)
+    fits_by_coords = {}
+    for tag, coord_lines in (("nocoord", ""),
+                             ("coord", "RAJ 04:37:00\nDECJ -47:15:00\n")):
+        par = str(tmp_path / (tag + ".par"))
+        with open(par, "w") as f:
+            f.write("PSR J0\n" + coord_lines +
+                    "F0 100.0\nPEPOCH 56000.0\nDM 30.0\n")
+        fits = str(tmp_path / (tag + ".fits"))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            make_fake_pulsar(gm, par, fits, nsub=1, nchan=8, nbin=128,
+                             nu0=1500.0, bw=400.0, tsub=60.0,
+                             noise_stds=0.01, dedispersed=False, seed=3,
+                             quiet=True)
+        fits_by_coords[tag] = fits
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        gt = GetTOAs(fits_by_coords["nocoord"], gm, quiet=True)
+        gt.get_TOAs(quiet=True, bary=True)
+    assert gt.TOA_list[0].flags.get("pp_topo") == 1
+
+    gt2 = GetTOAs(fits_by_coords["coord"], gm, quiet=True)
+    gt2.get_TOAs(quiet=True, bary=True)
+    assert "pp_topo" not in gt2.TOA_list[0].flags
+
+    # topocentric runs don't claim anything barycentric: no flag
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        gt3 = GetTOAs(fits_by_coords["nocoord"], gm, quiet=True)
+        gt3.get_TOAs(quiet=True, bary=False)
+    assert "pp_topo" not in gt3.TOA_list[0].flags
+
+
+def test_checkpoint_zero_toa_archive_stays_done(tmp_path):
+    """A 'C pp_done <arch> 0' marker (archive whose TOAs were all
+    culled) must validate on resume — not churn into an eternal refit."""
+    from pulseportraiture_tpu.pipelines.toas import _resume_checkpoint
+
+    ckpt = str(tmp_path / "z.tim")
+    with open(ckpt, "w") as f:
+        f.write("C pp_done empty.fits 0\n")
+        f.write("a.fits 1400.0 56000.5 1.0 1\n")
+        f.write("C pp_done a.fits 1\n")
+    import os
+    done = _resume_checkpoint(ckpt)
+    assert os.path.realpath("empty.fits") in done
+    assert os.path.realpath("a.fits") in done
+    # nothing was 'dirty': the file is untouched
+    assert len(open(ckpt).readlines()) == 3
