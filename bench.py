@@ -126,7 +126,6 @@ def main():
     _enable_compile_cache(jax)
 
     from pulseportraiture_tpu.config import Dconst
-    from pulseportraiture_tpu.fit.phase_shift import fit_phase_shift
     from pulseportraiture_tpu.fit.portrait import (fit_portrait_full_batch,
                                                    model_kmax)
     from pulseportraiture_tpu.ops.fourier import get_bin_centers, rotate_data
@@ -199,37 +198,28 @@ def main():
     model64_dev = jnp.asarray(model64)
     KMAX = model_kmax(model64)
 
-    def fit_all(data, init):
+    def fit_all(data):
         # storage stays f32; the scan body casts each chunk to f64 for
-        # the pair-path fit (cast=), so no full-batch f64 copy exists
+        # the pair-path fit (cast=), and init_params=None runs the
+        # batched FFTFIT seeding in the SAME program: the whole
+        # 1000-subint seed+fit is one device dispatch
         return fit_portrait_full_batch(
-            data, model64_dev, init, Ps, freqs_j, errs=errs,
+            data, model64_dev, None, Ps, freqs_j, errs=errs,
             fit_flags=(1, 1, 0, 0, 0), log10_tau=False,
             max_iter=30, kmax=KMAX, scan_size=scan, cast=fit_dtype)
 
-    def guess_phase(data):
-        prof = data.mean(axis=1)
-        mprof = jnp.broadcast_to(model.mean(axis=0), prof.shape)
-        return fit_phase_shift(prof, mprof,
-                               noise=jnp.full(data.shape[0], noise,
-                                              dtype)).phase
-
-    _stage('compiling guess + fit programs')
-    g0 = jax.block_until_ready(guess_phase(data_all))
-    init0 = jnp.zeros((nsub, 5), jnp.float64).at[:, 0].set(g0)
-    jax.block_until_ready(fit_all(data_all, init0).phi)
+    _stage('compiling seed+fit program')
+    jax.block_until_ready(fit_all(data_all).phi)
     _stage('compiled; timing main config')
 
-    # timed end-to-end on device (seed + scanned fit = 2 dispatches);
+    # timed end-to-end on device (seed + scanned fit = ONE dispatch);
     # best of two passes — the TPU tunnel's dispatch latency varies
     # with ambient host load, and the sustained-throughput number is
     # the less-loaded pass
     durations = []
     for ipass in range(2):
         t0 = time.time()
-        g = guess_phase(data_all)
-        init = jnp.zeros((nsub, 5), jnp.float64).at[:, 0].set(g)
-        out = fit_all(data_all, init)
+        out = fit_all(data_all)
         jax.block_until_ready(out.phi)
         durations.append(time.time() - t0)
         _stage('main config pass %d done in %.1fs'

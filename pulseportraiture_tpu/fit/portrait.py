@@ -908,16 +908,52 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
         nfeval=sol["nfev"], return_code=sol["rc"])
 
 
+def _seed_phases(data_ports, model_ports, errs_b, weights_b, cast):
+    """In-graph FFTFIT phase seeds from live-channel band averages.
+
+    The whole seeding stage lives inside the batched fit program, so a
+    seed+fit run costs ONE device dispatch (on a remote-dispatch tunnel
+    the second round trip is worth ~10% of the north-star config).
+    """
+    from .phase_shift import _fit_phase_shift_core
+
+    # band-average in the STORAGE dtype (seeds don't need f64 inputs;
+    # casting the padded batch first would materialize the full-batch
+    # f64 copy the scan/cast design exists to avoid), and weight the
+    # MODEL average by the same live-channel mask as the data so a
+    # partially-zapped band correlates matching profile shapes
+    d = data_ports
+    wok = (weights_b > 0.0).astype(d.dtype)
+    wsum = jnp.maximum(wok.sum(axis=1), 1.0)
+    prof = (d * wok[..., None]).sum(axis=1) / wsum[:, None]  # [B, nbin]
+    m = model_ports[None] if model_ports.ndim == 2 else model_ports
+    mprof = (m.astype(d.dtype) * wok[..., None]).sum(axis=1) \
+        / wsum[:, None]
+    # band-average noise of the weighted channel mean
+    err = jnp.sqrt(((errs_b.astype(d.dtype) * wok) ** 2).sum(axis=1)) \
+        / wsum
+    if cast is not None:
+        prof, mprof, err = (prof.astype(cast), mprof.astype(cast),
+                            err.astype(cast))
+    out = _fit_phase_shift_core(prof, mprof, err, -0.5, 0.5, 100, 6)
+    return out.phase.astype(jnp.float64)
+
+
 @partial(jax.jit, static_argnames=("fit_flags", "bounds", "log10_tau",
                                    "max_iter", "nu_outs_mask", "scat",
-                                   "pair", "kmax", "scan_size", "cast"))
+                                   "pair", "kmax", "scan_size", "cast",
+                                   "seed"))
 def _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b, errs_b,
                 weights_b, nu_fits_b, nu_outs_b, nu_outs_mask, fit_flags,
                 bounds, log10_tau, max_iter, scat, pair, kmax, scan_size,
-                cast):
+                cast, seed=False):
     # a 2-D model is shared by the whole batch (vmap in_axes=None /
     # scan-body closure) — it is never materialized at [B, nchan, nbin]
     shared_model = model_ports.ndim == 2
+    if seed:  # in-graph FFTFIT seeding: phi from band-average profiles
+        init_b = init_b.at[:, 0].set(
+            _seed_phases(data_ports, model_ports, errs_b, weights_b,
+                         cast))
 
     def one(d, m, x0, p, fq, er, w, nf, no):
         if cast is not None:
@@ -1000,6 +1036,10 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
     ``cast``: cast data/model/errs to this dtype *inside* the program —
     storage dtype (e.g. f32 on device) and fit precision (f64 pair
     path) decouple without ever materializing a full-batch f64 copy.
+
+    ``init_params=None`` seeds the phases in-graph (batched FFTFIT on
+    live-channel band-average profiles; other parameters start at 0),
+    so seed + fit cost a single device dispatch.
     """
     # static harmonic cutoff from the (concrete, pre-broadcast) model
     if kmax is None:
@@ -1015,6 +1055,16 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
     freqs_b = jnp.broadcast_to(freqs, (B, freqs.shape[-1])) \
         if freqs.ndim == 1 else freqs
     Ps_b = jnp.broadcast_to(jnp.asarray(Ps), (B,))
+    flags_t = tuple(int(bool(fl)) for fl in fit_flags)
+    seed = init_params is None
+    if seed:
+        if flags_t[3] or flags_t[4]:
+            raise ValueError(
+                "init_params=None (in-graph seeding) seeds only the "
+                "phase; scattering fits need explicit initial tau/alpha.")
+        init_params = np.zeros(5)
+        if log10_tau:
+            init_params[3] = -np.inf  # 10**-inf == 0: no scattering
     init_b = jnp.broadcast_to(jnp.asarray(init_params, dtype=jnp.float64),
                               (B, 5))
     if errs is None:
@@ -1040,7 +1090,6 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
     else:
         nu_fits_b = jnp.broadcast_to(jnp.asarray(nu_fits, dtype=jnp.float64),
                                      (B, 3))
-    flags_t = tuple(int(bool(fl)) for fl in fit_flags)
     # static scattering hint from the *concrete* batch inits (under vmap
     # the per-fit init is traced and could not prove tau == 0)
     scat = _scat_hint(flags_t, init_params, log10_tau)
@@ -1086,7 +1135,8 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
     out = _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b,
                       errs_b, weights_b, nu_fits_b, nu_outs_b,
                       nu_outs_mask, flags_t, bounds_t, bool(log10_tau),
-                      int(max_iter), scat, pair, kmax, scan_size, cast_t)
+                      int(max_iter), scat, pair, kmax, scan_size, cast_t,
+                      seed=seed)
     if data_ports.shape[0] != B:  # drop scan padding
         out = jax.tree_util.tree_map(lambda a: a[:B], out)
     return out

@@ -432,3 +432,40 @@ def test_scan_size_and_cast_match_plain_batch(rng):
                                            FREQS, scan_size=4, **kw)
     np.testing.assert_allclose(np.asarray(per_model.phi),
                                np.asarray(ref.phi), rtol=0, atol=1e-12)
+
+
+def test_in_graph_seeding_matches_explicit(rng):
+    """init_params=None seeds phases in-graph (one dispatch for
+    seed+fit); results must match seeding with fit_phase_shift
+    externally."""
+    from pulseportraiture_tpu.fit.phase_shift import fit_phase_shift
+
+    B = 6
+    model = make_model()
+    phis = rng.uniform(-0.4, 0.4, B)
+    datas = np.stack([
+        np.asarray(rotate_data(model, -phis[i], 0.0, P0, FREQS,
+                               np.mean(FREQS))) for i in range(B)])
+    datas = datas + rng.normal(0, 0.01, datas.shape)
+    errs = np.full((B, NCHAN), 0.01)
+    kw = dict(errs=errs, fit_flags=(1, 1, 0, 0, 0), log10_tau=False,
+              max_iter=50)
+    g = fit_phase_shift(datas.mean(axis=1), model.mean(axis=0),
+                        noise=np.full(B, 0.01) / np.sqrt(NCHAN)).phase
+    init = np.zeros((B, 5))
+    init[:, 0] = np.asarray(g)
+    ref = fp.fit_portrait_full_batch(datas, model[None], init, P0, FREQS,
+                                     **kw)
+    seeded = fp.fit_portrait_full_batch(datas, model[None], None, P0,
+                                        FREQS, scan_size=4, **kw)
+    np.testing.assert_allclose(np.asarray(seeded.phi),
+                               np.asarray(ref.phi), atol=1e-10)
+    # truth recovery through the wrap-around range
+    d = (np.asarray(seeded.phi) - phis + 0.5) % 1.0 - 0.5
+    # (phi referenced to nu_zero; DM-free data so direct compare is ok)
+    assert np.abs(d).max() < 5e-3
+    # scattering fits must demand explicit inits
+    with pytest.raises(ValueError, match="seed"):
+        fp.fit_portrait_full_batch(datas, model[None], None, P0, FREQS,
+                                   errs=errs, fit_flags=(1, 1, 0, 1, 1),
+                                   log10_tau=True)
