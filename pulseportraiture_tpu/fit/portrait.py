@@ -710,7 +710,8 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
                       nu_outs=(None, None, None), errs=None, weights=None,
                       fit_flags=(1, 1, 1, 1, 1), bounds=None,
                       log10_tau=True, option=0, max_iter=50, is_toa=True,
-                      quiet=True, scat=None, pair=None, kmax=None):
+                      quiet=True, scat=None, pair=None, kmax=None,
+                      polish_iter=None):
     """Fit (phi, DM, GM, tau, alpha) between one data and model portrait.
 
     Behavioral equivalent of /root/reference/pptoaslib.py:928-1096,
@@ -826,12 +827,15 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
                        cross32, abs_m2_32, inv_err2, freqs, P, nu_fit_DM,
                        nu_fit_GM, nu_fit_tau, flags, log10_tau, nbin, lo,
                        hi, max_iter=max_iter, scat=scat)
-        # the polish gets the caller's full budget: it exits on
-        # convergence (typically 2-3 steps), but a bulk stage stalled on
-        # the f32 plateau may need more than a token handful
+        # polish budget: convergence typically takes 2-3 Newton steps
+        # from the f32 plateau, but under vmap the while_loop runs to
+        # the SLOWEST lane — polish_iter caps the expensive f64 stage
+        # (None = the caller's full budget, the conservative default)
         sol = _solve(sol32["x"], cross, abs_m2, inv_err2, freqs, P,
                      nu_fit_DM, nu_fit_GM, nu_fit_tau, flags, log10_tau,
-                     nbin, lo, hi, max_iter=max_iter, scat=scat)
+                     nbin, lo, hi,
+                     max_iter=max_iter if polish_iter is None
+                     else polish_iter, scat=scat)
         sol["nfev"] = sol32["nfev"] + sol["nfev"]
     else:
         sol = _solve(jnp.asarray(init_params, dtype=jnp.float64), cross,
@@ -942,11 +946,11 @@ def _seed_phases(data_ports, model_ports, errs_b, weights_b, cast):
 @partial(jax.jit, static_argnames=("fit_flags", "bounds", "log10_tau",
                                    "max_iter", "nu_outs_mask", "scat",
                                    "pair", "kmax", "scan_size", "cast",
-                                   "seed"))
+                                   "seed", "polish_iter"))
 def _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b, errs_b,
                 weights_b, nu_fits_b, nu_outs_b, nu_outs_mask, fit_flags,
                 bounds, log10_tau, max_iter, scat, pair, kmax, scan_size,
-                cast, seed=False):
+                cast, seed=False, polish_iter=None):
     # a 2-D model is shared by the whole batch (vmap in_axes=None /
     # scan-body closure) — it is never materialized at [B, nchan, nbin]
     shared_model = model_ports.ndim == 2
@@ -973,7 +977,8 @@ def _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b, errs_b,
                                  fit_flags=fit_flags, nu_fits=nu_fits,
                                  nu_outs=nu_outs, bounds=bounds,
                                  log10_tau=log10_tau, max_iter=max_iter,
-                                 scat=scat, pair=pair, kmax=kmax)
+                                 scat=scat, pair=pair, kmax=kmax,
+                                 polish_iter=polish_iter)
 
     vfit = jax.vmap(one, in_axes=(0, None if shared_model else 0,
                                   0, 0, 0, 0, 0, 0, 0))
@@ -1012,7 +1017,8 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
                             nu_fits=(None, None, None),
                             nu_outs=(None, None, None), bounds=None,
                             log10_tau=True, max_iter=50, pair=None,
-                            kmax=None, scan_size=None, cast=None):
+                            kmax=None, scan_size=None, cast=None,
+                            polish_iter=None, seed=None):
     """vmapped+jitted fit over a batch of subints: data [B, nchan, nbin].
 
     model_ports/freqs broadcast over the batch; returns a DataBunch of
@@ -1039,7 +1045,16 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
 
     ``init_params=None`` seeds the phases in-graph (batched FFTFIT on
     live-channel band-average profiles; other parameters start at 0),
-    so seed + fit cost a single device dispatch.
+    so seed + fit cost a single device dispatch.  ``seed=True`` forces
+    in-graph seeding with a caller-provided init carrying the
+    non-phase start (for callers that must assemble the init onto a
+    multi-host mesh themselves); seeding requires scattering-free
+    fit_flags either way.
+
+    ``polish_iter`` caps the f64 polish stage of the hybrid path (the
+    vmapped while_loop runs to the SLOWEST lane; Newton convergence
+    from the f32 plateau typically takes 2-3 steps).  None = the full
+    ``max_iter`` budget.
     """
     # static harmonic cutoff from the (concrete, pre-broadcast) model
     if kmax is None:
@@ -1056,12 +1071,16 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
         if freqs.ndim == 1 else freqs
     Ps_b = jnp.broadcast_to(jnp.asarray(Ps), (B,))
     flags_t = tuple(int(bool(fl)) for fl in fit_flags)
-    seed = init_params is None
-    if seed:
-        if flags_t[3] or flags_t[4]:
-            raise ValueError(
-                "init_params=None (in-graph seeding) seeds only the "
-                "phase; scattering fits need explicit initial tau/alpha.")
+    # seed=None: in-graph seeding iff no init given; seed=True forces
+    # seeding with the caller's init supplying the non-phase start
+    # (distributed callers assemble a globally-sharded init themselves)
+    if seed is None:
+        seed = init_params is None
+    if seed and (flags_t[3] or flags_t[4]):
+        raise ValueError(
+            "in-graph seeding seeds only the phase; scattering fits "
+            "need explicit initial tau/alpha.")
+    if init_params is None:
         init_params = np.zeros(5)
         if log10_tau:
             init_params[3] = -np.inf  # 10**-inf == 0: no scattering
@@ -1136,7 +1155,9 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
                       errs_b, weights_b, nu_fits_b, nu_outs_b,
                       nu_outs_mask, flags_t, bounds_t, bool(log10_tau),
                       int(max_iter), scat, pair, kmax, scan_size, cast_t,
-                      seed=seed)
+                      seed=seed,
+                      polish_iter=None if polish_iter is None
+                      else int(polish_iter))
     if data_ports.shape[0] != B:  # drop scan padding
         out = jax.tree_util.tree_map(lambda a: a[:B], out)
     return out

@@ -1,0 +1,157 @@
+"""Multi-host scale-out: DCN-spanning meshes for survey-scale sweeps.
+
+The reference scales by launching many independent single-host processes
+(SURVEY.md §2.10); the TPU-native design instead spans hosts with a
+single jax.distributed program: ICI carries the within-slice collectives
+of the sharded fits (parallel/sharded_fit.py) and DCN only ever carries
+the embarrassingly-parallel (pulsar, epoch) batch axis — no inner-loop
+communication crosses hosts, matching SURVEY.md §5.8.
+
+Typical use on each host of a pod slice / multi-host job:
+
+    from pulseportraiture_tpu.parallel import multihost
+    multihost.initialize()                   # no-op when single-process
+    mesh = multihost.global_mesh()           # all devices, all hosts
+    out = multihost.distributed_sweep_fit(   # per-host local shard in,
+        mesh, local_data, model, ...)        # globally-sharded fit out
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..fit.portrait import fit_portrait_full_batch
+from .mesh import make_mesh
+
+__all__ = ["initialize", "global_mesh", "distributed_sweep_fit",
+           "process_count", "process_index"]
+
+
+def initialize(coordinator_address=None, num_processes=None,
+               process_id=None, **kw):
+    """jax.distributed.initialize with env/args; no-op single-process.
+
+    On managed TPU pods jax.distributed.initialize() discovers all
+    settings itself; explicit arguments are for manual bring-up
+    (coordinator 'host:port', process count, this process's id).
+    Safe to call more than once and in single-process runs.
+
+    MUST run before any jax call that initializes a backend (the check
+    below deliberately uses distributed-service state, NOT
+    jax.process_count(), which would itself initialize the backend and
+    make cluster bring-up impossible).
+    """
+    if jax.distributed.is_initialized():
+        return
+    if coordinator_address is None and num_processes is None:
+        try:
+            jax.distributed.initialize(**kw)
+        except (ValueError, RuntimeError):
+            pass  # single-process run with no cluster env: stay local
+    else:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id, **kw)
+
+
+def process_count():
+    return jax.process_count()
+
+
+def process_index():
+    return jax.process_index()
+
+
+def global_mesh(n_chan=1, n_bin=1, devices=None):
+    """('subint', 'chan', 'bin') mesh over ALL devices of ALL hosts.
+
+    The 'subint' (batch) axis spans hosts — its sharding needs no
+    communication at all — while 'chan'/'bin' model/sequence shards
+    should stay within a host's ICI domain (keep n_chan * n_bin <= the
+    per-host device count so GSPMD's reductions ride ICI, not DCN).
+    """
+    return make_mesh(n_chan=n_chan, n_bin=n_bin, devices=devices)
+
+
+def distributed_sweep_fit(mesh, local_data, model_port, init_params, Ps,
+                          freqs, errs=None, weights=None,
+                          fit_flags=(1, 1, 0, 0, 0), **kw):
+    """Fit a host-local batch shard as part of one global sharded batch.
+
+    Every process passes its own [B_local, nchan, nbin] block (epochs /
+    pulsars assigned to this host — e.g. a slice of a metafile); the
+    blocks are assembled into one global jax.Array sharded over the
+    mesh's 'subint' axis without any cross-host data movement, and the
+    batched fit runs as a single GSPMD program.  Returns the DataBunch
+    of the GLOBAL batch (addressable per host via
+    ``.phi.addressable_shards``).
+
+    Every process must pass the SAME local block size (pad the last
+    host's block — e.g. with zero-weight rows — when the split is
+    uneven); this is validated with a tiny allgather in multi-process
+    runs.  Single-process this degrades to sharded_fit-style behavior
+    on the local mesh.
+    """
+    local_data = np.asarray(local_data)
+    B_local = local_data.shape[0]
+    nproc = jax.process_count()
+    if nproc > 1:
+        from jax.experimental import multihost_utils
+
+        sizes = np.asarray(multihost_utils.process_allgather(
+            np.asarray([B_local])))
+        if not np.all(sizes == B_local):
+            raise ValueError(
+                "distributed_sweep_fit needs identical per-process "
+                f"block sizes; got {sizes.ravel().tolist()} — pad the "
+                "short blocks with zero-weight rows.")
+    B = B_local * nproc
+    sh3 = NamedSharding(mesh, P("subint", "chan", None))
+    data = jax.make_array_from_process_local_data(
+        sh3, local_data, (B,) + local_data.shape[1:])
+    model_port = jnp.asarray(model_port)
+
+    def rep(x, shape, spec):
+        """Broadcast host-replicated metadata onto the mesh."""
+        arr = np.broadcast_to(np.asarray(x), shape)
+        return jax.make_array_from_callback(
+            shape, NamedSharding(mesh, spec), lambda idx: arr[idx])
+
+    # every array reaching the fit must be assembled onto the global
+    # mesh here: the batch entry's own defaults would build host-local
+    # arrays of GLOBAL shape (undispatchable next to a non-addressable
+    # global data array in a real multi-process run)
+    nchan = local_data.shape[1]
+    Ps_g = rep(Ps, (B,), P("subint"))
+    seed = init_params is None
+    if seed:
+        # in-graph seeding, but with the zero init assembled globally
+        # (the batch entry's host-local default would not dispatch next
+        # to a non-addressable global data array); seed=True below
+        # keeps the seeding stage on
+        init_params = np.zeros(5)
+        if kw.get("log10_tau", True):
+            init_params[3] = -np.inf
+    init_g = rep(np.asarray(init_params, np.float64), (B, 5),
+                 P("subint"))
+    freqs_g = rep(freqs, (B, nchan), P("subint", "chan"))
+    if errs is None:
+        # per-host noise estimate on the addressable block, assembled
+        # globally (get_noise on the global array would touch
+        # non-addressable shards)
+        from ..ops.noise import get_noise
+
+        errs_local = np.asarray(get_noise(local_data))
+        errs_g = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("subint", "chan")), errs_local,
+            (B, nchan))
+    else:
+        errs_g = rep(errs, (B, nchan), P("subint", "chan"))
+    weights_g = rep(np.ones((1, 1)) if weights is None else weights,
+                    (B, nchan), P("subint", "chan"))
+    with mesh:
+        return fit_portrait_full_batch(
+            data, model_port, init_g, Ps_g, freqs_g, errs=errs_g,
+            weights=weights_g, fit_flags=fit_flags, seed=seed, **kw)

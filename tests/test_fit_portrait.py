@@ -469,3 +469,19 @@ def test_in_graph_seeding_matches_explicit(rng):
         fp.fit_portrait_full_batch(datas, model[None], None, P0, FREQS,
                                    errs=errs, fit_flags=(1, 1, 0, 1, 1),
                                    log10_tau=True)
+
+
+def test_polish_iter_cap_parity():
+    """Capping the f64 polish stage (polish_iter) must not move results
+    beyond the parity budget on a converged fit."""
+    phi_inj, dDM_inj = 0.123, 1.2e-3
+    model, data = make_data(phi=phi_inj, dDM=dDM_inj, noise=0.01, seed=9)
+    kw = dict(errs=np.full(NCHAN, 0.01), fit_flags=(1, 1, 0, 0, 0),
+              log10_tau=False, max_iter=50, pair="hybrid")
+    full = fp.fit_portrait_full(data, model, np.zeros(5), P0, FREQS, **kw)
+    capped = fp.fit_portrait_full(data, model, np.zeros(5), P0, FREQS,
+                                  polish_iter=6, **kw)
+    dphi_ns = abs(float(full.phi) - float(capped.phi)) * P0 * 1e9
+    assert dphi_ns < 0.1, dphi_ns  # well inside the 1 ns parity budget
+    np.testing.assert_allclose(float(capped.DM), float(full.DM),
+                               atol=1e-9)
