@@ -80,6 +80,16 @@ RCSTRINGS = {
 solver_dtype = jnp.float64
 data_dtype = jnp.float64  # parity-first default; benches may drop to float32
 
+# Chunked-scan engagement for batched fits (fit_portrait_full_batch
+# scan_size): batches above *_scan_threshold run as a lax.scan over
+# *_scan_size chunks inside one program, keeping the compile footprint
+# bounded (the remote compile helper fails on the monolithic 200-subint
+# 512x2048 program) while the whole batch stays one device dispatch.
+subint_scan_threshold = 128
+subint_scan_size = 100
+profile_scan_threshold = 2048  # narrowband: single-channel profile rows
+profile_scan_size = 1024
+
 
 def default_float(x):
     """Cast a python/numpy scalar or array to the solver dtype."""
@@ -183,4 +193,8 @@ __all__ = [
     "fft_real_dtype",
     "as_fft_operand",
     "host_stats_device",
+    "subint_scan_threshold",
+    "subint_scan_size",
+    "profile_scan_threshold",
+    "profile_scan_size",
 ]

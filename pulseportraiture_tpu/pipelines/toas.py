@@ -15,7 +15,10 @@ import time
 
 import numpy as np
 
-from ..config import host_array, scattering_alpha
+from ..config import (host_array, profile_scan_size,
+                      profile_scan_threshold,
+                      scattering_alpha, subint_scan_size,
+                      subint_scan_threshold)
 from ..fit.phase_shift import fit_phase_shift
 from ..fit.portrait import fit_portrait_full_batch
 from ..fit.transforms import guess_fit_freq, phase_transform
@@ -412,6 +415,11 @@ class GetTOAs:
             results = [None] * B
             for fl, idxs in flags_groups.items():
                 sel = np.asarray(idxs)
+                # long observations (hundreds of subints) run as a
+                # chunked scan: the compile footprint stays that of a
+                # 100-subint program (bigger monolithic batches can
+                # exhaust the compiler) while the whole archive stays
+                # one device dispatch
                 out = fit_portrait_full_batch(
                     ports[sel], models_b[sel], init[sel], Ps_b[sel],
                     freqs_b[sel], errs=errs_b[sel],
@@ -421,7 +429,10 @@ class GetTOAs:
                         None if col is None else col[sel]
                         for col in nu_outs_b),
                     bounds=bounds_eff, log10_tau=log10_tau,
-                    max_iter=max_iter)
+                    max_iter=max_iter,
+                    scan_size=subint_scan_size
+                    if len(sel) > subint_scan_threshold
+                    else None)
                 for j, i in enumerate(idxs):
                     results[i] = {key: np.asarray(val)[j]
                                   for key, val in out.items()}
@@ -797,7 +808,10 @@ class GetTOAs:
                     fit_flags=(1, 0, 0, 1, 0),
                     nu_fits=np.stack([nusx] * 3, axis=1),
                     bounds=bounds_eff, log10_tau=log10_tau,
-                    max_iter=max_iter)
+                    max_iter=max_iter,
+                    scan_size=profile_scan_size
+                    if len(profs) > profile_scan_threshold
+                    else None)
                 phis_fit = np.asarray(out["phi"])
                 phi_errs_fit = np.asarray(out["phi_err"])
                 taus_fit = np.asarray(out["tau"])
