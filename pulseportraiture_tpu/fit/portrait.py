@@ -57,7 +57,28 @@ from .smallsolve import inv_refined, solve_refined
 
 __all__ = ["fit_portrait_full", "fit_portrait_full_batch", "fit_portrait",
            "get_scales_full", "get_scales", "portrait_objective",
-           "portrait_grad_hess", "get_nu_zeros"]
+           "portrait_grad_hess", "get_nu_zeros", "auto_scan_size"]
+
+
+def auto_scan_size(batch_size, profiles=False):
+    """Chunked-scan engagement policy for large batches.
+
+    Returns the ``scan_size`` to pass to fit_portrait_full_batch: a
+    config-sized chunk when ``batch_size`` exceeds the engagement
+    threshold (monolithic big-batch programs can exhaust the compiler —
+    the remote compile helper here fails at ~200 subints x 512x2048),
+    else None.  ``profiles=True`` selects the narrowband thresholds
+    (single-channel profile rows are far cheaper per element).  Not
+    applied inside fit_portrait_full_batch itself because scan is not
+    transparent for every caller: a GSPMD-sharded batch axis must not
+    be reshaped into scan chunks (parallel/sharded_fit.py).
+    """
+    from ..config import (profile_scan_size, profile_scan_threshold,
+                          subint_scan_size, subint_scan_threshold)
+
+    threshold = profile_scan_threshold if profiles         else subint_scan_threshold
+    size = profile_scan_size if profiles else subint_scan_size
+    return size if batch_size > threshold else None
 
 
 def _phase_shift_derivs(freqs, nu_DM, nu_GM, P):

@@ -192,6 +192,26 @@ def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
     ephemeris, epochs and PSRFITS unload.  ``seed`` replaces global
     numpy randomness with an explicit PRNG.
     """
+    # fixture generation is host-side territory: the per-subint model
+    # builds and noise draws are tiny device ops that would each pay a
+    # full dispatch round trip through a remote-device tunnel (~150 ms
+    # here), dominating archive synthesis ~10x over the math
+    with host_stats_device():
+        return _make_fake_pulsar_impl(
+            modelfile=modelfile, ephemeris=ephemeris, outfile=outfile,
+            nsub=nsub, npol=npol, nchan=nchan, nbin=nbin, nu0=nu0, bw=bw,
+            tsub=tsub, phase=phase, dDM=dDM, start_MJD=start_MJD,
+            weights=weights, noise_stds=noise_stds, scales=scales,
+            dedispersed=dedispersed, t_scat=t_scat, alpha=alpha,
+            scint=scint, xs=xs, Cs=Cs, nu_DM=nu_DM, state=state,
+            telescope=telescope, seed=seed, quiet=quiet)
+
+
+def _make_fake_pulsar_impl(*, modelfile, ephemeris, outfile, nsub, npol,
+                           nchan, nbin, nu0, bw, tsub, phase, dDM,
+                           start_MJD, weights, noise_stds, scales,
+                           dedispersed, t_scat, alpha, scint, xs, Cs,
+                           nu_DM, state, telescope, seed, quiet):
     import jax
 
     from ..config import Dconst, host_array

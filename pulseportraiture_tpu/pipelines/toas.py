@@ -15,12 +15,9 @@ import time
 
 import numpy as np
 
-from ..config import (host_array, profile_scan_size,
-                      profile_scan_threshold,
-                      scattering_alpha, subint_scan_size,
-                      subint_scan_threshold)
+from ..config import host_array, scattering_alpha
 from ..fit.phase_shift import fit_phase_shift
-from ..fit.portrait import fit_portrait_full_batch
+from ..fit.portrait import auto_scan_size, fit_portrait_full_batch
 from ..fit.transforms import guess_fit_freq, phase_transform
 from ..io.archive import file_is_type, load_data, parse_metafile
 from ..io.gmodel import read_model
@@ -430,9 +427,7 @@ class GetTOAs:
                         for col in nu_outs_b),
                     bounds=bounds_eff, log10_tau=log10_tau,
                     max_iter=max_iter,
-                    scan_size=subint_scan_size
-                    if len(sel) > subint_scan_threshold
-                    else None)
+                    scan_size=auto_scan_size(len(sel)))
                 for j, i in enumerate(idxs):
                     results[i] = {key: np.asarray(val)[j]
                                   for key, val in out.items()}
@@ -809,9 +804,8 @@ class GetTOAs:
                     nu_fits=np.stack([nusx] * 3, axis=1),
                     bounds=bounds_eff, log10_tau=log10_tau,
                     max_iter=max_iter,
-                    scan_size=profile_scan_size
-                    if len(profs) > profile_scan_threshold
-                    else None)
+                    scan_size=auto_scan_size(len(profs),
+                                             profiles=True))
                 phis_fit = np.asarray(out["phi"])
                 phi_errs_fit = np.asarray(out["phi_err"])
                 taus_fit = np.asarray(out["tau"])
