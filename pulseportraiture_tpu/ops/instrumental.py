@@ -40,6 +40,12 @@ def instrumental_response_port_FT(nbin, freqs, DM=0.0, P=1.0, wids=(),
     the per-channel DM-smearing rectangle of width
     8.3e-6 * chan_bw * (nu/GHz)**-3 / P [rot] when DM != 0 (Bhat et al.
     2003).  Equivalent of /root/reference/pptoaslib.py:145-179.
+
+    Parity note: the reference's smearing width omits the factor of DM
+    from the Bhat et al. formula (8.3 us * DM * chbw_MHz * nu_GHz**-3) —
+    DM acts only as an on/off gate there.  We reproduce that behavior
+    bit-for-bit; callers wanting the physical width can fold DM into
+    ``wids`` explicitly.
     """
     freqs = jnp.asarray(freqs)
     nchan = freqs.shape[0]
