@@ -89,7 +89,8 @@ def main(argv=None):
         fd, tmp_file = tempfile.mkstemp(prefix="ppalign.", suffix=".fits")
         os.close(fd)
         average_archives(args.metafile, outfile=tmp_file,
-                         palign=args.palign, quiet=args.quiet)
+                         palign=args.palign, pscrunch=args.pscrunch,
+                         quiet=args.quiet)
         initial_guess = tmp_file
     elif args.fwhm:
         from ..io.psrfits import read_archive

@@ -66,7 +66,7 @@ class Archive:
                  backend="unknown", backend_delay=0.0, nu0=None, bw=None,
                  ephemeris_text="", doppler_factors=None,
                  parallactic_angles=None, filename="", polyco=None,
-                 doppler_degraded=False):
+                 doppler_degraded=False, basis="LIN"):
         self.data = np.asarray(data, dtype=np.float64)
         self.nsub, self.npol, self.nchan, self.nbin = self.data.shape
         self.freqs = np.asarray(freqs, dtype=np.float64)
@@ -78,6 +78,7 @@ class Archive:
         self.durations = np.asarray(durations, dtype=np.float64)
         self.DM = float(DM)
         self.state = state
+        self.basis = str(basis).strip().upper() or "LIN"
         self.dedispersed = bool(dedispersed)
         self.source = source
         self.telescope = telescope
@@ -135,12 +136,21 @@ class Archive:
                        doppler_factors=self.doppler_factors.copy(),
                        parallactic_angles=self.parallactic_angles.copy(),
                        filename=self.filename, polyco=self.polyco,
-                       doppler_degraded=self.doppler_degraded)
+                       doppler_degraded=self.doppler_degraded,
+                       basis=self.basis)
 
     # -- state ----------------------------------------------------------
     def convert_state(self, state):
-        """Convert polarization state; converting to 'Intensity' forms
-        total intensity (I or AA+BB), like PSRCHIVE's convert_state."""
+        """Convert polarization state like PSRCHIVE's convert_state
+        (the reference reaches it through load_data's ``state`` kwarg,
+        /root/reference/pplib.py:2678-2684).
+
+        Supported: any -> 'Intensity' (total intensity, I or AA+BB),
+        and the 4-pol linear maps Coherence <-> Stokes in the
+        receptor basis ``self.basis`` (FD_POLN): for 'LIN' feeds
+        I=AA+BB, Q=AA-BB, U=2CR, V=2CI; for 'CIRC' feeds the roles of
+        Q/U and V rotate (I=AA+BB, V=AA-BB, Q=2CR, U=2CI).
+        """
         if state == self.state:
             return
         if state == "Intensity":
@@ -151,10 +161,35 @@ class Archive:
             self.data = I
             self.npol = 1
             self.state = "Intensity"
-        else:
-            raise NotImplementedError(
-                f"State conversion {self.state} -> {state} not supported; "
-                f"only -> 'Intensity'.")
+            return
+        if self.state == "Coherence" and state == "Stokes" \
+                and self.npol == 4:
+            AA, BB = self.data[:, 0], self.data[:, 1]
+            CR, CI = self.data[:, 2], self.data[:, 3]
+            I, D = AA + BB, AA - BB
+            if self.basis.startswith("CIRC"):
+                self.data = np.stack([I, 2.0 * CR, 2.0 * CI, D], axis=1)
+            else:  # LIN (default when the basis is unrecorded)
+                self.data = np.stack([I, D, 2.0 * CR, 2.0 * CI], axis=1)
+            self.state = "Stokes"
+            return
+        if self.state == "Stokes" and state == "Coherence" \
+                and self.npol == 4:
+            I, Q = self.data[:, 0], self.data[:, 1]
+            U, V = self.data[:, 2], self.data[:, 3]
+            if self.basis.startswith("CIRC"):
+                AA, BB, CR, CI = (I + V) / 2.0, (I - V) / 2.0, \
+                    Q / 2.0, U / 2.0
+            else:
+                AA, BB, CR, CI = (I + Q) / 2.0, (I - Q) / 2.0, \
+                    U / 2.0, V / 2.0
+            self.data = np.stack([AA, BB, CR, CI], axis=1)
+            self.state = "Coherence"
+            return
+        raise NotImplementedError(
+            f"State conversion {self.state} (npol={self.npol}) -> "
+            f"{state} not supported; supported: -> 'Intensity', and "
+            f"4-pol Coherence <-> Stokes.")
 
     def pscrunch(self):
         self.convert_state("Intensity")
@@ -253,6 +288,8 @@ def write_archive_file(arch, filename, nbits=16, quiet=True,
     h.set("FRONTEND", arch.frontend)
     h.set("BACKEND", arch.backend)
     h.set("BE_DELAY", arch.backend_delay, "Backend propn delay [s]")
+    h.set("FD_POLN", getattr(arch, "basis", "LIN"),
+          "LIN or CIRC (receptor basis)")
     h.set("OBSFREQ", arch.nu0, "[MHz] Centre frequency")
     h.set("OBSBW", arch.bw, "[MHz] Bandwidth")
     h.set("OBSNCHAN", nchan, "Number of frequency channels")
@@ -475,7 +512,8 @@ def read_archive(filename):
         nu0=float(primary.get("OBSFREQ", freqs.mean())),
         bw=float(primary.get("OBSBW", 0.0)) or None,
         ephemeris_text=ephemeris_text, doppler_factors=dop,
-        parallactic_angles=par, filename=filename, polyco=polyco)
+        parallactic_angles=par, filename=filename, polyco=polyco,
+        basis=str(primary.get("FD_POLN", "LIN")).strip() or "LIN")
 
 
 def _period_from_ephemeris(text):

@@ -9,6 +9,8 @@ wrappers around PSRCHIVE's psradd/psrsmooth are replaced with native
 equivalents (average_archives; ops.wavelet smoothing for psrsmooth -W).
 """
 
+import sys
+
 import numpy as np
 
 import jax
@@ -81,28 +83,38 @@ def psrsmooth_archive(archive, options="-W", outfile=None, quiet=True):
 
 
 def average_archives(datafiles, outfile, palign=False, tscrunch=True,
-                     quiet=True):
+                     pscrunch=True, quiet=True):
     """Native psradd equivalent: load archives, optionally phase-align on
     their band-average profiles (psradd -P analog), and average them into
     one archive written to ``outfile``.
 
+    ``pscrunch=False`` keeps all four polarizations (ppalign -p's
+    psradd call), averaging in the Stokes basis; the alignment shift is
+    still measured on total intensity and applied to every pol.
     Replaces the subprocess wrapper /root/reference/ppalign.py:21-38.
     """
     if isinstance(datafiles, str):
         datafiles = parse_metafile(datafiles)
+    state = "Intensity" if pscrunch else "Stokes"
     total = None
     template_arch = None
     nused = 0
     ref_prof = None
     for f in datafiles:
         try:
-            d = load_data(f, dedisperse=True, tscrunch=True, pscrunch=True,
-                          rm_baseline=True, quiet=True)
+            d = load_data(f, state=state, dedisperse=True, tscrunch=True,
+                          pscrunch=pscrunch, rm_baseline=True, quiet=True)
+        except NotImplementedError as e:
+            # e.g. -p on an already-pscrunched archive: skipped, like
+            # the reference's ppalign ("converted or skipped")
+            print(f"Skipping {f}: cannot convert to {state} ({e})",
+                  file=sys.stderr)
+            continue
         except (OSError, ValueError, RuntimeError):
             continue
-        port = (d.masks * d.subints)[0, 0]
+        port = (d.masks * d.subints)[0]            # [npol, nchan, nbin]
         if palign:
-            prof = port.mean(axis=0)
+            prof = port[0].mean(axis=0)            # Stokes I / intensity
             if ref_prof is None:
                 ref_prof = prof
             else:
@@ -120,8 +132,11 @@ def average_archives(datafiles, outfile, palign=False, tscrunch=True,
     avg = total / nused
     arch = template_arch.copy()
     arch.tscrunch()
-    arch.pscrunch()
-    arch.data = avg[None, None]
+    if pscrunch:
+        arch.pscrunch()
+    # pscrunch=False: arch came through load_data(state="Stokes"), so
+    # it is already Stokes (inconvertible files were skipped above)
+    arch.data = avg[None]
     arch.unload(outfile, quiet=quiet)
     return outfile
 
@@ -336,6 +351,11 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
                               tscrunch=tscrunch, pscrunch=pscrunch,
                               rm_baseline=True, refresh_arch=False,
                               return_arch=False, quiet=True)
+            except NotImplementedError as e:
+                print(f"Skipping {datafile}: cannot convert to {state} "
+                      f"({e})", file=sys.stderr)
+                skip_these.add(datafile)
+                continue
             except (OSError, ValueError, RuntimeError):
                 skip_these.add(datafile)
                 continue
