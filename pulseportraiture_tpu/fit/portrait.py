@@ -76,7 +76,8 @@ def auto_scan_size(batch_size, profiles=False):
     from ..config import (profile_scan_size, profile_scan_threshold,
                           subint_scan_size, subint_scan_threshold)
 
-    threshold = profile_scan_threshold if profiles         else subint_scan_threshold
+    threshold = profile_scan_threshold if profiles \
+        else subint_scan_threshold
     size = profile_scan_size if profiles else subint_scan_size
     return size if batch_size > threshold else None
 

@@ -481,9 +481,17 @@ def read_archive(filename):
     elif polyco is not None:
         Ps = polyco.periods([ep.mjd() for ep in epochs])
     elif t2pred is not None:
-        nu_pred = float(primary.get("OBSFREQ",
-                                    np.asarray(freqs).mean()))
-        Ps = t2pred.periods([ep.mjd() for ep in epochs], nu_pred)
+        # evaluate the predictor per subint at that subint's weighted
+        # center frequency (the reference's get_folding_period asks
+        # each Integration for its own frequency; DAT_FREQ can drift)
+        wsum = weights.sum(axis=1)
+        has_w = wsum > 0.0
+        nu_sub = np.where(
+            has_w,
+            (freqs * weights).sum(axis=1) / np.where(has_w, wsum, 1.0),
+            freqs.mean(axis=1))
+        Ps = np.array([t2pred.period(ep.mjd(), float(nu_sub[i]))
+                       for i, ep in enumerate(epochs)])
     else:
         print(f"Warning: {filename} has no PERIOD column and no "
               "POLYCO/T2PREDICT HDU; folding all subints at the "

@@ -114,8 +114,17 @@ def distributed_sweep_fit(mesh, local_data, model_port, init_params, Ps,
     model_port = jnp.asarray(model_port)
 
     def rep(x, shape, spec):
-        """Broadcast host-replicated metadata onto the mesh."""
-        arr = np.broadcast_to(np.asarray(x), shape)
+        """Assemble metadata onto the mesh: a host-local block (leading
+        dim B_local, the normal case for per-subint periods/freqs from
+        drifting predictors) is assembled like the data; anything else
+        (globally-shaped or broadcastable, e.g. a scalar period) is
+        treated as host-replicated and broadcast."""
+        arr = np.asarray(x)
+        if nproc > 1 and arr.ndim == len(shape) and \
+                arr.shape[0] == B_local and arr.shape[1:] == shape[1:]:
+            return jax.make_array_from_process_local_data(
+                NamedSharding(mesh, spec), arr, shape)
+        arr = np.broadcast_to(arr, shape)
         return jax.make_array_from_callback(
             shape, NamedSharding(mesh, spec), lambda idx: arr[idx])
 

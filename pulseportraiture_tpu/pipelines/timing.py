@@ -58,6 +58,13 @@ def parse_tim(timfile):
     return toas
 
 
+def _dispersion_term(nu):
+    """Dispersion delay per unit DM [s]; a TOA frequency of 0.0 encodes
+    infinite frequency (no delay), as written by format_toa_line."""
+    return np.where(nu > 0.0,
+                    Dconst / np.where(nu > 0.0, nu, 1.0) ** 2.0, 0.0)
+
+
 def phase_residuals(toas, par):
     """Pulse-phase residuals [rot] of TOAs against a (F0, F1, DM) par.
 
@@ -76,8 +83,7 @@ def phase_residuals(toas, par):
     pe_day = int(PEPOCH)
     pe_sec = (PEPOCH - pe_day) * 86400.0
     nu = np.array([t["freq"] for t in toas])
-    delay = np.where(nu > 0.0, Dconst * DM
-                     / np.where(nu > 0.0, nu, 1.0) ** 2.0, 0.0)
+    delay = DM * _dispersion_term(nu)
     dt = np.array([(t["mjd"].day - pe_day) * 86400.0
                    + (t["mjd"].secs - pe_sec) for t in toas]) - delay
     phase = F0 * dt + 0.5 * F1 * dt * dt
@@ -105,7 +111,7 @@ def wideband_gls_fit(toas, par, fit_dm=None):
     # design matrix in phase units
     cols = [np.ones_like(dt), dt]
     if fit_dm:
-        cols.append(Dconst * nu ** -2.0 / P)
+        cols.append(_dispersion_term(nu) / P)
     M = np.stack(cols, axis=1)
     y = resid.copy()
     w = err_rot ** -2.0

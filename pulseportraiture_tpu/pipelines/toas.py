@@ -43,36 +43,47 @@ def _resume_checkpoint(checkpoint, quiet=True):
     partially-recorded archive would be silently skipped with its
     remaining subint TOAs lost, or refit with its lines duplicated.
 
+    Checkpoints written before the marker format existed (no pp_done
+    lines at all) are honored for backward compatibility: every block
+    but the trailing one — the only one a crash can have truncated —
+    is accepted, and the file is rewritten with markers added so the
+    next resume sees the current format.
+
     Returns a set of os.path.realpath-normalized archive names, so a
     resumed run matches archives regardless of path spelling (relative
     vs absolute vs './'-prefixed).
     """
+    with open(checkpoint) as cf:
+        lines = cf.readlines()
+    has_markers = any(len(t) >= 4 and t[0] == "C" and t[1] == "pp_done"
+                      for t in (ln.split() for ln in lines))
+    if not has_markers:
+        return _resume_markerless_checkpoint(checkpoint, lines, quiet)
     done, kept = set(), []
     buf_arch, buf = None, []
     dirty = False
-    with open(checkpoint) as cf:
-        for ln in cf:
-            tok = ln.split()
-            if len(tok) >= 4 and tok[0] == "C" and tok[1] == "pp_done":
-                arch, n = tok[2], tok[3]
-                # buf_arch is None for a zero-TOA archive (all its TOAs
-                # culled): a 0-count marker is then valid, not partial
-                if (arch == buf_arch or buf_arch is None) and \
-                        n.isdigit() and len(buf) == int(n):
-                    kept.extend(buf)
-                    kept.append(ln)
-                    done.add(os.path.realpath(arch))
-                else:  # marker without its (complete) block: drop both
-                    dirty = True
-                buf_arch, buf = None, []
-            elif not tok or tok[0] in ("FORMAT", "C", "#"):
+    for ln in lines:
+        tok = ln.split()
+        if len(tok) >= 4 and tok[0] == "C" and tok[1] == "pp_done":
+            arch, n = tok[2], tok[3]
+            # buf_arch is None for a zero-TOA archive (all its TOAs
+            # culled): a 0-count marker is then valid, not partial
+            if (arch == buf_arch or buf_arch is None) and \
+                    n.isdigit() and len(buf) == int(n):
+                kept.extend(buf)
                 kept.append(ln)
-            else:  # a TOA line; first token is the archive name
-                if buf_arch is not None and tok[0] != buf_arch:
-                    dirty = True  # interleaved block: treat as partial
-                    buf = []
-                buf_arch = tok[0]
-                buf.append(ln)
+                done.add(os.path.realpath(arch))
+            else:  # marker without its (complete) block: drop both
+                dirty = True
+            buf_arch, buf = None, []
+        elif not tok or tok[0] in ("FORMAT", "C", "#"):
+            kept.append(ln)
+        else:  # a TOA line; first token is the archive name
+            if buf_arch is not None and tok[0] != buf_arch:
+                dirty = True  # interleaved block: treat as partial
+                buf = []
+            buf_arch = tok[0]
+            buf.append(ln)
     if buf:  # trailing block with no marker: crash mid-archive
         dirty = True
     if dirty:
@@ -83,6 +94,44 @@ def _resume_checkpoint(checkpoint, quiet=True):
         if not quiet:
             print(f"checkpoint {checkpoint}: dropped partial archive "
                   "blocks; they will be refit.")
+    return done
+
+
+def _resume_markerless_checkpoint(checkpoint, lines, quiet=True):
+    """Legacy (pre-marker) checkpoint: accept every archive block except
+    the trailing one, which a crash may have truncated; rewrite the file
+    with pp_done markers so subsequent resumes use the current format."""
+    done, kept = set(), []
+    buf_arch, buf = None, []
+
+    def flush():
+        if buf:
+            kept.extend(buf)
+            kept.append(f"C pp_done {buf_arch} {len(buf)}\n")
+            done.add(os.path.realpath(buf_arch))
+
+    for ln in lines:
+        tok = ln.split()
+        if not tok or tok[0] in ("FORMAT", "C", "#"):
+            kept.append(ln)
+        else:
+            if buf_arch is not None and tok[0] != buf_arch:
+                flush()
+                buf = []
+            buf_arch = tok[0]
+            buf.append(ln)
+    # the trailing block is dropped (not flushed): with no marker there
+    # is no way to tell a complete block from a mid-write crash
+    dropped = len(buf)
+    tmp = checkpoint + ".tmp"
+    with open(tmp, "w") as tf:
+        tf.writelines(kept)
+    os.replace(tmp, checkpoint)
+    if not quiet:
+        print(f"checkpoint {checkpoint}: no pp_done markers (legacy "
+              f"file, or a crash before the first marker); accepted "
+              f"{len(done)} archives, refitting the trailing block "
+              f"({dropped} TOA lines).")
     return done
 
 

@@ -395,3 +395,32 @@ def test_long_observation_scanned_fit(tmp_path):
     r = ((phi0 - 0.11 + 0.5) % 1.0) - 0.5
     assert np.abs(np.median(r)) < 5e-3, np.median(r)
     assert np.abs(r).max() < 0.05
+
+
+def test_checkpoint_legacy_markerless_accepts_all_but_trailing(tmp_path):
+    """A pre-marker-format checkpoint keeps every completed archive
+    block (upgraded in place with pp_done markers) and refits only the
+    trailing block, which a crash may have truncated."""
+    import os
+
+    from pulseportraiture_tpu.pipelines.toas import _resume_checkpoint
+
+    ckpt = str(tmp_path / "legacy.tim")
+    with open(ckpt, "w") as f:
+        f.write("FORMAT 1\n")
+        f.write("a.fits 1400.0 56000.5 1.0 1\n")
+        f.write("a.fits 1500.0 56000.5 1.0 1\n")
+        f.write("b.fits 1400.0 56001.5 1.0 1\n")
+        f.write("c.fits 1400.0 56002.5 1.0 1\n")  # trailing: maybe cut
+    done = _resume_checkpoint(ckpt)
+    assert os.path.realpath("a.fits") in done
+    assert os.path.realpath("b.fits") in done
+    assert os.path.realpath("c.fits") not in done
+    lines = open(ckpt).readlines()
+    # upgraded in place: markers added, trailing block dropped
+    assert "C pp_done a.fits 2\n" in lines
+    assert "C pp_done b.fits 1\n" in lines
+    assert not any(ln.startswith("c.fits") for ln in lines)
+    # and the upgraded file round-trips through the marker-format parser
+    done2 = _resume_checkpoint(ckpt)
+    assert done2 == done
