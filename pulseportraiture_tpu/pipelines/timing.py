@@ -8,13 +8,20 @@ timing fit does not depend on an external tempo install.  When a real
 ``tempo`` + ``tempo_utils`` environment is available the example script
 can still hand the same files to it; the file formats are identical.
 
-The model fit here is the minimal wideband set: a constant phase offset,
-a spin-frequency correction dF0, and a DM correction dDM.  TOA phase
-residuals and DM measurements are combined in one weighted least-squares
-system, the wideband-GLS structure introduced by Pennucci+ (2014):
+The model fit is the wideband set [offset, dF0, dF1, DM]: TOA phase
+residuals and DM measurements are combined in one weighted
+least-squares system, the wideband-GLS structure introduced by
+Pennucci+ (2014):
 
-  r_phase_i = off + dF0 * dt_i + (Dconst / nu_i^2 / P) * dDM + noise
-  DM_i      = DM0 + dDM + noise_DM
+  r_phase_i = off + dF0 * dt_i + dF1 * dt_i^2 / 2
+              + (Dconst / nu_i^2 / P) * dDM_e(i) + noise
+  DM_i      = DM0 + dDM_e(i) + noise_DM
+
+where dDM_e is either one global correction or — with ``dmx=True`` or
+DMX in the par, tempo's DMDATA+DMX configuration — an independent
+correction per DMX epoch (TOAs grouped into fixed-length windows like
+tempo's DMX ranges).  Par-file DMX_xxxx values themselves are assumed
+zero in the prefit residuals; the fit estimates them from scratch.
 """
 
 import numpy as np
@@ -91,46 +98,123 @@ def phase_residuals(toas, par):
     return resid, dt, 1.0 / F0
 
 
-def wideband_gls_fit(toas, par, fit_dm=None):
-    """Weighted LSQ of [phase offset, dF0, dDM] on wideband TOAs.
+def dmx_epochs(mjds, window_days=6.5):
+    """Group TOA MJDs into DMX-style fixed-length ranges.
+
+    Like tempo's DMX binning: sorted TOAs open a new range when they
+    fall outside ``window_days`` of the current range's first TOA.
+    Returns (epoch_index per TOA [int], list of (r1, r2) range bounds).
+    """
+    order = np.argsort(mjds)
+    idx = np.empty(len(mjds), dtype=int)
+    ranges = []
+    start = None
+    for i in order:
+        if start is None or mjds[i] - start > window_days:
+            start = mjds[i]
+            ranges.append([mjds[i], mjds[i]])
+        idx[i] = len(ranges) - 1
+        ranges[-1][1] = mjds[i]
+    return idx, [tuple(r) for r in ranges]
+
+
+def wideband_gls_fit(toas, par, fit_dm=None, fit_f1=None, dmx=None,
+                     dmx_window_days=None):
+    """Weighted GLS of [phase offset, dF0, dF1, DM/DMX] on wideband TOAs.
 
     ``fit_dm`` defaults to True when the par has ``DMDATA 1`` (the
-    notebook's convention): the per-TOA -pp_dm/-pp_dme measurements then
-    enter the system as data alongside the TOA residuals.  Returns a
-    dict with params, errors, prefit/postfit weighted rms [us], chi2,
-    and dof.
+    notebook's convention): the per-TOA -pp_dm/-pp_dme measurements
+    then enter the system as data alongside the TOA residuals.
+    ``fit_f1`` defaults to the par's F1 fit flag (``F1 <val> 1``).
+    ``dmx`` defaults to True when the par carries DMX (a range length
+    or DMX_xxxx entries); per-epoch dDM corrections then replace the
+    single global dDM, with TOAs binned into ``dmx_window_days``-long
+    ranges (default: the par's DMX value, else 6.5 d, tempo's default).
+    Returns a dict with params, errors, per-epoch ``dmx`` results,
+    prefit/postfit weighted rms [us], chi2, and dof.
     """
     p = par if not isinstance(par, str) else read_par(par)
     if fit_dm is None:
         fit_dm = int(float(p.get("DMDATA", 0))) == 1
+    if fit_f1 is None:
+        fit_f1 = p.get("fit_flags", {}).get("F1", 0) == 1
+    has_dmx = "DMX" in p or any(k.startswith("DMX_") for k in p)
+    if dmx is None:
+        # auto-DMX requires the wideband DM rows: per-epoch DM columns
+        # constrained by phase residuals alone are rank-deficient for
+        # single-frequency epochs (tempo pairs DMX with DMDATA here too)
+        dmx = has_dmx and fit_dm
+    if dmx_window_days is None:
+        dmx_val = p.get("DMX", 6.5)
+        dmx_window_days = float(dmx_val) \
+            if isinstance(dmx_val, (int, float)) and dmx_val > 0 else 6.5
     DM0 = float(p.get("DM", 0.0))
     resid, dt, P = phase_residuals(toas, p)
     nu = np.array([t["freq"] for t in toas])
     err_rot = np.array([t["err_us"] for t in toas]) * 1e-6 / P
+    disp = _dispersion_term(nu) / P  # phase per unit DM
 
-    # design matrix in phase units
+    # spin columns, in phase units
     cols = [np.ones_like(dt), dt]
-    if fit_dm:
-        cols.append(_dispersion_term(nu) / P)
+    names = ["offset_rot", "dF0_hz"]
+    if fit_f1:
+        cols.append(0.5 * dt * dt)
+        names.append("dF1_hz_s")
+    nspin = len(cols)
+
+    # DM columns: one global dDM, or one per DMX epoch
+    if dmx:
+        mjds = np.array([t["mjd"].day + t["mjd"].secs / 86400.0
+                         for t in toas])
+        eidx, ranges = dmx_epochs(mjds, dmx_window_days)
+        nep = len(ranges)
+        dm_cols = np.zeros((len(toas), nep))
+        dm_cols[np.arange(len(toas)), eidx] = disp
+        cols.extend(list(dm_cols.T))
+        names.extend(f"DMX_{e + 1:04d}" for e in range(nep))
+    else:
+        eidx, ranges, nep = None, [], 0
+        if fit_dm:
+            cols.append(disp)
+            names.append("dDM")
     M = np.stack(cols, axis=1)
     y = resid.copy()
     w = err_rot ** -2.0
 
     if fit_dm:
+        # wideband DM measurements as data rows: DM_i - DM0 = dDM_e(i)
         dms = np.array([t["flags"].get("pp_dm", np.nan) for t in toas])
         dmes = np.array([t["flags"].get("pp_dme", np.nan) for t in toas])
         okd = np.isfinite(dms) & np.isfinite(dmes) & (dmes > 0)
-        # DM rows: DM_i - DM0 = dDM
-        Md = np.zeros((okd.sum(), M.shape[1]))
-        Md[:, 2] = 1.0
+        Md = np.zeros((int(okd.sum()), M.shape[1]))
+        if dmx:
+            Md[np.arange(Md.shape[0]), nspin + eidx[okd]] = 1.0
+        else:
+            Md[:, nspin] = 1.0
         M = np.vstack([M, Md])
         y = np.concatenate([y, dms[okd] - DM0])
         w = np.concatenate([w, dmes[okd] ** -2.0])
 
-    # weighted normal equations with errors from the covariance
-    A = M * w[:, None]
-    cov = np.linalg.inv(M.T @ A)
-    x = cov @ (A.T @ y)
+    # weighted LSQ via column-scaled QR: the spin columns span ~16
+    # decades (1, dt, dt^2/2 at dt~1e8 s), where forming the normal
+    # equations squares an already-large condition number
+    sw = np.sqrt(w)
+    Aw = M * sw[:, None]
+    scale = np.linalg.norm(Aw, axis=0)
+    scale[scale == 0.0] = 1.0
+    Q, R = np.linalg.qr(Aw / scale)
+    rdiag = np.abs(np.diag(R))
+    if R.shape[0] != R.shape[1] or rdiag.min() < 1e-12 * rdiag.max():
+        raise ValueError(
+            "singular wideband design matrix (%d rows x %d params): "
+            "with dmx=True each epoch needs constraining data — DM "
+            "measurement rows (DMDATA 1 + -pp_dm flags) or "
+            "multi-frequency TOAs per epoch." % (M.shape[0], M.shape[1]))
+    xs = np.linalg.solve(R, Q.T @ (y * sw))
+    Rinv = np.linalg.solve(R, np.eye(R.shape[0]))
+    cov = (Rinv @ Rinv.T) / np.outer(scale, scale)
+    x = xs / scale
+    errs = np.sqrt(np.diag(cov))
     post = y - M @ x
     ntoa = len(toas)
     wrms_us = np.sqrt(np.sum(w[:ntoa] * post[:ntoa] ** 2)
@@ -139,13 +223,20 @@ def wideband_gls_fit(toas, par, fit_dm=None):
                         / np.sum(w[:ntoa])) * P * 1e6
     chi2 = float(np.sum(w * post ** 2))
     dof = len(y) - M.shape[1]
-    names = ["offset_rot", "dF0_hz"] + (["dDM"] if fit_dm else [])
+    dmx_out = [dict(name=names[nspin + e], r1=ranges[e][0],
+                    r2=ranges[e][1],
+                    mjd_mid=0.5 * (ranges[e][0] + ranges[e][1]),
+                    dDM=float(x[nspin + e]),
+                    err=float(errs[nspin + e]),
+                    ntoa=int(np.sum(eidx == e)))
+               for e in range(nep)]
     return dict(params=dict(zip(names, x)),
-                errors=dict(zip(names, np.sqrt(np.diag(cov)))),
+                errors=dict(zip(names, errs)),
+                dmx=dmx_out,
                 prefit_wrms_us=float(prefit_us),
                 postfit_wrms_us=float(wrms_us),
                 chi2=chi2, red_chi2=chi2 / max(dof, 1), dof=dof,
-                ntoa=ntoa, fit_dm=bool(fit_dm))
+                ntoa=ntoa, fit_dm=bool(fit_dm), fit_f1=bool(fit_f1))
 
 
 def run_tempo_if_available(parfile, timfile, quiet=True):

@@ -93,3 +93,90 @@ def test_gls_without_dmdata(tim_and_par, tmp_path):
     fit = wideband_gls_fit(parse_tim(timf), parf2)
     assert not fit["fit_dm"]
     assert "dDM" not in fit["params"]
+
+
+@pytest.fixture
+def dmx_tim_and_par(tmp_path, rng):
+    """TOAs over 5 epochs 20 d apart with injected F0/F1 drift and
+    per-epoch DM wander."""
+    off_inj, dF0_inj, dF1_inj = 0.015, 2e-10, 3e-18
+    dmx_inj = [5e-4, -3e-4, 8e-4, 0.0, -6e-4]
+    err_us, dm_err = 1.0, 1.5e-4
+    toas = []
+    for ep in range(5):
+        for i in range(8):
+            dt_target = ep * 20 * 86400.0 + i * 3600.0
+            n = round(dt_target * F0)
+            nu = 1300.0 + i * 50.0
+            resid = off_inj + dF0_inj * (n * P) \
+                + 0.5 * dF1_inj * (n * P) ** 2 \
+                + Dconst * dmx_inj[ep] * nu ** -2.0 / P \
+                + rng.normal(0, err_us * 1e-6 / P)
+            dt = (n + resid) * P + Dconst * DM0 * nu ** -2.0
+            day = int(PEPOCH) + int(dt // 86400.0)
+            toas.append(TOA("e%d.fits" % ep, nu,
+                            MJD(day, dt - (day - int(PEPOCH)) * 86400.0),
+                            err_us, "GBT", "1",
+                            DM=DM0 + dmx_inj[ep] + rng.normal(0, dm_err),
+                            DM_error=dm_err, flags={"snr": 100.0}))
+    timf = str(tmp_path / "dmx.tim")
+    write_TOAs(toas, outfile=timf, append=False)
+    parf = str(tmp_path / "dmx.par")
+    with open(parf, "w") as f:
+        f.write("PSR J0\nF0 %.1f 1\nF1 0.0 1\nPEPOCH %.1f\nDM %.1f\n"
+                "DMDATA 1\nDMX 6.5\n" % (F0, PEPOCH, DM0))
+    return timf, parf, (off_inj, dF0_inj, dF1_inj, dmx_inj)
+
+
+def test_wideband_gls_dmx_recovers_per_epoch_dm(dmx_tim_and_par):
+    timf, parf, (off_inj, dF0_inj, dF1_inj, dmx_inj) = dmx_tim_and_par
+    toas = parse_tim(timf)
+    fit = wideband_gls_fit(toas, parf)
+    assert fit["fit_dm"] and fit["fit_f1"]  # par flags turn both on
+    p, e = fit["params"], fit["errors"]
+    assert abs(p["offset_rot"] - off_inj) < 5 * e["offset_rot"] + 1e-4
+    assert abs(p["dF0_hz"] - dF0_inj) < 5 * e["dF0_hz"]
+    assert abs(p["dF1_hz_s"] - dF1_inj) < 5 * e["dF1_hz_s"]
+    assert len(fit["dmx"]) == 5  # one 6.5-d range per 20-d-spaced epoch
+    for ep, d in enumerate(fit["dmx"]):
+        assert d["ntoa"] == 8
+        assert abs(d["dDM"] - dmx_inj[ep]) < 5 * d["err"] + 2e-5, \
+            (ep, d, dmx_inj[ep])
+    assert fit["postfit_wrms_us"] < fit["prefit_wrms_us"] / 3.0
+    assert 0.2 < fit["red_chi2"] < 3.0
+
+
+def test_dmx_epochs_binning():
+    from pulseportraiture_tpu.pipelines.timing import dmx_epochs
+    mjds = np.array([100.0, 100.5, 103.0, 110.0, 110.1, 130.0])
+    idx, ranges = dmx_epochs(mjds, window_days=6.5)
+    assert idx.tolist() == [0, 0, 0, 1, 1, 2]
+    assert ranges[0] == (100.0, 103.0)
+    assert ranges[1] == (110.0, 110.1)
+    # unsorted input maps consistently
+    idx2, _ = dmx_epochs(mjds[::-1], window_days=6.5)
+    assert idx2.tolist() == idx.tolist()[::-1]
+
+
+def test_gls_f1_off_by_default(tim_and_par):
+    timf, parf, _ = tim_and_par
+    fit = wideband_gls_fit(parse_tim(timf), parf)
+    assert not fit["fit_f1"] and "dF1_hz_s" not in fit["params"]
+    assert fit["dmx"] == []
+
+
+def test_dmx_without_dmdata_stays_off_or_errors(dmx_tim_and_par, tmp_path):
+    """DMX in the par without DMDATA must not auto-build a rank-
+    deficient system: auto keeps dmx off; forcing it errors clearly."""
+    timf, parf, _ = dmx_tim_and_par
+    parf2 = str(tmp_path / "dmx_nodata.par")
+    with open(parf2, "w") as f:
+        f.write("PSR J0\nF0 %.1f\nPEPOCH %.1f\nDM %.1f\nDMX 6.5\n"
+                % (F0, PEPOCH, DM0))
+    toas = parse_tim(timf)
+    fit = wideband_gls_fit(toas, parf2)  # auto: dmx off without DM rows
+    assert not fit["fit_dm"] and fit["dmx"] == []
+    # single-frequency epochs forced into DMX -> informative error
+    mono = [t for t in toas if t["freq"] == toas[0]["freq"]]
+    with pytest.raises(ValueError, match="singular wideband design"):
+        wideband_gls_fit(mono, parf2, dmx=True)
