@@ -143,6 +143,25 @@ def distributed_sweep_fit(mesh, local_data, model_port, init_params, Ps,
         init_params = np.zeros(5)
         if kw.get("log10_tau", True):
             init_params[3] = -np.inf
+    # the scattering fast-path hint must come from the host-local
+    # concrete inits: the assembled global array below is not fully
+    # addressable, so the batch entry could no longer inspect it.  The
+    # hint is a STATIC jit argument, so all processes of the global
+    # computation must agree — allgather-OR it (one host with a
+    # nonzero tau turns the scattering chain on everywhere)
+    from ..fit.portrait import _scat_hint
+
+    if "scat_hint" not in kw:
+        hint = _scat_hint(tuple(fit_flags),
+                          np.asarray(init_params, np.float64),
+                          kw.get("log10_tau", True))
+        if nproc > 1:
+            from jax.experimental import multihost_utils
+
+            hints = np.asarray(multihost_utils.process_allgather(
+                np.asarray([bool(hint)])))
+            hint = bool(hints.any())
+        kw["scat_hint"] = hint
     init_g = rep(np.asarray(init_params, np.float64), (B, 5),
                  P("subint"))
     freqs_g = rep(freqs, (B, nchan), P("subint", "chan"))

@@ -1,0 +1,71 @@
+"""Worker process for the 2-process jax.distributed multihost test.
+
+Usage: python _multihost_worker.py <pid> <nproc> <port> <outdir>
+Each process owns 4 virtual CPU devices (8 global), builds the global
+mesh through multihost.initialize/global_mesh, fits its host-local
+half of a deterministic dataset, and saves its addressable result
+shards for the parent test to reassemble.
+"""
+
+import os
+import sys
+
+pid, nproc, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
+                            sys.argv[3], sys.argv[4])
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+from pulseportraiture_tpu.parallel import multihost  # noqa: E402
+
+multihost.initialize(coordinator_address=f"localhost:{port}",
+                     num_processes=nproc, process_id=pid)
+assert jax.process_count() == nproc, jax.process_count()
+assert len(jax.local_devices()) == 4
+assert len(jax.devices()) == 4 * nproc
+
+from pulseportraiture_tpu.ops.fourier import get_bin_centers  # noqa: E402
+from pulseportraiture_tpu.ops.profiles import gen_gaussian_portrait  # noqa: E402
+from pulseportraiture_tpu.pipelines.synth import make_fake_dataset  # noqa: E402
+
+B, nchan, nbin = 8, 16, 64
+B_local = B // nproc
+mp = np.array([0.0, 0.0, 0.35, -0.05, 0.05, 0.1, 1.0, -1.2])
+ds = make_fake_dataset(jax.random.key(7), mp, nsub=B, nchan=nchan,
+                       nbin=nbin, noise_std=0.01)
+model = gen_gaussian_portrait(ds.model_code, mp, -4.0,
+                              get_bin_centers(nbin), ds.freqs, ds.nu_ref)
+data = np.asarray(ds.subints)
+Ps = np.full(B, 0.005) * (1.0 + 1e-6 * np.arange(B))  # drifting periods
+freqs = np.broadcast_to(np.asarray(ds.freqs), (B, nchan))
+
+mesh = multihost.global_mesh()
+sl = slice(pid * B_local, (pid + 1) * B_local)
+res = multihost.distributed_sweep_fit(
+    mesh, data[sl], model, None, Ps[sl], freqs[sl])
+
+def gather(arr):
+    """(global row index, value) pairs of this process's shards."""
+    out = {}
+    for s in arr.addressable_shards:
+        i0 = s.index[0].start or 0
+        for k, v in enumerate(np.asarray(jax.device_get(s.data)).ravel()):
+            out[i0 + k] = float(v)
+    return out
+
+
+phis, dms = gather(res.phi), gather(res.DM)
+idx = sorted(phis)
+np.savez(os.path.join(outdir, f"proc{pid}.npz"),
+         idx=np.array(idx),
+         phi=np.array([phis[i] for i in idx]),
+         dm=np.array([dms[i] for i in idx]),
+         inj=np.asarray(ds.phases_inj))
+print(f"worker {pid}: ok, {len(idx)} addressable rows", flush=True)

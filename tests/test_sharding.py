@@ -53,6 +53,7 @@ def test_make_mesh_shapes():
         make_mesh(n_subint=3, n_chan=2)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("n_subint,n_chan", [(8, 1), (4, 2)])
 def test_sharded_fit_matches_unsharded(problem, n_subint, n_chan):
     data, model, init, P0, freqs, errs, phis, dDMs = problem
@@ -87,6 +88,7 @@ def test_shard_batch_placement(problem):
         data.ndim)
 
 
+@pytest.mark.slow
 def test_ipta_sweep_fit(problem):
     data, model, init, P0, freqs, errs, phis, dDMs = problem
     # reshape into a (pulsar=2, epoch=4) sweep
@@ -100,11 +102,13 @@ def test_ipta_sweep_fit(problem):
     assert np.max(np.abs(np.asarray(out.phi) - phis)) < 5e-3
 
 
+@pytest.mark.slow
 def test_graft_dryrun_multichip():
     import __graft_entry__ as g
     g.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("n_subint,n_chan,n_bin", [(2, 2, 2), (1, 1, 8)])
 def test_bin_sharded_fit_matches_unsharded(problem, n_subint, n_chan,
                                            n_bin):
@@ -127,6 +131,7 @@ def test_bin_sharded_fit_matches_unsharded(problem, n_subint, n_chan,
     assert np.max(np.abs(np.asarray(out.phi) - phis)) < 5e-3
 
 
+@pytest.mark.slow
 def test_multihost_single_process_path(problem):
     """multihost helpers in a single-process run: initialize() is a
     no-op, the global mesh spans the 8 virtual devices, and
@@ -154,3 +159,73 @@ def test_multihost_single_process_path(problem):
         mesh, data, model[None], None, P0, freqs, errs=errs,
         fit_flags=(1, 1, 0, 0, 0), log10_tau=False)
     assert np.max(np.abs(np.asarray(seeded.phi) - phis)) < 5e-3
+
+
+@pytest.mark.slow
+def test_two_process_distributed_sweep(tmp_path):
+    """Real 2-process jax.distributed bring-up on CPU: each process owns
+    4 of 8 virtual devices, builds the global mesh, fits its host-local
+    half through distributed_sweep_fit (with per-host [B_local] drifting
+    periods), and the reassembled global result matches a single-process
+    fit of the same dataset."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_multihost_worker.py")
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = ""  # workers set their own 4-device flag
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(pid), "2", str(port),
+         str(tmp_path)], env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for pid in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-3000:]
+
+    # reassemble the global result from the two hosts' shards
+    import numpy as np
+    rows = {}
+    for pid in range(2):
+        z = np.load(str(tmp_path / f"proc{pid}.npz"))
+        for i, ph, dm in zip(z["idx"], z["phi"], z["dm"]):
+            rows[int(i)] = (ph, dm)
+        inj = z["inj"]
+    assert sorted(rows) == list(range(8)), sorted(rows)
+    phi2 = np.array([rows[i][0] for i in range(8)])
+    dm2 = np.array([rows[i][1] for i in range(8)])
+
+    # single-process reference on the identical dataset
+    from pulseportraiture_tpu.ops.fourier import get_bin_centers
+    from pulseportraiture_tpu.parallel import multihost
+    from pulseportraiture_tpu.pipelines.synth import make_fake_dataset
+    mp = np.array([0.0, 0.0, 0.35, -0.05, 0.05, 0.1, 1.0, -1.2])
+    ds = make_fake_dataset(jax.random.key(7), mp, nsub=8, nchan=16,
+                           nbin=64, noise_std=0.01)
+    model = gen_gaussian_portrait(ds.model_code, mp, -4.0,
+                                  get_bin_centers(64), ds.freqs,
+                                  ds.nu_ref)
+    Ps = np.full(8, 0.005) * (1.0 + 1e-6 * np.arange(8))
+    ref = multihost.distributed_sweep_fit(
+        multihost.global_mesh(), np.asarray(ds.subints), model, None,
+        Ps, np.broadcast_to(np.asarray(ds.freqs), (8, 16)))
+    np.testing.assert_allclose(phi2, np.asarray(ref.phi), atol=1e-7)
+    np.testing.assert_allclose(dm2, np.asarray(ref.DM), atol=1e-6)
+    # and both recover the injected phases
+    np.testing.assert_allclose(np.asarray(inj), phi2, atol=5e-3)
