@@ -248,6 +248,7 @@ def show_residual_plot(port, model, resids=None, phases=None, freqs=None,
             + list(np.linspace(3.0, 10.0, 8))
             + list(np.linspace(20.0, 100.0, 9))
             + list(np.linspace(200.0, 1000.0, 9)) + [np.inf])
+    fig.pp_rchi2 = rchi2  # numerical payload, for tests/inspection
     ax4.hist(rchi2, bins=bins, histtype="step", color="k")
     if len(rchi2) and rchi2.min() > 0 and \
             np.log10(rchi2.max() / rchi2.min()) > 2:

@@ -1,0 +1,110 @@
+"""Content assertions for the core figures: not just "a png exists" but
+the rendered arrays, orientation/extent, and the chi2 histogram payload
+(ref behavior: pplib.py:3511-3616 show_portrait, :3708-3829
+show_residual_plot)."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu import viz
+
+
+@pytest.fixture(autouse=True)
+def _close_figs():
+    yield
+    plt.close("all")
+
+
+def image_axes(fig):
+    return [ax for ax in fig.axes if ax.images]
+
+
+def make_port(nchan=8, nbin=32):
+    rng = np.random.default_rng(3)
+    port = np.zeros((nchan, nbin))
+    port[:, 10] = np.linspace(1.0, 2.0, nchan)  # marker column
+    return port + rng.normal(0, 0.01, port.shape)
+
+
+def test_show_portrait_renders_the_array_unrotated():
+    port = make_port()
+    phases = np.linspace(0, 1, 32, endpoint=False)
+    freqs = np.linspace(1100.0, 1900.0, 8)
+    fig = viz.show_portrait(port, phases=phases, freqs=freqs, show=False)
+    (ax,) = image_axes(fig)
+    shown = np.asarray(ax.images[0].get_array())
+    np.testing.assert_array_equal(shown, port)  # no transpose/flip
+    assert ax.images[0].origin == "lower"
+    ext = tuple(ax.images[0].get_extent())
+    assert ext == (phases[0], phases[-1], freqs[0], freqs[-1])
+    assert ax.get_xlabel() == "Phase [rot]"
+    # the frequency label lives on the shared-y flux side panel
+    assert any(a.get_ylabel() == "Frequency [MHz]" for a in fig.axes)
+
+
+def test_show_portrait_rvrsd_flips_band():
+    port = make_port()
+    freqs = np.linspace(1100.0, 1900.0, 8)
+    fig = viz.show_portrait(port, freqs=freqs, rvrsd=True, show=False,
+                            prof=False, fluxprof=False)
+    (ax,) = image_axes(fig)
+    shown = np.asarray(ax.images[0].get_array())
+    np.testing.assert_array_equal(shown, port[::-1])
+    ext = tuple(ax.images[0].get_extent())
+    assert ext[2] == freqs[-1] and ext[3] == freqs[0]
+
+
+def test_show_residual_plot_panels_and_chi2_payload():
+    from pulseportraiture_tpu.ops.stats import get_red_chi2
+
+    rng = np.random.default_rng(11)
+    nchan, nbin = 8, 32
+    model = np.zeros((nchan, nbin))
+    model[:, 12] = 1.0
+    noise = np.full(nchan, 0.02)
+    port = model + rng.normal(0, 0.02, model.shape)
+    port[3] *= 1.5  # one misfit channel
+    fig = viz.show_residual_plot(port, model, freqs=np.arange(nchan),
+                                 noise_stds=noise, show=False)
+    data_ax, model_ax, resid_ax = image_axes(fig)[:3]
+    np.testing.assert_array_equal(
+        np.asarray(data_ax.images[0].get_array()), port)
+    np.testing.assert_array_equal(
+        np.asarray(model_ax.images[0].get_array()), model)
+    np.testing.assert_allclose(
+        np.asarray(resid_ax.images[0].get_array()), port - model,
+        atol=1e-14)
+    # panel titles identify the triptych
+    assert [a.get_title() for a in (data_ax, model_ax, resid_ax)] == \
+        ["Data", "Model", "Residuals"]
+    # all three panels share one color scale (the reference's behavior)
+    clims = {a.images[0].get_clim() for a in (data_ax, model_ax,
+                                              resid_ax)}
+    assert len(clims) == 1
+    # chi2 payload matches an independent recomputation
+    want = np.array([
+        float(np.asarray(get_red_chi2(port[i], model[i], errs=noise[i],
+                                      dof=nbin)))
+        for i in range(nchan)])
+    np.testing.assert_allclose(fig.pp_rchi2, want, rtol=1e-12)
+    assert np.argmax(fig.pp_rchi2) == 3  # the misfit channel stands out
+    assert fig.pp_rchi2[3] > 5 * np.median(fig.pp_rchi2)
+    # and the rendered histogram contains every channel
+    hist_ax = [ax for ax in fig.axes if ax.get_xlabel().startswith(
+        "Red.")][0]
+    assert f"total = {nchan}" in hist_ax.get_ylabel()
+
+
+def test_show_residual_plot_zapped_channels_excluded():
+    model = np.zeros((6, 16))
+    model[:, 4] = 1.0
+    port = model + 0.01
+    port[2] = 0.0  # zapped channel: zero weight
+    fig = viz.show_residual_plot(port, model, show=False,
+                                 noise_stds=np.full(6, 0.01))
+    assert len(fig.pp_rchi2) == 5
