@@ -490,7 +490,7 @@ def read_archive(filename):
             has_w,
             (freqs * weights).sum(axis=1) / np.where(has_w, wsum, 1.0),
             freqs.mean(axis=1))
-        Ps = np.array([t2pred.period(ep.mjd(), float(nu_sub[i]))
+        Ps = np.array([float(t2pred.period(ep.mjd(), float(nu_sub[i])))
                        for i, ep in enumerate(epochs)])
     else:
         print(f"Warning: {filename} has no PERIOD column and no "

@@ -14,7 +14,14 @@ archives use and this repo's own writer does not:
   (ref /root/reference/pplib.py:2650-2820 consumes these via PSRCHIVE).
 
 Run from the repo root:  python tests/data/make_golden.py
-Writes psrchive_style.fits + psrchive_style_expected.npz next to itself.
+Writes, next to itself:
+* psrchive_style.fits + _expected.npz  (descending band, AABBCRCI,
+  POLYCO-carried folding periods)
+* t2pred_style.fits + _expected.npz    (T2PREDICT Chebyshev predictor
+  carrying frequency-dependent folding periods, drifting per-subint
+  DAT_FREQ, a zapped channel so the weighted center frequency matters)
+* stokes_style.fits + _expected.npz    (4-pol POL_TYPE=IQUV archive
+  with FD_POLN=LIN and a PERIOD column)
 """
 
 import os
@@ -205,5 +212,252 @@ def main():
           % os.path.getsize(os.path.join(HERE, "psrchive_style.fits")))
 
 
+def int16_encode(data_phys):
+    """Signed int16 with psrchive-style f32 DAT_SCL/DAT_OFFS; returns
+    (q, scl, offs, exact f32-rounded decode)."""
+    dmax = data_phys.max(axis=-1)
+    dmin = data_phys.min(axis=-1)
+    scl = (dmax - dmin) / 60000.0
+    offs = (dmax + dmin) / 2.0
+    q = np.rint((data_phys - offs[..., None]) / scl[..., None])
+    q = np.clip(q, -32767, 32767).astype(np.int16)
+    scl32 = scl.astype(np.float32).astype(np.float64)
+    offs32 = offs.astype(np.float32).astype(np.float64)
+    return q, scl, offs, q * scl32[..., None] + offs32[..., None]
+
+
+def make_t2pred():
+    """T2PREDICT fixture: folding periods from a 2-D Chebyshev phase
+    predictor evaluated per subint at its weighted center frequency."""
+    nsub, npol, nchan, nbin = 3, 1, 4, 32
+    F0, F1, PEPOCH = 321.5678901, -7.3e-13, 56100.0
+    DM = 21.25
+    stt_imjd, stt_smjd, stt_offs = 56100, 21600, 0.25
+    tsub = 900.0
+    fc, k = 1400.0, 2.0e-9  # apparent spin rate drifts k Hz/MHz
+    t0, t1 = PEPOCH - 0.5, PEPOCH + 0.5  # predictor time range [MJD]
+    f0, f1 = 1200.0, 1600.0              # predictor freq range [MHz]
+    # per-subint DAT_FREQ drifts; channel 1 zapped in every subint
+    base = np.array([1350.0, 1400.0, 1450.0, 1500.0])
+    freqs = np.stack([base - 10.0 * i for i in range(nsub)])
+    weights = np.ones((nsub, nchan))
+    weights[:, 1] = 0.0
+
+    # phase(t, f) = F0*dt + F1/2 dt^2 + k*(f - fc)*dt  (dt secs from
+    # PEPOCH) -> exact low-degree 2-D Chebyshev representation
+    halfspan_s = (t1 - t0) / 2.0 * 86400.0
+    A = (t0 + (t1 - t0) / 2.0 - PEPOCH) * 86400.0  # dt at x=0
+    B = halfspan_s                                  # d(dt)/dx
+    C = (f0 + f1) / 2.0 - fc                        # (f-fc) at y=0
+    D = (f1 - f0) / 2.0                             # d(f)/dy
+    # P[i, j] multiplies x^i y^j
+    P = np.zeros((3, 2))
+    P[0, 0] = F0 * A + 0.5 * F1 * A * A + k * C * A
+    P[1, 0] = F0 * B + F1 * A * B + k * C * B
+    P[2, 0] = 0.5 * F1 * B * B
+    P[0, 1] = k * D * A
+    P[1, 1] = k * D * B
+    cheb = np.polynomial.chebyshev
+
+    def p2c(v):  # poly2cheb, padded back (it trims trailing zeros)
+        out = cheb.poly2cheb(v)
+        return np.pad(out, (0, len(v) - len(out)))
+
+    c = P.copy()
+    for j in range(c.shape[1]):  # monomial -> Chebyshev along x
+        c[:, j] = p2c(c[:, j])
+    for i in range(c.shape[0]):  # ... and along y
+        c[i, :] = p2c(c[i, :])
+    # tempo2 files store coefficients whose evaluation HALVES the first
+    # row/column: write the inverse so eval reproduces phase(t, f)
+    c_file = c.copy()
+    c_file[0, :] *= 2.0
+    c_file[:, 0] *= 2.0
+
+    lines = ["ChebyModelSet 1 segments",
+             "ChebyModel BEGIN",
+             "PSRNAME J2100+1234",
+             "SITENAME GBT",
+             "TIME_RANGE %.10f %.10f" % (t0, t1),
+             "FREQ_RANGE %.4f %.4f" % (f0, f1),
+             "DISPERSION_CONSTANT 0.0",
+             "NCOEFF_TIME 3",
+             "NCOEFF_FREQ 2"]
+    lines += ["COEFFS %.18e %.18e" % tuple(row) for row in c_file]
+    lines += ["ChebyModel END"]
+    w = max(len(ln) for ln in lines)
+    t2pred = bintable("T2PREDICT", [
+        ("PREDICT", "%dA" % w, None,
+         [ln.ljust(w).encode("ascii") for ln in lines]),
+    ])
+
+    rng = np.random.default_rng(777)
+    phases = (np.arange(nbin) + 0.5) / nbin
+    pulse = np.exp(-0.5 * ((phases - 0.6) / 0.05) ** 2)
+    data_phys = 0.3 + pulse[None, None, None] * \
+        (1.0 + 0.1 * np.arange(nchan))[None, None, :, None] \
+        + rng.normal(0, 0.01, (nsub, npol, nchan, nbin))
+    q, scl, offs, data_quant = int16_encode(data_phys)
+
+    primary = header_block([
+        card("SIMPLE", True), card("BITPIX", 8), card("NAXIS", 0),
+        card("EXTEND", True), card("HDRVER", "6.1"),
+        card("FITSTYPE", "PSRFITS"), card("OBS_MODE", "PSR"),
+        card("TELESCOP", "GBT"), card("FRONTEND", "Rcvr1_2"),
+        card("BACKEND", "GUPPI"), card("OBSFREQ", 1425.0),
+        card("OBSBW", 200.0), card("OBSNCHAN", nchan),
+        card("SRC_NAME", "J2100+1234"),
+        card("STT_IMJD", stt_imjd), card("STT_SMJD", stt_smjd),
+        card("STT_OFFS", stt_offs),
+    ])
+    ephem = ["PSRJ            J2100+1234",
+             "F0              %.7f" % F0,
+             "F1              %.3e" % F1,
+             "PEPOCH          %.1f" % PEPOCH,
+             "DM              %.2f" % DM]
+    we = max(len(ln) for ln in ephem)
+    psrparam = bintable("PSRPARAM", [
+        ("PARAM", "%dA" % we, None,
+         [ln.ljust(we).encode("ascii") for ln in ephem]),
+    ])
+    be = np.dtype(">f8")
+    offs_sub = np.array([tsub / 2 + i * tsub for i in range(nsub)])
+    rows = []
+    for isub in range(nsub):
+        rows.append((
+            np.array(tsub, be).tobytes(),
+            np.array(offs_sub[isub], be).tobytes(),
+            freqs[isub].astype(be).tobytes(),
+            weights[isub].astype(">f4").tobytes(),
+            offs[isub].reshape(-1).astype(">f4").tobytes(),
+            scl[isub].reshape(-1).astype(">f4").tobytes(),
+            q[isub].reshape(-1).astype(">i2").tobytes(),
+        ))
+    subint = bintable("SUBINT", [
+        ("TSUBINT", "1D", None, [r[0] for r in rows]),
+        ("OFFS_SUB", "1D", None, [r[1] for r in rows]),
+        ("DAT_FREQ", "%dD" % nchan, None, [r[2] for r in rows]),
+        ("DAT_WTS", "%dE" % nchan, None, [r[3] for r in rows]),
+        ("DAT_OFFS", "%dE" % (npol * nchan), None, [r[4] for r in rows]),
+        ("DAT_SCL", "%dE" % (npol * nchan), None, [r[5] for r in rows]),
+        ("DATA", "%dI" % (npol * nchan * nbin),
+         "(%d,%d,%d)" % (nbin, nchan, npol), [r[6] for r in rows]),
+    ], extra_cards=[
+        card("INT_TYPE", "TIME"), card("INT_UNIT", "SEC"),
+        card("SCALE", "FluxDen"), card("POL_TYPE", "AA+BB"),
+        card("NPOL", npol), card("TBIN", (1.0 / F0) / nbin),
+        card("NBIN", nbin), card("NCHAN", nchan),
+        card("CHAN_BW", 50.0), card("DM", DM),
+        card("NBITS", 1), card("NSBLK", 1),
+        card("EPOCHS", "MIDTIME"),
+    ])
+    with open(os.path.join(HERE, "t2pred_style.fits"), "wb") as f:
+        f.write(primary + psrparam + t2pred + subint)
+
+    # expected per-subint periods: 1 / (dphase/dt) at each subint's
+    # epoch and weighted center frequency (channel 1 zapped),
+    # independently from the analytic spin model
+    mjds = stt_imjd + (stt_smjd + stt_offs + offs_sub) / 86400.0
+    nu_sub = (freqs * weights).sum(axis=1) / weights.sum(axis=1)
+    dt_s = (mjds - PEPOCH) * 86400.0
+    spin = F0 + F1 * dt_s + k * (nu_sub - fc)
+    np.savez(os.path.join(HERE, "t2pred_style_expected.npz"),
+             data=data_quant, freqs=freqs, weights=weights,
+             offs_sub=offs_sub, mjds=mjds, nu_sub=nu_sub,
+             periods=1.0 / spin, F0=F0, F1=F1, PEPOCH=PEPOCH, k=k,
+             fc=fc, DM=DM,
+             stt=np.array([stt_imjd, stt_smjd, stt_offs]))
+    print("wrote t2pred_style.fits (%d bytes)"
+          % os.path.getsize(os.path.join(HERE, "t2pred_style.fits")))
+
+
+def make_stokes():
+    """4-pol Stokes (POL_TYPE=IQUV, FD_POLN=LIN) fixture with a PERIOD
+    column; coherence-basis equivalents stored for conversion checks."""
+    nsub, npol, nchan, nbin = 2, 4, 4, 32
+    F0 = 186.4947211
+    DM = 9.75
+    stt_imjd, stt_smjd, stt_offs = 56200, 3600, 0.5
+    tsub = 300.0
+    freqs = np.array([1150.0, 1250.0, 1350.0, 1450.0])  # ascending
+    periods = 1.0 / F0 * (1.0 + np.array([2.0e-9, 5.0e-9]))
+
+    rng = np.random.default_rng(4242)
+    phases = (np.arange(nbin) + 0.5) / nbin
+    pulse = np.exp(-0.5 * ((phases - 0.4) / 0.06) ** 2)
+    sub_amp = (1.0 + 0.05 * np.arange(nsub))[:, None, None]
+    I = 0.8 + sub_amp * pulse[None, None, :] * \
+        (1.0 + 0.15 * np.arange(nchan))[None, :, None]
+    L = 0.45 * (I - 0.8)          # linear polarization fraction
+    psi = np.pi / 6               # constant position angle
+    Q = L * np.cos(2 * psi)
+    U = L * np.sin(2 * psi)
+    V = 0.2 * (I - 0.8)
+    data_phys = np.stack([I, Q, U, V], axis=1)  # [nsub, 4, nchan, nbin]
+    data_phys = data_phys + rng.normal(0, 0.01, data_phys.shape)
+    q, scl, offs, data_quant = int16_encode(data_phys)
+    weights = np.ones((nsub, nchan))
+
+    primary = header_block([
+        card("SIMPLE", True), card("BITPIX", 8), card("NAXIS", 0),
+        card("EXTEND", True), card("HDRVER", "6.1"),
+        card("FITSTYPE", "PSRFITS"), card("OBS_MODE", "PSR"),
+        card("TELESCOP", "GBT"), card("FRONTEND", "Rcvr1_2"),
+        card("BACKEND", "GUPPI"), card("FD_POLN", "LIN"),
+        card("OBSFREQ", 1300.0), card("OBSBW", 400.0),
+        card("OBSNCHAN", nchan), card("SRC_NAME", "J0437-4715"),
+        card("STT_IMJD", stt_imjd), card("STT_SMJD", stt_smjd),
+        card("STT_OFFS", stt_offs),
+    ])
+    be = np.dtype(">f8")
+    offs_sub = np.array([tsub / 2 + i * tsub for i in range(nsub)])
+    rows = []
+    for isub in range(nsub):
+        rows.append((
+            np.array(tsub, be).tobytes(),
+            np.array(offs_sub[isub], be).tobytes(),
+            np.array(periods[isub], be).tobytes(),
+            freqs.astype(be).tobytes(),
+            weights[isub].astype(">f4").tobytes(),
+            offs[isub].reshape(-1).astype(">f4").tobytes(),
+            scl[isub].reshape(-1).astype(">f4").tobytes(),
+            q[isub].reshape(-1).astype(">i2").tobytes(),
+        ))
+    subint = bintable("SUBINT", [
+        ("TSUBINT", "1D", None, [r[0] for r in rows]),
+        ("OFFS_SUB", "1D", None, [r[1] for r in rows]),
+        ("PERIOD", "1D", None, [r[2] for r in rows]),
+        ("DAT_FREQ", "%dD" % nchan, None, [r[3] for r in rows]),
+        ("DAT_WTS", "%dE" % nchan, None, [r[4] for r in rows]),
+        ("DAT_OFFS", "%dE" % (npol * nchan), None, [r[5] for r in rows]),
+        ("DAT_SCL", "%dE" % (npol * nchan), None, [r[6] for r in rows]),
+        ("DATA", "%dI" % (npol * nchan * nbin),
+         "(%d,%d,%d)" % (nbin, nchan, npol), [r[7] for r in rows]),
+    ], extra_cards=[
+        card("INT_TYPE", "TIME"), card("INT_UNIT", "SEC"),
+        card("SCALE", "FluxDen"), card("POL_TYPE", "IQUV"),
+        card("NPOL", npol), card("TBIN", (1.0 / F0) / nbin),
+        card("NBIN", nbin), card("NCHAN", nchan),
+        card("CHAN_BW", 100.0), card("DM", DM),
+        card("NBITS", 1), card("NSBLK", 1),
+        card("EPOCHS", "MIDTIME"),
+    ])
+    with open(os.path.join(HERE, "stokes_style.fits"), "wb") as f:
+        f.write(primary + subint)
+
+    # independently-computed coherence equivalents (LIN basis)
+    Iq, Qq, Uq, Vq = (data_quant[:, i] for i in range(4))
+    coherence = np.stack([(Iq + Qq) / 2.0, (Iq - Qq) / 2.0,
+                          Uq / 2.0, Vq / 2.0], axis=1)
+    np.savez(os.path.join(HERE, "stokes_style_expected.npz"),
+             data=data_quant, coherence=coherence, freqs=freqs,
+             weights=weights, periods=periods, offs_sub=offs_sub,
+             DM=DM, stt=np.array([stt_imjd, stt_smjd, stt_offs]))
+    print("wrote stokes_style.fits (%d bytes)"
+          % os.path.getsize(os.path.join(HERE, "stokes_style.fits")))
+
+
 if __name__ == "__main__":
     main()
+    make_t2pred()
+    make_stokes()
