@@ -68,6 +68,7 @@ def test_print_paz_cmds(setup, capsys):
     assert os.path.exists(out)
 
 
+@pytest.mark.slow
 def test_cli_ppzap(setup, capsys):
     from pulseportraiture_tpu.cli.ppzap import main
 
@@ -82,6 +83,7 @@ def test_cli_ppzap(setup, capsys):
     capsys.readouterr()
 
 
+@pytest.mark.slow
 def test_cli_pptoas_wideband_and_formats(setup):
     from pulseportraiture_tpu.cli.pptoas import main
 
@@ -114,6 +116,7 @@ def test_cli_pptoas_wideband_and_formats(setup):
                open(one).read().splitlines()[1:])
 
 
+@pytest.mark.slow
 def test_cli_ppspline_and_model(setup):
     from pulseportraiture_tpu.cli.ppspline import main
 
@@ -125,6 +128,7 @@ def test_cli_ppspline_and_model(setup):
     assert mean_prof.shape == (128,)
 
 
+@pytest.mark.slow
 def test_cli_ppgauss(setup):
     from pulseportraiture_tpu.cli.ppgauss import main
     from pulseportraiture_tpu.io.gmodel import read_model
@@ -140,6 +144,7 @@ def test_cli_ppgauss(setup):
     assert os.path.exists(out + "_errs")
 
 
+@pytest.mark.slow
 def test_cli_ppalign(setup):
     from pulseportraiture_tpu.cli.ppalign import main
 
@@ -166,6 +171,7 @@ def test_cli_ppalign(setup):
     assert avg.subints[0, 0][avg.ok_ichans[0]].max() > 0.5
 
 
+@pytest.mark.slow
 def test_viz_smoke(setup):
     import matplotlib
 
@@ -235,6 +241,7 @@ def test_cli_pptoas_flags_and_cuts(setup):
     assert main(["-d", hot, "-m", gm, "--narrowband", "--one_DM"]) == 1
 
 
+@pytest.mark.slow
 def test_cli_ppalign_gaussian_init_and_template(setup):
     from pulseportraiture_tpu.cli.ppalign import main
     from pulseportraiture_tpu.io.psrfits import read_archive
@@ -272,6 +279,7 @@ def test_cli_ppzap_hist(setup):
     assert os.path.exists(hot + "_ppzap_hist.png")
 
 
+@pytest.mark.slow
 def test_gaussian_selector_state_machine():
     """Selector state transitions: sketch -> fit -> remove, display-free."""
     import matplotlib
@@ -305,6 +313,7 @@ def test_gaussian_selector_state_machine():
     assert sel.done
 
 
+@pytest.mark.slow
 def test_gaussian_selector_events():
     """Drive the selector through real matplotlib events (Agg backend)."""
     import matplotlib
@@ -372,6 +381,7 @@ def test_cli_ppgauss_interactive_headless(setup):
     assert rc == 1
 
 
+@pytest.mark.slow
 def test_cli_pptoas_checkpoint(setup, tmp_path):
     """--checkpoint is the output, resumes across runs, and rejects
     post-processing flags."""

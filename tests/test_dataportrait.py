@@ -61,6 +61,7 @@ def test_smooth_portrait_reduces_noise(archive):
     assert dp.flux_profx.shape == (len(dp.portx),)
 
 
+@pytest.mark.slow
 def test_fit_flux_profile_recovers_spectral_index(archive):
     tmp, fits = archive
     dp = DataPortrait(fits, quiet=True)

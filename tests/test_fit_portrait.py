@@ -67,6 +67,7 @@ def test_objective_matches_oracle():
     np.testing.assert_allclose(got, want, rtol=1e-10)
 
 
+@pytest.mark.slow
 def test_grad_hess_match_autodiff():
     model, data = make_data(phi=0.05, dDM=1e-3, tau=0.003, noise=0.01)
     cross, abs_m2, inv_err2 = _prep(data, model, 0.01)
@@ -90,6 +91,7 @@ def test_grad_hess_match_autodiff():
                                atol=1e-9 * float(jnp.abs(H_ad).max()))
 
 
+@pytest.mark.slow
 def test_recover_phase_dm_noiseless():
     phi_inj, dDM_inj = 0.123, 2.3e-3
     model, data = make_data(phi=phi_inj, dDM=dDM_inj)
@@ -107,6 +109,7 @@ def test_recover_phase_dm_noiseless():
     assert int(out.return_code) in (1, 2)
 
 
+@pytest.mark.slow
 def test_recover_full_five_param():
     phi_inj, dDM_inj, tau_inj, alpha_inj = 0.07, 1.1e-3, 0.004, -4.2
     model, data = make_data(phi=phi_inj, dDM=dDM_inj, tau=tau_inj,
@@ -147,6 +150,7 @@ def test_matches_scipy_oracle_minimum():
     assert f_ours <= f_or + 1e-6 * abs(f_or)
 
 
+@pytest.mark.slow
 def test_batched_fit_recovers_per_subint(rng):
     nsub = 8
     phis = rng.uniform(-0.3, 0.3, nsub)
@@ -187,6 +191,7 @@ def test_nu_zero_decorrelates_phi_dm():
     assert abs(rho) < 0.05, rho
 
 
+@pytest.mark.slow
 def test_error_calibration_phase_dm(rng):
     # empirical scatter of fitted params across noise realizations should
     # match the reported 1-sigma errors
@@ -256,6 +261,7 @@ def test_zapped_channels_masked(rng):
     assert 0.5 < float(out.red_chi2) < 2.0
 
 
+@pytest.mark.slow
 def test_pair_path_matches_complex128():
     """The TPU f64 (re, im) pair path (DFT-matmul spectra + real-pair
     moments) is numerically identical to the complex128 path."""
@@ -317,6 +323,7 @@ def test_pair_path_matches_complex128():
     assert abs(10 ** float(s_p.tau) - 3e-3) / 3e-3 < 0.1
 
 
+@pytest.mark.slow
 def test_model_kmax_semantics():
     """Harmonic cutoff: small for clean compact templates, full for
     noisy ones, None for traced input."""
@@ -356,6 +363,7 @@ def test_model_kmax_semantics():
     assert abs(float(r_auto.phi - r_full.phi)) * P0 * 1e9 < 1e-3
 
 
+@pytest.mark.slow
 def test_batched_polynomial_nu_zero_flags_11100(rng):
     """flags (1,1,1,0,0) routes nu_zero through the degree-6 polynomial
     root solve; at batch 64 the whole batch must make ONE host callback
@@ -393,6 +401,7 @@ def test_batched_polynomial_nu_zero_flags_11100(rng):
                                    float(one.phi), atol=1e-9)
 
 
+@pytest.mark.slow
 def test_scan_size_and_cast_match_plain_batch(rng):
     """The chunked-scan path (scan_size, incl. padding) and the in-graph
     cast must reproduce the plain vmapped batch exactly."""
@@ -434,6 +443,7 @@ def test_scan_size_and_cast_match_plain_batch(rng):
                                np.asarray(ref.phi), rtol=0, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_in_graph_seeding_matches_explicit(rng):
     """init_params=None seeds phases in-graph (one dispatch for
     seed+fit); results must match seeding with fit_phase_shift
@@ -471,6 +481,7 @@ def test_in_graph_seeding_matches_explicit(rng):
                                    log10_tau=True)
 
 
+@pytest.mark.slow
 def test_polish_iter_cap_parity():
     """Capping the f64 polish stage (polish_iter) must not move results
     beyond the parity budget on a converged fit."""

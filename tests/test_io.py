@@ -58,6 +58,7 @@ def test_gmodel_roundtrip(gmodel_file):
     np.testing.assert_allclose(alpha, -4.0)
 
 
+@pytest.mark.slow
 def test_gmodel_build_portrait(gmodel_file):
     freqs = np.linspace(1300, 1700, 8)
     phases = np.linspace(1 / 128, 1 - 1 / 128, 64)

@@ -19,6 +19,7 @@ from pulseportraiture_tpu.ops.profiles import (gen_gaussian_portrait,
 MODEL_PARAMS = np.array([0.05, 0.0, 0.35, -0.05, 0.05, 0.1, 1.0, -1.2])
 
 
+@pytest.mark.slow
 def test_fit_gaussian_profile_recovers():
     rng = np.random.default_rng(0)
     nbin = 256
@@ -44,6 +45,7 @@ def test_fit_gaussian_profile_scattering():
     assert abs(r.fitted_params[1] - 6.0) < 1.0, r.fitted_params
 
 
+@pytest.mark.slow
 def test_peak_pick_seed_finds_components():
     rng = np.random.default_rng(2)
     nbin = 256
@@ -66,6 +68,7 @@ def test_auto_gauss_seed():
     assert abs(r.fitted_params[3] - 0.06) < 0.01
 
 
+@pytest.mark.slow
 def test_fit_gaussian_portrait_recovers():
     rng = np.random.default_rng(3)
     nbin, nchan = 256, 16
@@ -108,6 +111,7 @@ def gauss_setup(tmp_path_factory):
     return tmp, gm, par, avg
 
 
+@pytest.mark.slow
 def test_make_gaussian_model_recovers_injected(gauss_setup):
     tmp, gm, par, avg = gauss_setup
     dp = make_gaussian_model(avg, niter=3, quiet=True)
@@ -125,6 +129,7 @@ def test_make_gaussian_model_recovers_injected(gauss_setup):
     assert dp.cnvrgnc
 
 
+@pytest.mark.slow
 def test_gaussian_model_toa_pipeline(gauss_setup):
     from pulseportraiture_tpu.pipelines.toas import GetTOAs
 
@@ -147,6 +152,7 @@ def test_gaussian_model_toa_pipeline(gauss_setup):
     assert abs(got - 8e-4) < max(5 * err, 1e-4), (got, err)
 
 
+@pytest.mark.slow
 def test_improve_mode_from_modelfile(gauss_setup):
     tmp, gm, par, avg = gauss_setup
     # seed from the true .gmodel (improve mode) and refit

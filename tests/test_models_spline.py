@@ -52,6 +52,7 @@ def test_wavelet_smooth_denoises():
         0.5 * np.sqrt(np.mean((noisy - prof) ** 2))
 
 
+@pytest.mark.slow
 def test_smart_smooth_batched_and_fallbacks():
     rng = np.random.default_rng(2)
     prof = np.asarray(gaussian_profile(256, 0.5, 0.05))
@@ -93,6 +94,7 @@ def test_pca_matches_numpy_cov():
     np.testing.assert_allclose(rec, port, atol=1e-10)
 
 
+@pytest.mark.slow
 def test_find_significant_eigvec():
     rng = np.random.default_rng(4)
     nbin = 256
@@ -130,6 +132,7 @@ def spline_setup(tmp_path_factory):
     return tmp, gm, par, avg
 
 
+@pytest.mark.slow
 def test_make_spline_model_reconstructs(spline_setup):
     tmp, gm, par, avg = spline_setup
     dp = DataPortrait(avg, quiet=True)
@@ -144,6 +147,7 @@ def test_make_spline_model_reconstructs(spline_setup):
     assert np.abs(built.model[0] - built.model[-1]).max() > 0.01
 
 
+@pytest.mark.slow
 def test_spline_model_roundtrip_and_toas(spline_setup):
     tmp, gm, par, avg = spline_setup
     from pulseportraiture_tpu.pipelines.toas import GetTOAs

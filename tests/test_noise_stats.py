@@ -1,6 +1,7 @@
 """Tests for ops.noise, ops.stats, ops.normalize, ops.powlaw."""
 
 import numpy as np
+import pytest
 
 from pulseportraiture_tpu.ops import noise as nz
 from pulseportraiture_tpu.ops import normalize as nm
@@ -35,6 +36,7 @@ def test_get_noise_ignores_pulse(rng):
     np.testing.assert_allclose(got, 1.0, rtol=0.2)
 
 
+@pytest.mark.slow
 def test_get_noise_fit_pulse_plus_noise(rng):
     # pure white noise leaves the exponential noise-floor fit
     # unconstrained (same in the reference); use a pulse + noise profile
@@ -84,6 +86,7 @@ def test_count_crossings():
     assert int(st.count_crossings(x, 0.5)) == 4
 
 
+@pytest.mark.slow
 def test_normalize_methods(rng):
     port = rng.normal(1.0, 0.3, size=(8, 256))
     port[3] = 0.0  # zapped channel passes through
@@ -144,6 +147,7 @@ def test_wiener_filter_shape_and_range(rng):
     assert np.median(wf[nbin // 4:]) < 0.5
 
 
+@pytest.mark.slow
 def test_wiener_smooth_reduces_error(rng):
     from pulseportraiture_tpu.ops.profiles import gen_gaussian_profile
 
@@ -162,6 +166,7 @@ def test_wiener_smooth_reduces_error(rng):
         assert rms_sm < fac * rms_raw, (brick, rms_sm, rms_raw)
 
 
+@pytest.mark.slow
 def test_fit_brickwall_finds_cutoff(rng):
     # band-limited signal: exactly kc_true nonzero harmonics
     nbin, kc_true = 256, 12

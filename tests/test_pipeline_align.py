@@ -83,6 +83,7 @@ def test_align_archives_niter3_nonzero(setup, tmp_path):
     assert prof.max() / np.abs(prof).mean() > 3
 
 
+@pytest.mark.slow
 def test_align_archives_mixed_channelization(setup, tmp_path):
     """Archives whose channelization differs from the template go
     through the nearest-frequency channel mapping (ref
@@ -113,6 +114,7 @@ def test_align_archives_mixed_channelization(setup, tmp_path):
     assert d.prof_SNR > 50
 
 
+@pytest.mark.slow
 def test_psrsmooth_archive(setup, tmp_path):
     """-W equivalent: wavelet-denoised archive has the same shape and a
     higher S/N average profile than the raw one."""

@@ -24,6 +24,7 @@ def nb_setup(tmp_path_factory):
     return tmp, gm, par
 
 
+@pytest.mark.slow
 def test_narrowband_phase_recovery(nb_setup):
     # DM=0 ephemeris: the narrowband path un-dedisperses loaded data
     # (reference pptoas.py:806-822), so a zero-DM archive isolates the
@@ -75,6 +76,7 @@ def test_narrowband_tracks_dispersion(nb_setup):
     assert np.all(np.abs(dev) < tol), (dev, tol)
 
 
+@pytest.mark.slow
 def test_narrowband_scattering_fit(nb_setup):
     """fit_scat recovers an injected per-channel scattering time (a mode
     the reference declares unimplemented)."""

@@ -48,6 +48,7 @@ def fake_archives(fixture_dir):
     return files, phases, dDMs, gmodel
 
 
+@pytest.mark.slow
 def test_get_toas_recovers_injected_dDM(fake_archives):
     files, phases, dDMs, gmodel = fake_archives
     gt = GetTOAs(files, gmodel, quiet=True)
@@ -64,6 +65,7 @@ def test_get_toas_recovers_injected_dDM(fake_archives):
         assert 0.5 < np.median(gt.red_chi2s[iarch][ok]) < 1.5
 
 
+@pytest.mark.slow
 def test_toa_epochs_and_flags(fake_archives):
     files, phases, dDMs, gmodel = fake_archives
     gt = GetTOAs(files[:1], gmodel, quiet=True)
@@ -96,6 +98,7 @@ def test_write_tim(fake_archives, tmp_path):
     assert all("-pp_dm" in line for line in lines)
 
 
+@pytest.mark.slow
 def test_tscrunch_mode(fake_archives):
     files, phases, dDMs, gmodel = fake_archives
     gt = GetTOAs(files[:1], gmodel, quiet=True)
@@ -113,6 +116,7 @@ def test_zap_channels_clean_data(fake_archives):
     assert flagged <= 2, zaps[0]
 
 
+@pytest.mark.slow
 def test_spline_model_pipeline(fake_archives, tmp_path):
     # build a real spline model with the ppspline-equivalent builder and
     # fit with it (deeper builder coverage in test_models_spline.py)
@@ -133,6 +137,7 @@ def test_spline_model_pipeline(fake_archives, tmp_path):
     assert np.all(np.asarray(gt.snrs[0])[ok] > 20)
 
 
+@pytest.mark.slow
 def test_nu_refs_honored(fake_archives):
     files, phases, dDMs, gmodel = fake_archives
     gt = GetTOAs(files[:1], gmodel, quiet=True)
@@ -142,6 +147,7 @@ def test_nu_refs_honored(fake_archives):
     assert all(abs(t.frequency - 1400.0) < 1e-9 for t in gt.TOA_list)
 
 
+@pytest.mark.slow
 def test_two_channel_degraded_mode(fixture_dir):
     """A 2-live-channel subint demotes only the GM flag (reference
     pptoas.py:474-484 semantics) and still runs under fit_scat."""
@@ -202,6 +208,7 @@ def test_calculate_toa():
     assert abs(dsec / P - phi_exp) < 1e-9
 
 
+@pytest.mark.slow
 def test_get_toas_odd_nbin(tmp_path):
     """Odd phase-bin counts (no rFFT Nyquist bin) run end to end."""
     from pulseportraiture_tpu.io.archive import make_fake_pulsar
@@ -225,6 +232,7 @@ def test_get_toas_odd_nbin(tmp_path):
     assert np.isfinite(gt.TOA_list[0].TOA_error)
 
 
+@pytest.mark.slow
 def test_get_toas_checkpoint_resume(tmp_path):
     """TOAs append to the checkpoint per archive, and a re-run skips
     archives already written (crash-resume semantics)."""
@@ -295,6 +303,7 @@ def test_get_toas_checkpoint_resume(tmp_path):
         [files[0]] * 2 + [files[1]] * 2 + [files[2]] * 2
 
 
+@pytest.mark.slow
 def test_degraded_doppler_flagged(tmp_path):
     """When the ephemeris lacks coordinates the Doppler factors degrade
     to unity; a bary=True TOA must carry -pp_topo 1 (VERDICT r02 #6),
@@ -360,6 +369,7 @@ def test_checkpoint_zero_toa_archive_stays_done(tmp_path):
     assert len(open(ckpt).readlines()) == 3
 
 
+@pytest.mark.slow
 def test_long_observation_scanned_fit(tmp_path):
     """An archive with >128 subints routes through the chunked-scan fit
     (bounded compile footprint) and still recovers the injection."""

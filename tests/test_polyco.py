@@ -132,6 +132,7 @@ def test_f0_fallback_warns(drifting_archive, capsys):
     assert np.ptp(arch3.Ps) == 0.0
 
 
+@pytest.mark.slow
 def test_toas_at_parity_with_drifting_periods(drifting_archive):
     from pulseportraiture_tpu.config import Dconst
     from pulseportraiture_tpu.pipelines.toas import GetTOAs

@@ -14,6 +14,7 @@ from pulseportraiture_tpu.io.gmodel import write_model
 MODEL_PARAMS = np.array([0.02, 0.0, 0.40, 0.0, 0.05, 0.0, 1.0, -1.2])
 
 
+@pytest.mark.slow
 def test_fit_powlaw_recovers():
     rng = np.random.default_rng(0)
     freqs = np.linspace(1200.0, 1800.0, 64)
@@ -85,6 +86,7 @@ def test_join_dataportrait(two_bands):
                                atol=1e-12)
 
 
+@pytest.mark.slow
 def test_join_gaussian_model(two_bands):
     """Multi-receiver model building (SURVEY S8): a Gaussian model fit
     across two joined bands recovers the injected component."""

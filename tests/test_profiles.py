@@ -1,6 +1,7 @@
 """Tests for ops.profiles (Gaussian generation + evolution laws)."""
 
 import numpy as np
+import pytest
 
 from pulseportraiture_tpu.ops import profiles as pf
 from pulseportraiture_tpu.ops.fourier import get_bin_centers
@@ -73,6 +74,7 @@ def test_evolution_laws():
                                rtol=1e-12)
 
 
+@pytest.mark.slow
 def test_gen_gaussian_portrait_at_nu_ref():
     # At nu_ref the portrait channel equals the reference profile.
     freqs = np.array([1400.0, 1500.0, 1600.0])

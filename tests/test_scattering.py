@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from pulseportraiture_tpu.ops import scattering as sc
 
@@ -37,6 +38,7 @@ def _chain(tau, alpha, freqs, nu_tau, nbin, log10_tau=True):
     return sc.scattering_portrait_FT(taus, nbin)
 
 
+@pytest.mark.slow
 def test_scattering_FT_deriv_vs_autodiff():
     freqs = jnp.linspace(1300.0, 1700.0, 4)
     nu_tau, nbin = 1500.0, 64
@@ -59,6 +61,7 @@ def test_scattering_FT_deriv_vs_autodiff():
     np.testing.assert_allclose(got[1], np.asarray(jac_alpha), atol=1e-10)
 
 
+@pytest.mark.slow
 def test_scattering_FT_2deriv_vs_autodiff():
     freqs = jnp.linspace(1300.0, 1700.0, 3)
     nu_tau, nbin = 1500.0, 32
@@ -87,6 +90,7 @@ def test_scattering_FT_2deriv_vs_autodiff():
     np.testing.assert_allclose(got, hess, atol=1e-9)
 
 
+@pytest.mark.slow
 def test_abs_scattering_derivs_vs_autodiff():
     freqs = jnp.linspace(1300.0, 1700.0, 3)
     nu_tau, nbin = 1500.0, 32

@@ -82,6 +82,7 @@ kw = dict(fit_flags=(1,1,0,0,0), log10_tau=False, max_iter=50,
 """
 
 
+@pytest.mark.slow
 def test_pair_fit_parity_on_device():
     """The hybrid/pair f64 path on the chip agrees with an independent
     complex128 oracle run in a cpu-pinned process at the sub-ns level
@@ -126,6 +127,7 @@ print("PHIS", " ".join("%.15f" % p for p in np.asarray(out.phi)))
     assert ns < 1.0, ns
 
 
+@pytest.mark.slow
 def test_pipeline_runs_on_device():
     """make_fake_pulsar -> GetTOAs (wideband + narrowband) executes with
     the TPU as the default backend and recovers the injected dDM."""

@@ -77,6 +77,7 @@ def test_scattering_FT_no_c128(no_c128):
     assert f(taus).dtype == jnp.complex64
 
 
+@pytest.mark.slow
 def test_fit_portrait_full_clamped_parity(no_c128):
     # phase+DM fit on clean synthetic data: the clamped (TPU-style) path
     # must recover the same (phi, DM) as full f64 to ~1e-7 rot
