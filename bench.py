@@ -10,13 +10,22 @@ vs_baseline is measured throughput / target throughput (1000 fits/60 s);
 dispatch: a lax.scan over vmapped fixed-size chunks inside a single
 compiled program (fit_portrait_full_batch(scan_size=...)), so the
 compile footprint stays bounded while no per-chunk dispatch latency is
-paid.
+paid.  The configs, model, injections and the two timed fit programs
+live in bench_common.NorthStar, shared verbatim with
+tools/perf_probe.py so the committed perf evidence measures exactly
+what is benched.
 
 extra carries the other BASELINE.md configs and the accuracy criterion:
 - parity_scipy_max_ns / parity_cpu_f64_max_ns: max |device - oracle| TOA
-  residual on identical data (target < 1 ns).  The SciPy oracle is the
-  independent Nelder-Mead+Powell minimizer from tests/oracle.py; the
-  CPU-f64 oracle is this framework's own kernel at full precision.
+  residual on identical data (target < 1 ns), with the device side run
+  through the SAME fast32 + hybrid + polish-capped path the timed fits
+  use.  The SciPy oracle is the independent Nelder-Mead+Powell
+  minimizer from tests/oracle.py; the CPU-f64 oracle is this
+  framework's own kernel at full precision with exact spectra.
+- parity_scat_cpu_f64_max_ns: the same device-vs-CPU check for the
+  scattering configuration (flags 11011, coarse_kmax f32 stage) — the
+  coarse-harmonic truncation is parity-guarded in-bench, not just in
+  PERF.md's one-off A/B.
 - scat_fits_per_sec: the joint phase+DM+tau+alpha fit (flags 11011).
 - ipta_fits_per_sec: the 20 pulsars x 10 epochs sharded sweep
   (parallel.sharded_fit.ipta_sweep_fit).
@@ -33,22 +42,13 @@ import time
 
 import numpy as np
 
+from bench_common import (MODEL_PARAMS, NOISE, P0, POLISH_ITER,
+                          SCAT_COARSE_KMAX, TAU_INJ, NorthStar,
+                          enable_compile_cache, materialize,
+                          stage as _stage, timed_passes)
+
 # kill -USR1 <pid> dumps all Python stacks to stderr (hang diagnosis)
 faulthandler.register(signal.SIGUSR1, all_threads=True)
-
-# persistent XLA compilation cache: the handful of big fit programs cost
-# minutes to compile through the TPU tunnel; cached, a repeat bench run
-# (same jaxlib + same shapes) skips straight to execution
-_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          ".jax_cache")
-
-
-def _enable_compile_cache(jax):
-    try:
-        jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
-    except Exception as e:  # cache is best-effort
-        _stage("compilation cache unavailable: %s" % e)
 
 
 def _load_oracle():
@@ -58,29 +58,6 @@ def _load_oracle():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
-
-
-_T0 = time.time()
-
-
-def _stage(msg):
-    """Progress marker on stderr (stdout carries only the JSON line)."""
-    print("[bench %7.1fs] %s" % (time.time() - _T0, msg), file=sys.stderr,
-          flush=True)
-
-
-def _timed_passes(run, wait, label, n=2):
-    """Best-of-n wall time for run() (tunnel dispatch latency varies);
-    returns (best seconds, last result), logging every pass."""
-    best, out = float("inf"), None
-    for i in range(n):
-        t0 = time.time()
-        out = run()
-        wait(out)
-        dur = time.time() - t0
-        best = min(best, dur)
-        _stage("%s pass %d done in %.1fs" % (label, i + 1, dur))
-    return best, out
 
 
 def _align_batch(n_arch):
@@ -137,106 +114,35 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    _enable_compile_cache(jax)
+    enable_compile_cache(jax)
 
     from pulseportraiture_tpu.config import Dconst
-    from pulseportraiture_tpu.fit.portrait import (fit_portrait_full_batch,
-                                                   model_kmax)
-    from pulseportraiture_tpu.ops.fourier import get_bin_centers, rotate_data
-    from pulseportraiture_tpu.ops.profiles import gen_gaussian_portrait
+    from pulseportraiture_tpu.fit.portrait import fit_portrait_full_batch
 
+    ns = NorthStar(jax)
     platform = jax.devices()[0].platform
-    on_accel = platform not in ("cpu",)
-    if on_accel:
-        # scan: the whole batch runs as ONE dispatch — a lax.scan over
-        # vmapped 100-subint chunks inside a single compiled program
-        # (fit_portrait_full_batch(scan_size=...)).  The compile
-        # footprint stays that of a 100-subint program (chunk=200
-        # monolithic fails the remote compile helper; measured r03),
-        # while the tunnel's ~0.3 s dispatch latency is paid once, not
-        # nsub/100 times
-        nsub, nchan, nbin, scan = 1000, 512, 2048, 100
-    else:  # CPU smoke config (first-slice scale from BASELINE.md)
-        nsub, nchan, nbin, scan = 64, 128, 1024, 32
-    P0 = 0.005
-    noise = 0.05
-    # generation/storage dtype; the timed fits run in FULL f64 on every
-    # backend — on TPU via the complex128-free (re, im) pair path
-    # (ops.fourier.rfft_pair + pair moments), which is what holds the
-    # <1 ns oracle-parity criterion at speed
-    dtype = jnp.float32 if on_accel else jnp.float64
-    fit_dtype = jnp.float64
+    on_accel = ns.on_accel
+    nsub, nchan, nbin, scan = ns.nsub, ns.nchan, ns.nbin, ns.scan
+    fit_dtype = ns.fit_dtype
+    freqs, freqs_j, nu0 = ns.freqs, ns.freqs_j, ns.nu0
+    phis_inj, dDMs_inj = ns.phis_inj, ns.dDMs_inj
+    errs, Ps = ns.errs, ns.Ps
+    model64_dev, KMAX = ns.model64_dev, ns.kmax
 
-    # the template is analytic: generate in f64 so its spectral tail is
-    # genuinely zero and model_kmax can truncate the harmonic axis
-    # (an f32-generated model's quantization noise floods the tail)
-    model_params = np.array([0.0, 0.0, 0.35, -0.05, 0.05, 0.1, 1.0, -1.2])
-    freqs = np.linspace(1300.0, 1700.0, nchan) + 400.0 / nchan / 2
-    phases = np.asarray(get_bin_centers(nbin), dtype=np.float64)
-    model64 = np.asarray(gen_gaussian_portrait("000", model_params, -4.0,
-                                               phases, freqs, 1500.0),
-                         dtype=np.float64)
-    model = jnp.asarray(model64, dtype)
-
-    rng = np.random.default_rng(0)
-    phis_inj = rng.uniform(-0.4, 0.4, nsub)
-    dDMs_inj = rng.uniform(-2e-3, 2e-3, nsub)
-    freqs_j = jnp.asarray(freqs, jnp.float64)
-
-    def make_chunk(i0, i1, key):
-        ph = jnp.asarray(phis_inj[i0:i1])
-        dm = jnp.asarray(dDMs_inj[i0:i1])
-        base = jax.vmap(
-            lambda p, d: rotate_data(model, -p, -d, P0, freqs_j,
-                                     float(freqs.mean())))(ph, dm)
-        noise_arr = noise * jax.random.normal(key, base.shape, dtype)
-        return (base + noise_arr).astype(dtype)
-
-    # generate in scan-sized blocks (bounds rotate_data's spectral
-    # temporaries), then concatenate into one device-resident batch
-    keys = jax.random.split(jax.random.key(1), (nsub + scan - 1) // scan)
-    blocks = []
-    for ci, i0 in enumerate(range(0, nsub, scan)):
-        i1 = min(i0 + scan, nsub)
-        blocks.append(make_chunk(i0, i1, keys[ci]))
-    data_all = jnp.concatenate(blocks, axis=0)
-    del blocks
-    jax.block_until_ready(data_all)
+    data_all = ns.main_data()
     _stage('data generated on device')
 
-    errs = jnp.full((nsub, nchan), noise, fit_dtype)
-    Ps = jnp.full((nsub,), P0, jnp.float64)
-    # f64 template straight from the clean f64 generation (an f32 round
-    # trip would re-flood the spectral tail with noise); shared 2-D —
-    # never materialized per-subint; harmonic cutoff computed once
-    model64_dev = jnp.asarray(model64)
-    KMAX = model_kmax(model64)
-
-    def fit_all(data):
-        # storage stays f32; the scan body casts each chunk to f64 for
-        # the pair-path fit (cast=), and init_params=None runs the
-        # batched FFTFIT seeding in the SAME program: the whole
-        # 1000-subint seed+fit is one device dispatch
-        # polish_iter=6 caps the f64 polish stage (the vmapped
-        # while_loop runs to the slowest lane): measured 13% faster at
-        # a 0.006 ns max effect on this config (r03 probe)
-        return fit_portrait_full_batch(
-            data, model64_dev, None, Ps, freqs_j, errs=errs,
-            fit_flags=(1, 1, 0, 0, 0), log10_tau=False,
-            max_iter=30, kmax=KMAX, scan_size=scan, cast=fit_dtype,
-            polish_iter=6)
-
     _stage('compiling seed+fit program')
-    jax.block_until_ready(fit_all(data_all).phi)
+    materialize(ns.fit_main(data_all).phi)
     _stage('compiled; timing main config')
 
     # timed end-to-end on device (seed + scanned fit = ONE dispatch);
     # best of two passes — the TPU tunnel's dispatch latency varies
     # with ambient host load, and the sustained-throughput number is
     # the less-loaded pass
-    duration, out = _timed_passes(lambda: fit_all(data_all),
-                                  lambda o: jax.block_until_ready(o.phi),
-                                  'main config')
+    duration, out = timed_passes(lambda: ns.fit_main(data_all),
+                                 lambda o: materialize(o.phi),
+                                 'main config')
 
     # accuracy vs injections: transform fitted phi back to the injection
     # reference frequency and compare [ns]
@@ -244,7 +150,6 @@ def main():
     DM = np.asarray(out.DM)
     nu_ref = np.asarray(out.nu_DM)
     phi_err = np.asarray(out.phi_err)
-    nu0 = float(freqs.mean())
     phi_at_nu0 = phi + Dconst * DM / P0 * (nu0 ** -2.0 - nu_ref ** -2.0)
     resid = (phi_at_nu0 - phis_inj + 0.5) % 1.0 - 0.5
     resid_ns = resid * P0 * 1e9
@@ -252,31 +157,36 @@ def main():
     zscore = np.median(np.abs(resid) / phi_err)
 
     # ---- parity vs oracles (the BASELINE <1 ns criterion) -------------
-    # pin nu_fit = nu_out = nu0 on all paths so phi/DM compare directly
+    # pin nu_fit = nu_out = nu0 on all paths so phi/DM compare directly;
+    # the device side runs the SAME fast32 + hybrid + polish-capped
+    # path as the timed fits (f32 storage, cast=f64, polish_iter)
     K_cpu = min(32, scan)
     K_scipy = 4
     data_par = data_all[:K_cpu]
-    nus_pin = np.tile([nu0, nu0, nu0], (K_cpu, 1))
+    nus_pin = ns.nus_pin(K_cpu)
     init_par = np.zeros((K_cpu, 5))
     init_par[:, 0] = phis_inj[:K_cpu]
     init_par[:, 1] = dDMs_inj[:K_cpu]
 
-    def pinned_fit(data, nsel, dtype_sel, kmax=None):
+    def pinned_fit(data, nsel, dtype_sel, kmax=None, cast=None,
+                   polish_iter=None):
         return fit_portrait_full_batch(
-            jnp.asarray(data, dtype_sel), model64_dev.astype(dtype_sel),
+            jnp.asarray(data, dtype_sel), model64_dev,
             init_par[:nsel], Ps[:nsel], freqs_j,
-            errs=errs[:nsel].astype(dtype_sel),
+            errs=errs[:nsel],
             fit_flags=(1, 1, 0, 0, 0), nu_fits=nus_pin[:nsel],
             nu_outs=(nus_pin[:nsel, 0], nus_pin[:nsel, 1],
                      nus_pin[:nsel, 2]),
-            log10_tau=False, max_iter=50, kmax=kmax)
+            log10_tau=False, max_iter=30 if cast is not None else 50,
+            kmax=kmax, cast=cast, polish_iter=polish_iter)
 
-    _stage('parity: device pinned fit')
-    dev_out = pinned_fit(data_par, K_cpu, fit_dtype, kmax=KMAX)
-    dev_phi = np.asarray(dev_out.phi)
-    dev_DM = np.asarray(dev_out.DM)
+    _stage('parity: device pinned fit (timed path)')
+    dev_out = pinned_fit(data_par, K_cpu, ns.dtype, kmax=KMAX,
+                         cast=fit_dtype, polish_iter=POLISH_ITER)
+    dev_phi = materialize(dev_out.phi)
+    dev_DM = materialize(dev_out.DM)
     # CPU f64 oracle: identical data/inits through the same kernel at
-    # full precision on the host backend
+    # full precision (exact spectra, uncapped polish) on the host
     data_np = np.asarray(data_par, np.float64)
     cpu_dev = jax.devices("cpu")[0]
     _stage('parity: CPU f64 oracle')
@@ -295,10 +205,10 @@ def main():
     parity_scipy = []
     for i in range(K_scipy):
         x, _ = oracle.oracle_fit(
-            data_np[i], model64,
+            data_np[i], ns.model64,
             init_par[i], P0, np.asarray(freqs, np.float64),
             fit_flags=(1, 1, 0, 0, 0), log10_tau=False,
-            noise=np.full(nchan, noise), nu_fits=nu0)
+            noise=np.full(nchan, NOISE), nu_fits=nu0)
         d = (dev_phi[i] - x[0] + 0.5) % 1.0 - 0.5
         parity_scipy.append(abs(d) * P0 * 1e9)
         _stage('scipy oracle fit %d/%d done' % (i + 1, K_scipy))
@@ -307,83 +217,70 @@ def main():
     # ---- scattering joint fit (flags 11011, log10 tau) ----------------
     # full north-star scale: all nsub subints in ONE scanned dispatch on
     # device-resident data (r02 timed a 335 MB host->device transfer
-    # inside this stage and read 0.726 fits/s; the kernel itself runs
-    # at ~100 fits/s once the data lives on device)
+    # inside this stage and read 0.726 fits/s; r04's block_until_ready
+    # read 0.002 s for the whole batch — see bench_common.materialize)
     scat_B = nsub if on_accel else min(nsub, 32)  # CPU: smoke scale
-    tau_inj = 3e-3  # rot at nu0
-    from pulseportraiture_tpu.ops.scattering import (scattering_portrait_FT,
-                                                     scattering_times)
-    # built fully on device: the axon tunnel cannot transfer complex
-    # buffers to host (config.host_array), so keep the spectra there
-    taus_chan = scattering_times(tau_inj, -4.0, jnp.asarray(freqs), nu0)
-    spFT = scattering_portrait_FT(taus_chan, nbin)
-    scat_model = jnp.fft.irfft(spFT * jnp.fft.rfft(model, axis=-1),
-                               nbin, axis=-1).astype(dtype)
     del data_all  # free the main-config batch before building this one
-
-    def make_scat_block(i0, i1, key):
-        ph = jnp.asarray(phis_inj[i0:i1])
-        dm = jnp.asarray(dDMs_inj[i0:i1])
-        base = jax.vmap(
-            lambda p, d: rotate_data(scat_model, -p, -d, P0, freqs_j,
-                                     nu0))(ph, dm)
-        return (base + noise * jax.random.normal(key, base.shape,
-                                                 dtype)).astype(dtype)
-
-    skeys = jax.random.split(jax.random.key(3),
-                             (scat_B + scan - 1) // scan)
-    blocks = []
-    for ci, i0 in enumerate(range(0, scat_B, scan)):
-        blocks.append(make_scat_block(i0, min(i0 + scan, scat_B),
-                                      skeys[ci]))
-    scat_data = jnp.concatenate(blocks, axis=0)
-    del blocks
-    jax.block_until_ready(scat_data)
-    scat_init = np.zeros((scat_B, 5))
-    scat_init[:, 0] = phis_inj[:scat_B]
-    scat_init[:, 1] = dDMs_inj[:scat_B]
-    scat_init[:, 3] = np.log10(tau_inj * 1.5)
-    scat_init[:, 4] = -4.0
-
-    nus_pin_s = np.tile([nu0, nu0, nu0], (scat_B, 1))
-
-    def scat_fit():
-        # full f64 (hybrid pair path covers the scattering chain too);
-        # f32 storage, per-chunk in-scan cast as in the main config
-        return fit_portrait_full_batch(
-            scat_data, model64_dev, scat_init, Ps[:scat_B], freqs_j,
-            errs=errs[:scat_B], fit_flags=(1, 1, 0, 1, 1),
-            nu_fits=nus_pin_s,
-            nu_outs=(nus_pin_s[:, 0], nus_pin_s[:, 1], nus_pin_s[:, 2]),
-            log10_tau=True, max_iter=30, kmax=KMAX, scan_size=scan,
-            cast=fit_dtype, polish_iter=6)
+    scat_data = ns.scat_data(scat_B)
 
     _stage('scattering fit: compiling')
-    jax.block_until_ready(scat_fit().phi)  # compile
-    scat_dur, sout = _timed_passes(scat_fit,
-                                   lambda o: jax.block_until_ready(o.phi),
-                                   'scattering')
-    tau_fit = np.median(10 ** np.asarray(sout.tau))
+    materialize(ns.fit_scat(scat_data, scat_B).phi)  # compile
+    scat_dur, sout = timed_passes(lambda: ns.fit_scat(scat_data, scat_B),
+                                  lambda o: materialize(o.phi),
+                                  'scattering')
+    tau_fit = np.median(10 ** materialize(sout.tau))
+
+    # scattering parity: the coarse-harmonic f32 stage + capped polish
+    # vs the CPU f64 exact-spectra oracle, pinned references, same data
+    K_scat = min(8, scat_B)
+    s_init = ns.scat_init(scat_B)[:K_scat]
+    s_nus = ns.nus_pin(K_scat)
+
+    def pinned_scat(data, dtype_sel, kmax, cast=None, polish_iter=None,
+                    coarse_kmax=None):
+        return fit_portrait_full_batch(
+            jnp.asarray(data, dtype_sel), model64_dev, s_init,
+            Ps[:K_scat], freqs_j, errs=errs[:K_scat],
+            fit_flags=(1, 1, 0, 1, 1), nu_fits=s_nus,
+            nu_outs=(s_nus[:, 0], s_nus[:, 1], s_nus[:, 2]),
+            log10_tau=True, max_iter=30 if cast is not None else 50,
+            kmax=kmax, cast=cast, polish_iter=polish_iter,
+            coarse_kmax=coarse_kmax)
+
+    _stage('parity: device pinned scattering fit (timed path)')
+    sdev = pinned_scat(scat_data[:K_scat], ns.dtype, KMAX,
+                       cast=fit_dtype, polish_iter=POLISH_ITER,
+                       coarse_kmax=SCAT_COARSE_KMAX)
+    sdev_phi = materialize(sdev.phi)
+    _stage('parity: CPU f64 scattering oracle')
+    sdata_np = np.asarray(scat_data[:K_scat], np.float64)
+    with jax.default_device(cpu_dev):
+        scpu = pinned_scat(sdata_np, jnp.float64, nbin // 2 + 1)
+        scpu_phi = np.asarray(scpu.phi)
+    sdphi = (sdev_phi - scpu_phi + 0.5) % 1.0 - 0.5
+    parity_scat_ns = float(np.max(np.abs(sdphi)) * P0 * 1e9)
 
     # ---- IPTA sweep: 20 pulsars x 10 epochs (sharded path) ------------
+    from pulseportraiture_tpu.fit.portrait import model_kmax
+    from pulseportraiture_tpu.ops.fourier import get_bin_centers
+    from pulseportraiture_tpu.ops.profiles import gen_gaussian_portrait
     from pulseportraiture_tpu.parallel.sharded_fit import ipta_sweep_fit
 
     np_, ne, inchan, inbin = 20, 10, 128, 1024
-    i_model_params = model_params.astype(np.float64)
     i_freqs = np.linspace(1300.0, 1700.0, inchan) + 400.0 / inchan / 2
     i_phases = np.asarray(get_bin_centers(inbin))
     i_model = np.asarray(gen_gaussian_portrait(
-        "000", i_model_params, -4.0, i_phases, i_freqs, 1500.0))
+        "000", MODEL_PARAMS, -4.0, i_phases, i_freqs, 1500.0))
     i_rng = np.random.default_rng(2)
     i_data = (np.broadcast_to(i_model, (np_ * ne, inchan, inbin))
-              + i_rng.normal(0, noise, (np_ * ne, inchan, inbin))) \
+              + i_rng.normal(0, NOISE, (np_ * ne, inchan, inbin))) \
         .astype(np.float32 if on_accel else np.float64)
 
     i_kmax = model_kmax(i_model)
-    i_data_dev = jnp.asarray(i_data, dtype)
-    i_model_dev = jnp.asarray(i_model, dtype)
+    i_data_dev = jnp.asarray(i_data, ns.dtype)
+    i_model_dev = jnp.asarray(i_model, ns.dtype)
     i_freqs_dev = jnp.asarray(i_freqs)
-    i_errs = np.full((np_ * ne, inchan), noise)
+    i_errs = np.full((np_ * ne, inchan), NOISE)
 
     def ipta_run():
         return ipta_sweep_fit(
@@ -392,10 +289,10 @@ def main():
             log10_tau=False, max_iter=20, kmax=i_kmax)
 
     _stage('IPTA sweep: compiling')
-    jax.block_until_ready(ipta_run().phi)  # compile
-    ipta_dur, iout = _timed_passes(ipta_run,
-                                   lambda o: jax.block_until_ready(o.phi),
-                                   'IPTA sweep')
+    materialize(ipta_run().phi)  # compile
+    ipta_dur, iout = timed_passes(ipta_run,
+                                  lambda o: materialize(o.phi),
+                                  'IPTA sweep')
 
     # ---- ppalign batch (BASELINE '500 homogeneous archives', scaled) --
     # 100 archives exercises the streaming-block host-memory bound
@@ -432,10 +329,11 @@ def main():
             "parity_cpu_f64_max_ns": round(parity_cpu_ns, 4),
             "parity_cpu_f64_max_dDM": round(float(np.max(np.abs(
                 dev_DM - cpu_DM))), 9),
+            "parity_scat_cpu_f64_max_ns": round(parity_scat_ns, 4),
             "scat_fits_per_sec": round(scat_B / scat_dur, 3),
             "scat_config": f"{scat_B}x{nchan}x{nbin}",
             "scat_duration_sec": round(scat_dur, 3),
-            "scat_tau_rel_err": round(abs(tau_fit - tau_inj) / tau_inj,
+            "scat_tau_rel_err": round(abs(tau_fit - TAU_INJ) / TAU_INJ,
                                       4),
             "ipta_fits_per_sec": round(np_ * ne / ipta_dur, 3),
             "ipta_config": f"{np_}x{ne}x{inchan}x{inbin}",

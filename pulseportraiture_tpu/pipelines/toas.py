@@ -1017,6 +1017,11 @@ class GetTOAs:
         this framework — the native equivalent is
         ``get_narrowband_TOAs``).  Results accumulate (as TOA-line
         strings per archive) on self.psrchive_toas.
+
+        NOTE: unexercised in this environment — no psrchive install
+        exists here, so tests cover only the RuntimeError gate
+        (tests/test_pipeline_toas.py); the pat-driving body has never
+        run against real bindings.
         """
         try:
             import psrchive as pr

@@ -1,0 +1,186 @@
+"""Capture committable performance evidence for PERF.md.
+
+Lowers the exact north-star fit programs (phase+DM and joint
+scattering, bench.py shapes) and records, for each:
+
+* XLA cost analysis (flops / transcendentals / bytes accessed) from the
+  compiled executable when the backend exposes it, else from the
+  lowered module;
+* an operator histogram of the optimized HLO (trig / f64 arithmetic /
+  fusion counts) when retrievable, else of the client-side StableHLO;
+* best-of-N measured wall time, turning the counts into achieved
+  FLOP/s, transcendental/s and HBM bytes/s against v5e peaks.
+
+Writes JSON to stdout (redirect into tools/perf_probe_out.json); stage
+progress goes to stderr.  Run on the TPU:  python tools/perf_probe.py
+A CPU run (JAX_PLATFORMS=cpu) produces the same structure at smoke
+scale for pipeline testing.
+"""
+
+import json
+import os
+import re
+import sys
+import time
+
+import numpy as np
+
+_T0 = time.time()
+
+
+def _stage(msg):
+    print("[probe %7.1fs] %s" % (time.time() - _T0, msg),
+          file=sys.stderr, flush=True)
+
+
+def _histogram(text):
+    """Operator histogram of an HLO/StableHLO module, split by dtype.
+
+    Matches both '%x = f64[...] multiply(...)' (optimized HLO, with or
+    without layout braces) and 'stablehlo.multiply ... tensor<..xf64>'.
+    """
+    counts = {}
+    for m in re.finditer(
+            r"=\s+\(?(pred|s8|s16|s32|s64|u8|u16|u32|u64|bf16|f16|f32|"
+            r"f64|c64|c128)\[[0-9,]*\](?:\{[^}]*\})?\s+([a-z][a-z0-9\-]*)"
+            r"[\.\(]", text):
+        dtype, op = m.group(1), m.group(2)
+        counts["%s:%s" % (op, dtype)] = counts.get(
+            "%s:%s" % (op, dtype), 0) + 1
+    for m in re.finditer(r"stablehlo\.([a-z_]+)\s.*?:.*?tensor<[0-9x]*"
+                         r"([a-z0-9]+)>", text):
+        op, dtype = m.group(1), m.group(2)
+        counts["%s:%s" % (op, dtype)] = counts.get(
+            "%s:%s" % (op, dtype), 0) + 1
+    return counts
+
+
+def _evidence(name, fn, args, n_time=2, trace_dir=None):
+    import jax
+
+    out = {"name": name}
+    _stage("%s: lowering" % name)
+    lowered = jax.jit(fn).lower(*args)
+    _stage("%s: compiling (minutes on the TPU tunnel, cached after)"
+           % name)
+    compiled = lowered.compile()
+    _stage("%s: compiled" % name)
+    ca = None
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+    except Exception as e:
+        out["compiled_cost_analysis_error"] = str(e)
+    if not ca:
+        try:
+            ca = lowered.cost_analysis()
+        except Exception as e:
+            out["lowered_cost_analysis_error"] = str(e)
+    if ca:
+        out["cost_analysis"] = {
+            k: float(v) for k, v in ca.items()
+            if isinstance(v, (int, float)) and (
+                "flops" in k or "transcendental" in k or "bytes" in k
+                or "optimal" in k)}
+    hlo = None
+    try:
+        hlo = compiled.as_text()
+        out["hlo_kind"] = "optimized_hlo"
+    except Exception:
+        try:
+            hlo = lowered.as_text()
+            out["hlo_kind"] = "stablehlo"
+        except Exception as e:
+            out["hlo_error"] = str(e)
+    if hlo:
+        hist = _histogram(hlo)
+        out["op_histogram_top"] = dict(sorted(
+            hist.items(), key=lambda kv: -kv[1])[:40])
+        trig = {k: v for k, v in hist.items()
+                if k.split(":")[0] in ("cosine", "sine", "tanh",
+                                       "exponential", "log", "atan2",
+                                       "power", "rsqrt", "sqrt")}
+        out["transcendental_ops"] = trig
+        out["f64_op_count"] = sum(v for k, v in hist.items()
+                                  if k.endswith(":f64"))
+        out["f32_op_count"] = sum(v for k, v in hist.items()
+                                  if k.endswith(":f32"))
+        out["hlo_bytes"] = len(hlo)
+    # timed passes; materialize a result leaf on the host each pass —
+    # block_until_ready alone has been observed to return early for
+    # some programs through the remote-device tunnel
+    best = float("inf")
+    for i in range(n_time):
+        t0 = time.time()
+        r = compiled(*args)
+        phi_host = np.asarray(jax.device_get(
+            r.phi if hasattr(r, "phi") else jax.tree_util.tree_leaves(
+                r)[0]))
+        dur = time.time() - t0
+        best = min(best, dur)
+        _stage("%s: pass %d in %.2fs (phi finite: %s)"
+               % (name, i + 1, dur, bool(np.isfinite(phi_host).all())))
+    out["best_seconds"] = best
+    out["output_finite"] = bool(np.isfinite(phi_host).all())
+    if hasattr(r, "nfeval"):
+        out["median_nfeval"] = float(np.median(np.asarray(
+            jax.device_get(r.nfeval))))
+    if trace_dir:  # device profile of one more pass (may be
+        # unsupported through the remote tunnel; recorded either way)
+        try:
+            with jax.profiler.trace(os.path.join(trace_dir, name)):
+                jax.device_get(jax.tree_util.tree_leaves(
+                    compiled(*args))[0])
+            out["profiler_trace"] = os.path.join(".jax_profile", name)
+        except Exception as e:
+            out["profiler_trace_error"] = str(e)
+    if "cost_analysis" in out:
+        c = out["cost_analysis"]
+        if c.get("flops"):
+            out["achieved_gflops"] = c["flops"] / best / 1e9
+        if c.get("transcendentals"):
+            out["achieved_gtranscendentals"] = \
+                c["transcendentals"] / best / 1e9
+        if c.get("bytes accessed"):
+            out["achieved_gbytes_per_s"] = c["bytes accessed"] / best / 1e9
+    return out
+
+
+def main():
+    import jax
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench_common import NorthStar, enable_compile_cache
+
+    enable_compile_cache(jax)
+    ns = NorthStar(jax)
+    platform = jax.devices()[0].platform
+
+    data_all = ns.main_data()
+    _stage("main data on device")
+    trace_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_profile")
+    results = {"platform": platform,
+               "config": {"nsub": ns.nsub, "nchan": ns.nchan,
+                          "nbin": ns.nbin, "scan": ns.scan,
+                          "kmax": int(ns.kmax)},
+               "programs": []}
+    # the two programs are bench_common.NorthStar.fit_main/fit_scat —
+    # the literally-same callables bench.py times
+    results["programs"].append(_evidence("phase_dm", ns.fit_main,
+                                         (data_all,),
+                                         trace_dir=trace_dir))
+    del data_all
+    scat_data = ns.scat_data()
+    _stage("scat data on device")
+    results["programs"].append(_evidence("scattering", ns.fit_scat,
+                                         (scat_data,),
+                                         trace_dir=trace_dir))
+    print(json.dumps(results, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,45 @@
+"""Condense a jax.profiler Chrome trace into a committable op table.
+
+Usage: python tools/trace_summary.py .jax_profile/scattering > out.json
+Finds the newest vm.trace.json.gz under the given directory and emits
+the top device ops by total duration (host python frames excluded) —
+the artifact PERF.md's decomposition tables are built from.
+"""
+
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def summarize(trace_dir, top=40):
+    paths = sorted(glob.glob(os.path.join(
+        trace_dir, "**", "*.trace.json.gz"), recursive=True))
+    if not paths:
+        raise SystemExit(f"no trace under {trace_dir}")
+    path = paths[-1]
+    d = json.load(gzip.open(path))
+    tot = collections.Counter()
+    for e in d.get("traceEvents", []):
+        if e.get("ph") == "X" and "dur" in e:
+            nm = e.get("name", "")
+            if nm.startswith("$") or "np.asarray" in nm:
+                continue  # host python frames
+            tot[nm] += e["dur"]
+    return {
+        "trace": os.path.relpath(path, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))),
+        "note": "durations are summed per event name over NESTED "
+                "Chrome-trace spans: program-level (jit_*) and "
+                "while-loop rows CONTAIN their child ops, so rows do "
+                "not partition device time and must not be added "
+                "across nesting levels",
+        "top_ops_seconds": {nm: round(us / 1e6, 4)
+                            for nm, us in tot.most_common(top)},
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(summarize(sys.argv[1]), indent=1))
