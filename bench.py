@@ -42,9 +42,9 @@ import time
 
 import numpy as np
 
-from bench_common import (MODEL_PARAMS, NOISE, P0, POLISH_ITER,
-                          SCAT_COARSE_KMAX, TAU_INJ, NorthStar,
-                          enable_compile_cache, materialize,
+from bench_common import (COARSE_ITER, MODEL_PARAMS, NOISE, P0,
+                          POLISH_ITER, SCAT_COARSE_KMAX, TAU_INJ,
+                          NorthStar, enable_compile_cache, materialize,
                           stage as _stage, timed_passes)
 
 # kill -USR1 <pid> dumps all Python stacks to stderr (hang diagnosis)
@@ -169,7 +169,7 @@ def main():
     init_par[:, 1] = dDMs_inj[:K_cpu]
 
     def pinned_fit(data, nsel, dtype_sel, kmax=None, cast=None,
-                   polish_iter=None):
+                   polish_iter=None, coarse_iter=None):
         return fit_portrait_full_batch(
             jnp.asarray(data, dtype_sel), model64_dev,
             init_par[:nsel], Ps[:nsel], freqs_j,
@@ -178,11 +178,13 @@ def main():
             nu_outs=(nus_pin[:nsel, 0], nus_pin[:nsel, 1],
                      nus_pin[:nsel, 2]),
             log10_tau=False, max_iter=30 if cast is not None else 50,
-            kmax=kmax, cast=cast, polish_iter=polish_iter)
+            kmax=kmax, cast=cast, polish_iter=polish_iter,
+            coarse_iter=coarse_iter)
 
     _stage('parity: device pinned fit (timed path)')
     dev_out = pinned_fit(data_par, K_cpu, ns.dtype, kmax=KMAX,
-                         cast=fit_dtype, polish_iter=POLISH_ITER)
+                         cast=fit_dtype, polish_iter=POLISH_ITER,
+                         coarse_iter=COARSE_ITER)
     dev_phi = materialize(dev_out.phi)
     dev_DM = materialize(dev_out.DM)
     # CPU f64 oracle: identical data/inits through the same kernel at
@@ -237,7 +239,7 @@ def main():
     s_nus = ns.nus_pin(K_scat)
 
     def pinned_scat(data, dtype_sel, kmax, cast=None, polish_iter=None,
-                    coarse_kmax=None):
+                    coarse_kmax=None, coarse_iter=None):
         return fit_portrait_full_batch(
             jnp.asarray(data, dtype_sel), model64_dev, s_init,
             Ps[:K_scat], freqs_j, errs=errs[:K_scat],
@@ -245,12 +247,13 @@ def main():
             nu_outs=(s_nus[:, 0], s_nus[:, 1], s_nus[:, 2]),
             log10_tau=True, max_iter=30 if cast is not None else 50,
             kmax=kmax, cast=cast, polish_iter=polish_iter,
-            coarse_kmax=coarse_kmax)
+            coarse_kmax=coarse_kmax, coarse_iter=coarse_iter)
 
     _stage('parity: device pinned scattering fit (timed path)')
     sdev = pinned_scat(scat_data[:K_scat], ns.dtype, KMAX,
                        cast=fit_dtype, polish_iter=POLISH_ITER,
-                       coarse_kmax=SCAT_COARSE_KMAX)
+                       coarse_kmax=SCAT_COARSE_KMAX,
+                       coarse_iter=COARSE_ITER)
     sdev_phi = materialize(sdev.phi)
     _stage('parity: CPU f64 scattering oracle')
     sdata_np = np.asarray(scat_data[:K_scat], np.float64)

@@ -65,6 +65,7 @@ P0 = 0.005
 NOISE = 0.05
 TAU_INJ = 3e-3  # scattering config: injected tau [rot] at nu0
 SCAT_COARSE_KMAX = 64  # f32-stage harmonics for the scattering fit
+COARSE_ITER = 12  # f32-stage iteration cap (lockstep vmap lanes)
 POLISH_ITER = 6
 
 
@@ -188,7 +189,8 @@ class NorthStar:
             data, self.model64_dev, None, self.Ps, self.freqs_j,
             errs=self.errs, fit_flags=(1, 1, 0, 0, 0), log10_tau=False,
             max_iter=30, kmax=self.kmax, scan_size=self.scan,
-            cast=self.fit_dtype, polish_iter=POLISH_ITER)
+            cast=self.fit_dtype, polish_iter=POLISH_ITER,
+            coarse_iter=COARSE_ITER)
 
     def fit_scat(self, data, scat_B=None):
         from pulseportraiture_tpu.fit.portrait import fit_portrait_full_batch
@@ -202,4 +204,4 @@ class NorthStar:
             nu_outs=(nus[:, 0], nus[:, 1], nus[:, 2]), log10_tau=True,
             max_iter=30, kmax=self.kmax, scan_size=self.scan,
             cast=self.fit_dtype, polish_iter=POLISH_ITER,
-            coarse_kmax=SCAT_COARSE_KMAX)
+            coarse_kmax=SCAT_COARSE_KMAX, coarse_iter=COARSE_ITER)

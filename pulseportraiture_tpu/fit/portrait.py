@@ -624,16 +624,21 @@ def _scat_hint(fit_flags, init_params, log10_tau):
 
 
 @partial(jax.jit, static_argnames=("fit_flags", "log10_tau", "nbin",
-                                   "max_iter", "scat"))
+                                   "max_iter", "scat", "coarse"))
 def _solve(init_params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
            nu_tau, fit_flags, log10_tau, nbin, lo, hi, max_iter=50,
-           scat=None):
+           scat=None, coarse=False):
     """Bounded Levenberg-damped Newton minimization of the objective.
 
     Per-fit state advances in lockstep under vmap; convergence is
     tracked with masks, mapping termination reasons onto the reference's
     TNC-style return codes (config.RCSTRINGS): 1 = f converged,
     2 = step converged, 3 = max iterations.
+
+    ``coarse=True`` marks the hybrid driver's f32 stage: the objective
+    f-tolerance relaxes to the f32 plateau (~32 eps_f32 relative),
+    since an f64-scale ftol is unreachable in f32 arithmetic and a
+    full-precision polish follows.
     """
     flags = jnp.asarray(fit_flags, dtype=jnp.result_type(init_params,
                                                          jnp.float64))
@@ -654,7 +659,10 @@ def _solve(init_params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
                  done=jnp.asarray(False), it=jnp.asarray(0),
                  nfev=jnp.asarray(1), rc=jnp.asarray(3))
 
-    ftol = 1e-12
+    # NOTE the objective's dtype cannot mark the f32 stage: f64 errs
+    # promote C to f64 even over complex64 spectra, so the stage is
+    # flagged explicitly (static ``coarse``) by the hybrid driver
+    ftol = 32.0 * float(np.finfo(np.float32).eps) if coarse else 1e-12
     xtol = 1e-12
     mu_max = 1e12
 
@@ -738,7 +746,7 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
                       log10_tau=True, option=0, max_iter=50, is_toa=True,
                       quiet=True, scat=None, pair=None, kmax=None,
                       polish_iter=None, coarse_kmax=None,
-                      data_spectra="exact"):
+                      coarse_iter=None, data_spectra="exact"):
     """Fit (phi, DM, GM, tau, alpha) between one data and model portrait.
 
     Behavioral equivalent of /root/reference/pptoaslib.py:928-1096,
@@ -877,10 +885,20 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
         if coarse_kmax is not None and coarse_kmax < cross32.shape[-1]:
             cross32 = cross32[..., :coarse_kmax]
             abs_m2_32 = abs_m2_32[..., :coarse_kmax]
+        # coarse_iter caps the f32 stage separately from max_iter:
+        # under vmap the while_loop runs every lane to the slowest
+        # lane's trip count, and an f32 stage that cannot meet f64
+        # tolerances otherwise burns its full budget in lockstep; the
+        # f64 polish only needs the coarse stage inside its Newton
+        # basin (a max_iter 30 -> 15 -> 10 sweep on the north-star
+        # scattering config measured no added error at the shipped
+        # in-bench parity figure, 0.036 ns — PERF.md; bench ships
+        # coarse_iter=12, bench_common.COARSE_ITER)
         sol32 = _solve(jnp.asarray(init_params, dtype=jnp.float64),
                        cross32, abs_m2_32, inv_err2, freqs, P, nu_fit_DM,
                        nu_fit_GM, nu_fit_tau, flags, log10_tau, nbin, lo,
-                       hi, max_iter=max_iter, scat=scat)
+                       hi, max_iter=max_iter if coarse_iter is None
+                       else coarse_iter, scat=scat, coarse=True)
         # polish budget: convergence typically takes 2-3 Newton steps
         # from the f32 plateau, but under vmap the while_loop runs to
         # the SLOWEST lane — polish_iter caps the expensive f64 stage
@@ -1001,12 +1019,12 @@ def _seed_phases(data_ports, model_ports, errs_b, weights_b, cast):
                                    "max_iter", "nu_outs_mask", "scat",
                                    "pair", "kmax", "scan_size", "cast",
                                    "seed", "polish_iter", "coarse_kmax",
-                                   "data_spectra"))
+                                   "coarse_iter", "data_spectra"))
 def _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b, errs_b,
                 weights_b, nu_fits_b, nu_outs_b, nu_outs_mask, fit_flags,
                 bounds, log10_tau, max_iter, scat, pair, kmax, scan_size,
                 cast, seed=False, polish_iter=None, coarse_kmax=None,
-                data_spectra="exact"):
+                coarse_iter=None, data_spectra="exact"):
     # a 2-D model is shared by the whole batch (vmap in_axes=None /
     # scan-body closure) — it is never materialized at [B, nchan, nbin]
     shared_model = model_ports.ndim == 2
@@ -1036,6 +1054,7 @@ def _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b, errs_b,
                                  scat=scat, pair=pair, kmax=kmax,
                                  polish_iter=polish_iter,
                                  coarse_kmax=coarse_kmax,
+                                 coarse_iter=coarse_iter,
                                  data_spectra=data_spectra)
 
     vfit = jax.vmap(one, in_axes=(0, None if shared_model else 0,
@@ -1078,7 +1097,7 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
                             kmax=None, scan_size=None, cast=None,
                             polish_iter=None, seed=None,
                             scat_hint=None, coarse_kmax=None,
-                            data_spectra=None):
+                            coarse_iter=None, data_spectra=None):
     """vmapped+jitted fit over a batch of subints: data [B, nchan, nbin].
 
     model_ports/freqs broadcast over the batch; returns a DataBunch of
@@ -1233,6 +1252,8 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
                       else int(polish_iter),
                       coarse_kmax=None if coarse_kmax is None
                       else int(coarse_kmax),
+                      coarse_iter=None if coarse_iter is None
+                      else int(coarse_iter),
                       data_spectra=data_spectra_t)
     if data_ports.shape[0] != B:  # drop scan padding
         out = jax.tree_util.tree_map(lambda a: a[:B], out)
