@@ -66,7 +66,11 @@ NOISE = 0.05
 TAU_INJ = 3e-3  # scattering config: injected tau [rot] at nu0
 SCAT_COARSE_KMAX = 64  # f32-stage harmonics for the scattering fit
 COARSE_ITER = 12  # f32-stage iteration cap (lockstep vmap lanes)
-POLISH_ITER = 6
+# f64 polish budget: Newton needs 2-3 steps from the coarse plateau;
+# an on-chip 6 -> 4 -> 3 sweep measured 1.39 -> 1.10 -> 0.97 s on the
+# scattering config at +0.0037 / +0.0053 ns vs polish=6 (in-bench
+# parity stages re-verify against the CPU-f64 oracle on every run)
+POLISH_ITER = 4
 
 
 def shapes(on_accel):
