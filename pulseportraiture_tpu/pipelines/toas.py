@@ -286,7 +286,8 @@ class GetTOAs:
                  print_parangle=False, add_instrumental_response=False,
                  addtnl_toa_flags={}, method="trust-ncg", bounds=None,
                  nu_fits=None, show_plot=False, quiet=None,
-                 max_iter=50, checkpoint=None):
+                 max_iter=50, checkpoint=None, polish_iter=None,
+                 coarse_iter=None, coarse_kmax=None):
         """Measure TOAs; results accumulate on self (reference-named).
 
         Equivalent of /root/reference/pptoas.py:150-738; ``method`` is
@@ -298,6 +299,12 @@ class GetTOAs:
         multi-archive run loses all TOAs — SURVEY.md §5.3).  On entry,
         archives already present in the checkpoint are skipped, so a
         killed run resumes where it stopped.
+
+        ``polish_iter`` / ``coarse_iter`` / ``coarse_kmax``: optional
+        speed knobs for the hybrid f32+f64 fit (cap the f64 polish /
+        the f32 stage's iterations / its harmonics).  Defaults keep
+        exact behavior; the sub-0.01-ns trade each knob buys on the
+        bench configs is measured in PERF.md (bench ships 4/12/64).
         """
         if quiet is None:
             quiet = self.quiet
@@ -476,7 +483,9 @@ class GetTOAs:
                         for col in nu_outs_b),
                     bounds=bounds_eff, log10_tau=log10_tau,
                     max_iter=max_iter,
-                    scan_size=auto_scan_size(len(sel)))
+                    scan_size=auto_scan_size(len(sel)),
+                    polish_iter=polish_iter, coarse_iter=coarse_iter,
+                    coarse_kmax=coarse_kmax)
                 for j, i in enumerate(idxs):
                     results[i] = {key: np.asarray(val)[j]
                                   for key, val in out.items()}
@@ -729,7 +738,8 @@ class GetTOAs:
                             add_instrumental_response=False,
                             addtnl_toa_flags={}, method="trust-ncg",
                             bounds=None, show_plot=False, quiet=None,
-                            max_iter=50):
+                            max_iter=50, polish_iter=None,
+                            coarse_iter=None, coarse_kmax=None):
         """Measure per-channel (narrowband) TOAs.
 
         Equivalent of /root/reference/pptoas.py:740-1125, re-designed as
@@ -854,7 +864,9 @@ class GetTOAs:
                     bounds=bounds_eff, log10_tau=log10_tau,
                     max_iter=max_iter,
                     scan_size=auto_scan_size(len(profs),
-                                             profiles=True))
+                                             profiles=True),
+                    polish_iter=polish_iter, coarse_iter=coarse_iter,
+                    coarse_kmax=coarse_kmax)
                 phis_fit = np.asarray(out["phi"])
                 phi_errs_fit = np.asarray(out["phi_err"])
                 taus_fit = np.asarray(out["tau"])

@@ -434,3 +434,25 @@ def test_checkpoint_legacy_markerless_accepts_all_but_trailing(tmp_path):
     # and the upgraded file round-trips through the marker-format parser
     done2 = _resume_checkpoint(ckpt)
     assert done2 == done
+
+
+@pytest.mark.slow
+def test_get_toas_speed_knobs_match_default(fake_archives):
+    """polish_iter/coarse_iter/coarse_kmax pass through get_TOAs to
+    the kernel without breaking the fit.  NOTE: on this CPU lane the
+    backend supports complex128, so the hybrid f32+f64 path the knobs
+    act on is not selected and results are bit-identical — this test
+    guards the plumbing; the knobs' accuracy trade on the hybrid path
+    is covered by test_fit_portrait (polish cap parity) and bench.py's
+    in-bench TPU parity stages (PERF.md)."""
+    files, phases, dDMs, gmodel = fake_archives
+    gt0 = GetTOAs(files[:1], gmodel, quiet=True)
+    gt0.get_TOAs(bary=False)
+    gt1 = GetTOAs(files[:1], gmodel, quiet=True)
+    gt1.get_TOAs(bary=False, polish_iter=4, coarse_iter=12,
+                 coarse_kmax=64)
+    p0, p1 = np.asarray(gt0.phis[0]), np.asarray(gt1.phis[0])
+    e0 = np.asarray(gt0.phi_errs[0])
+    assert np.abs(((p1 - p0 + 0.5) % 1.0) - 0.5).max() < 0.05 * e0.min()
+    np.testing.assert_allclose(np.asarray(gt1.DMs[0]),
+                               np.asarray(gt0.DMs[0]), atol=1e-6)
