@@ -753,6 +753,11 @@ class GetTOAs:
         portrait through the 5-parameter kernel with fit_flags
         (phi, tau) so the scattering fit is real.  alpha and DM/GM are
         unidentifiable from one channel and stay fixed.
+
+        ``polish_iter`` / ``coarse_iter`` / ``coarse_kmax``: speed
+        knobs for the 5-parameter kernel (see get_TOAs / PERF.md) —
+        they apply ONLY to the fit_scat=True path; the default
+        phase-only mode runs the FFTFIT kernel, which never sees them.
         """
         if quiet is None:
             quiet = self.quiet

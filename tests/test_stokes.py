@@ -145,3 +145,25 @@ def test_ppalign_p_averages_coherence_archives(fourpol_files, tmp_path):
     assert peak > 10 * 0.02  # profile survives averaging (noise 0.02)
     for ipol in range(1, 4):
         assert abs(np.abs(aligned[ipol]).max() - peak) < 0.1 * peak
+
+
+@pytest.mark.slow
+def test_get_toas_on_fourpol_archives(fourpol_files):
+    """GetTOAs pscrunches 4-pol inputs internally: Coherence (AA+BB)
+    and Stokes (I) archives of the same data give the same TOAs."""
+    from pulseportraiture_tpu.pipelines.toas import GetTOAs
+    tmp, gmodel, stokes, coherence = fourpol_files
+
+    def phis(f):
+        gt = GetTOAs([f], gmodel, quiet=True)
+        gt.get_TOAs(bary=False, nu_refs=(1500.0, 1500.0))
+        return (np.asarray(gt.phis[0]), np.asarray(gt.phi_errs[0]),
+                np.asarray(gt.red_chi2s[0]))
+
+    ps, es, cs = phis(stokes[0])
+    pc, ec, cc = phis(coherence[0])
+    assert np.isfinite(ps).all() and np.isfinite(pc).all()
+    # same underlying data (modulo int16 re-quantization): same TOAs
+    dphi = np.abs((ps - pc + 0.5) % 1.0 - 0.5)
+    assert (dphi < 5 * np.hypot(es, ec)).all(), (ps, pc, es)
+    assert np.median(cs) < 3.0 and np.median(cc) < 3.0
