@@ -818,16 +818,22 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
         # matmul emulation it replaces is ~25% of device time.  The
         # model side (shared across the batch) stays exact.
         fast32 = data_spectra == "fast32"
-        sd_dtype = jnp.float32 if fast32 else jnp.float64
-        dS = jnp.asarray(data_port, sd_dtype)
+        # Sd's moments are computed in f64 even under fast32: the
+        # nbin*sum(x^2) - X0^2 subtraction cancels catastrophically in
+        # f32 when the data carry a large un-removed DC baseline, which
+        # would corrupt the reported chi2/red_chi2 (TOA phase is
+        # unaffected — Sd is a constant offset of the objective).  The
+        # cost is a handful of plain f64-pair reductions, negligible
+        # next to the DFT matmul fast32 exists to avoid.
+        dS = jnp.asarray(data_port, jnp.float64)
         X0 = jnp.sum(dS, axis=-1)
         Sd_chan = (nbin * jnp.sum(dS * dS, axis=-1) - X0 ** 2) / 2.0
         if nbin % 2 == 0:  # rFFT has a Nyquist bin only for even nbin
-            alt = jnp.asarray((-1.0) ** np.arange(nbin), sd_dtype)
+            alt = jnp.asarray((-1.0) ** np.arange(nbin), jnp.float64)
             Xny = jnp.sum(dS * alt, axis=-1)
             Sd_chan = Sd_chan + Xny ** 2 / 2.0
         Sd_chan = Sd_chan + (F0_fact ** 2) * X0 ** 2  # DC-policy term
-        Sd = jnp.sum(Sd_chan.astype(jnp.float64) * inv_err2)
+        Sd = jnp.sum(Sd_chan * inv_err2)
         if fast32:
             dc = jnp.fft.rfft(jnp.asarray(data_port, jnp.float32),
                               axis=-1)

@@ -146,13 +146,21 @@ def parse_polyco_text(text):
 
 
 def _cheby2d_eval(coeffs, x, y):
-    """sum_ij c_ij T_i(x) T_j(y), i=0/j=0 rows at half weight."""
+    """sum_ij c_ij T_i(x) T_j(y), i=0/j=0 rows at half weight.
+
+    Returns a true Python float for scalar (x, y) inputs — chebvander
+    promotes 0-d inputs to shape (1,), which would otherwise leak out
+    as a size-1 array (a hard error to float() under future NumPy).
+    """
     c = np.array(coeffs, dtype=np.float64)
     c[0, :] *= 0.5
     c[:, 0] *= 0.5
     Tx = np.polynomial.chebyshev.chebvander(np.asarray(x), c.shape[0] - 1)
     Ty = np.polynomial.chebyshev.chebvander(np.asarray(y), c.shape[1] - 1)
-    return np.einsum("...i,ij,...j->...", Tx, c, Ty)
+    out = np.einsum("...i,ij,...j->...", Tx, c, Ty)
+    if np.ndim(x) == 0 and np.ndim(y) == 0:
+        return out.reshape(()).item()
+    return out.reshape(np.broadcast_shapes(np.shape(x), np.shape(y)))
 
 
 class ChebyModel:
@@ -201,7 +209,14 @@ class ChebyModel:
                                                 dc.shape[1] - 1)
         dphase_dx = np.einsum("...i,ij,...j->...", Tx, dc, Ty)
         dx_dmjd = 2.0 / (self.mjd_end - self.mjd_start)
-        return dphase_dx * dx_dmjd / 86400.0
+        out = dphase_dx * dx_dmjd / 86400.0
+        # chebvander promotes 0-d inputs to (1,); hand scalars back as
+        # true scalars so float(period(...)) stays legal under future
+        # NumPy (see _cheby2d_eval)
+        if np.ndim(mjd) == 0 and np.ndim(freq) == 0:
+            return out.reshape(()).item()
+        return out.reshape(np.broadcast_shapes(np.shape(mjd),
+                                               np.shape(freq)))
 
 
 class ChebyModelSet:

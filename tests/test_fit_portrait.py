@@ -323,6 +323,58 @@ def test_pair_path_matches_complex128():
     assert abs(10 ** float(s_p.tau) - 3e-3) / 3e-3 < 0.1
 
 
+def test_fast32_chi2_survives_dc_baseline(rng):
+    """fast32's chi2 normalization (Sd) must not catastrophically cancel
+    on data with a large un-removed DC baseline: nbin*sum(x^2) - X0^2 in
+    f32 loses everything when DC >> signal.  Sd is computed in f64 even
+    under fast32; this pins red_chi2 agreement with the exact-f64 path
+    (ADVICE r4: fit/portrait.py Sd_chan)."""
+    B, dc = 4, 1000.0  # baseline ~1000x the pulse amplitude
+    model = make_model()
+    phis = rng.uniform(-0.1, 0.1, B)
+    datas = np.stack([
+        np.asarray(rotate_data(model, -phis[i], 0.0, P0, FREQS,
+                               np.mean(FREQS))) for i in range(B)])
+    datas = datas + rng.normal(0, 0.01, datas.shape) + dc
+    init = np.zeros((B, 5))
+    init[:, 0] = phis
+    kw = dict(errs=np.full((B, NCHAN), 0.01), fit_flags=(1, 1, 0, 0, 0),
+              log10_tau=False, max_iter=50)
+    exact = fp.fit_portrait_full_batch(datas, model[None], init, P0,
+                                       FREQS, **kw)
+    # f32 storage + cast=f64 auto-selects the fast32 data-spectra path
+    fast = fp.fit_portrait_full_batch(datas.astype(np.float32),
+                                      model[None].astype(np.float32),
+                                      init, P0, FREQS, cast=np.float64,
+                                      **kw)
+    # the f32 round-trip of DC-1000 data quantizes inputs at ~6e-5 abs;
+    # chi2 (sum over 16*256 bins at sigma=0.01) moves by O(1e-1) from
+    # that alone — the f32-Sd cancellation this guards against was O(1e6)
+    np.testing.assert_allclose(np.asarray(fast.red_chi2),
+                               np.asarray(exact.red_chi2), rtol=5e-3)
+    np.testing.assert_allclose(np.asarray(fast.phi), np.asarray(exact.phi),
+                               atol=5e-6)
+
+
+def test_t2pred_scalar_period():
+    """ChebyModel phase/freq_spin/period hand back true Python scalars
+    for scalar inputs (chebvander promotes 0-d to (1,); float(array)
+    is a hard error under future NumPy — ADVICE r4)."""
+    from pulseportraiture_tpu.io.polyco import ChebyModel, ChebyModelSet
+
+    m = ChebyModel(50000.0, 50001.0, 1000.0, 2000.0,
+                   np.arange(12.0).reshape(4, 3))
+    ms = ChebyModelSet([m])
+    for val in (m.phase(50000.5, 1500.0), m.freq_spin(50000.5, 1500.0),
+                ms.period(50000.5, 1500.0)):
+        assert np.ndim(val) == 0 and isinstance(val, float), type(val)
+    # array inputs still broadcast
+    ph = m.phase(np.full(3, 50000.5), 1500.0)
+    assert ph.shape == (3,)
+    assert np.allclose(ph, m.phase(50000.5, 1500.0))
+    assert ms.periods([50000.4, 50000.6], 1500.0).shape == (2,)
+
+
 @pytest.mark.slow
 def test_model_kmax_semantics():
     """Harmonic cutoff: small for clean compact templates, full for
