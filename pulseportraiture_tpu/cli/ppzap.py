@@ -43,7 +43,15 @@ def build_parser():
                    help="Output paz command file (appends). "
                         "[default=stdout]")
     p.add_argument("--modify", action="store_true",
-                   help="paz commands modify the original datafiles.")
+                   help="paz commands modify the original datafiles; "
+                        "with --apply, rewrite them in place.")
+    p.add_argument("--apply", action="store_true",
+                   help="Apply the zaps natively (no psrchive needed): "
+                        "zero the flagged channel weights and rewrite "
+                        "the archives with the built-in PSRFITS writer "
+                        "instead of emitting paz commands. Without "
+                        "--modify, writes '.zap' copies like paz -e "
+                        "zap.")
     p.add_argument("--hist", action="store_true",
                    help="Save a histogram of channel reduced-chi2 "
                         "values.")
@@ -56,9 +64,15 @@ def main(argv=None):
     if args.datafiles is None:
         build_parser().print_help()
         return 1
+    if args.apply and args.outfile is not None:
+        print("ppzap: --apply applies zaps natively and emits no paz "
+              "command file; -o/--outfile cannot be combined with it.",
+              file=sys.stderr)
+        return 1
 
     from ..io.archive import file_is_type, load_data, parse_metafile
-    from ..pipelines.zap import get_zap_channels, print_paz_cmds
+    from ..pipelines.zap import (apply_zaps, get_zap_channels,
+                                 print_paz_cmds)
 
     if args.modelfile is not None:
         from ..pipelines.toas import GetTOAs
@@ -70,9 +84,14 @@ def main(argv=None):
                                rchi2_threshold=args.rchi2_threshold,
                                iterate=True, show=False)
         ok_datafiles = [gt.datafiles[i] for i in gt.ok_idatafiles]
-        print_paz_cmds(ok_datafiles, gt.zap_channels,
+        if args.apply:
+            apply_zaps(ok_datafiles, gt.zap_channels,
                        all_subs=args.tscrunch, modify=args.modify,
-                       outfile=args.outfile, quiet=args.quiet)
+                       quiet=args.quiet)
+        else:
+            print_paz_cmds(ok_datafiles, gt.zap_channels,
+                           all_subs=args.tscrunch, modify=args.modify,
+                           outfile=args.outfile, quiet=args.quiet)
         nchan = sum(len(s) for arch in gt.channel_red_chi2s for s in arch)
         nzap = sum(len(s) for arch in gt.zap_channels for s in arch)
         if args.hist:
@@ -116,6 +135,11 @@ def main(argv=None):
                 if not args.quiet:
                     print("Cannot load_data(%s).  Skipping it."
                           % datafile)
+                # placeholder keeps zap_channels aligned with
+                # all_datafiles — apply_zaps/print_paz_cmds pair the
+                # lists by index, and a silent shift would zap the
+                # wrong archives
+                zap_channels.append([])
                 continue
             nchan += int(np.sum([len(ic) for ic in data.ok_ichans]))
             if args.norm is not None:
@@ -131,9 +155,14 @@ def main(argv=None):
             zaps = get_zap_channels(data, nstd=args.nstd)
             zap_channels.append(zaps)
             nzap += sum(len(s) for s in zaps)
-        print_paz_cmds(all_datafiles, zap_channels,
+        if args.apply:
+            apply_zaps(all_datafiles, zap_channels,
                        all_subs=args.tscrunch, modify=args.modify,
-                       outfile=args.outfile, quiet=args.quiet)
+                       quiet=args.quiet)
+        else:
+            print_paz_cmds(all_datafiles, zap_channels,
+                           all_subs=args.tscrunch, modify=args.modify,
+                           outfile=args.outfile, quiet=args.quiet)
     if not args.quiet and nchan:
         print("ppzap found %d channels to zap out of a total %d "
               "channels (=%.2f%%) in %s."

@@ -1198,15 +1198,19 @@ class GetTOAs:
         chi2 (> rchi2_threshold or NaN) and channel S/N below the
         effective per-channel threshold (SNR_threshold^2/nchx)^0.5,
         iterating the S/N cut to convergence.  Fills
-        self.channel_red_chi2s and self.zap_channels.  Equivalent of
+        self.channel_red_chi2s and self.zap_channels — both hold one
+        entry per ARCHIVE subint (position == absolute subint index,
+        empty for subints the fit skipped) so paz ``-w`` emission and
+        ``apply_zaps`` address the right subints.  Equivalent of
         /root/reference/pptoas.py:1201-1278."""
         from ..ops.stats import get_red_chi2
 
         self.channel_red_chi2s = []
         self.zap_channels = []
         for ifile in range(len(self.order)):
-            channel_red_chi2s = []
-            zap_channels = []
+            nsub_arch = len(self.Ps[ifile])
+            channel_red_chi2s = [[] for _ in range(nsub_arch)]
+            zap_channels = [[] for _ in range(nsub_arch)]
             for j, isub in enumerate(self.ok_isubs[ifile]):
                 port, model, ok_ichans, freqs, noise_stds = \
                     self.return_fit(ifile, isub)
@@ -1238,8 +1242,8 @@ class GetTOAs:
                                 bad_ichans.append(ok_ichan)
                         added_new = bool(len(bad_ichans) - old_len)
                         old_len = len(bad_ichans)
-                channel_red_chi2s.append(red_chi2s)
-                zap_channels.append(bad_ichans)
+                channel_red_chi2s[int(isub)] = red_chi2s
+                zap_channels[int(isub)] = bad_ichans
             self.channel_red_chi2s.append(channel_red_chi2s)
             self.zap_channels.append(zap_channels)
         return self.zap_channels

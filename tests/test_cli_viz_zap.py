@@ -8,7 +8,8 @@ import pytest
 from pulseportraiture_tpu.io.archive import load_data, make_fake_pulsar
 from pulseportraiture_tpu.io.gmodel import write_model
 from pulseportraiture_tpu.io.splmodel import read_spline_model
-from pulseportraiture_tpu.pipelines.zap import (get_zap_channels,
+from pulseportraiture_tpu.pipelines.zap import (apply_zaps,
+                                                get_zap_channels,
                                                 print_paz_cmds)
 
 MODEL_PARAMS = np.array([0.02, 0.0, 0.40, 0.0, 0.05, 0.0, 1.0, -0.5])
@@ -66,6 +67,115 @@ def test_print_paz_cmds(setup, capsys):
     out = str(tmp / "paz.cmds")
     print_paz_cmds([hot], zap_list, outfile=out, quiet=True)
     assert os.path.exists(out)
+
+
+def test_zap_lists_are_absolute_subint_indexed(setup, tmp_path):
+    """Producers emit one entry per ARCHIVE subint (empty for dead
+    subints), so paz -w emission and apply_zaps address the right
+    subints on archives where load_data excluded a subint."""
+    tmp, gm, par, hot, clean = setup
+    noise = np.full(16, 0.005)
+    noise[7] = 0.08
+    arch = str(tmp_path / "deadsub.fits")
+    w = np.ones((3, 16))
+    w[0] = 0.0  # subint 0 entirely dead -> excluded from ok_isubs
+    make_fake_pulsar(gm, par, arch, nsub=3, nchan=16, nbin=128,
+                     nu0=1500.0, bw=800.0, tsub=60.0, noise_stds=noise,
+                     weights=w, dedispersed=False, seed=5, quiet=True)
+    data = load_data(arch, dedisperse=False, pscrunch=True,
+                     rm_baseline=True, quiet=True)
+    assert 0 not in list(data.ok_isubs)
+    zaps = get_zap_channels(data, nstd=3)
+    assert len(zaps) == 3 and zaps[0] == []
+    assert 7 in zaps[1] and 7 in zaps[2]
+    # applying hits subints 1 and 2, not 0/1
+    apply_zaps([arch], [zaps], modify=True, quiet=True)
+    dz = load_data(arch, pscrunch=True, quiet=True)
+    assert np.all(dz.weights[1:, 7] == 0.0)
+    # misaligned lists are refused
+    with pytest.raises(ValueError):
+        apply_zaps([arch, arch], [zaps], modify=True, quiet=True)
+
+
+def test_apply_zaps_e2e(setup, tmp_path):
+    """Native zap application: archive -> zap proposals -> apply ->
+    reload shows the channels at zero weight and the TOA pipeline skips
+    them (SURVEY §7.1's native 'zap application'; the reference can
+    only emit paz commands, /root/reference/ppzap.py:50-95)."""
+    import shutil
+
+    from pulseportraiture_tpu.pipelines.toas import GetTOAs
+
+    tmp, gm, par, hot, clean = setup
+    work = str(tmp_path / "hot_copy.fits")
+    shutil.copy(hot, work)
+    data = load_data(work, dedisperse=False, tscrunch=False,
+                     pscrunch=True, rm_baseline=True, quiet=True)
+    zaps = get_zap_channels(data, nstd=3)
+    assert all(3 in z and 11 in z for z in zaps)
+
+    # copy mode (paz -e zap naming): original untouched
+    res = apply_zaps([work], [zaps], modify=False, quiet=True)
+    assert len(res) == 1
+    zapfile, nzapped = res[0]
+    assert zapfile == str(tmp_path / "hot_copy.zap")
+    assert nzapped == sum(len(z) for z in zaps)
+    d0 = load_data(work, pscrunch=True, quiet=True)
+    assert all(3 in d0.ok_ichans[s] for s in range(d0.nsub))
+    dz = load_data(zapfile, pscrunch=True, quiet=True)
+    for isub, z in enumerate(zaps):
+        assert np.all(dz.weights[isub, z] == 0.0)
+        assert not set(z) & set(np.asarray(dz.ok_ichans[isub]).tolist())
+
+    # modify mode rewrites in place
+    res = apply_zaps([work], [zaps], modify=True, quiet=True)
+    assert res[0][0] == work
+    dm_ = load_data(work, pscrunch=True, quiet=True)
+    for isub, z in enumerate(zaps):
+        assert np.all(dm_.weights[isub, z] == 0.0)
+
+    # the TOA pipeline skips zapped channels: their channel SNR is 0
+    gt = GetTOAs(datafiles=work, modelfile=gm, quiet=True)
+    gt.get_TOAs(quiet=True)
+    csnr = np.asarray(gt.channel_snrs[0])
+    for isub, z in enumerate(zaps):
+        assert np.all(csnr[isub, z] == 0.0)
+        alive = sorted(set(range(csnr.shape[1])) - set(z))
+        assert np.all(csnr[isub, alive] > 0.0)
+
+    # all_subs applies the channel union to every subint
+    work2 = str(tmp_path / "hot_allsubs.fits")
+    shutil.copy(hot, work2)
+    apply_zaps([work2], [[[3], [11]]], all_subs=True, modify=True,
+               quiet=True)
+    da = load_data(work2, pscrunch=True, quiet=True)
+    assert np.all(da.weights[:, [3, 11]] == 0.0)
+
+
+def test_cli_ppzap_apply(setup, tmp_path, capsys):
+    """ppzap --apply natively zaps through the CLI in both copy and
+    modify modes (no psrchive required)."""
+    import shutil
+
+    from pulseportraiture_tpu.cli.ppzap import main
+
+    tmp, gm, par, hot, clean = setup
+    work = str(tmp_path / "cli_hot.fits")
+    shutil.copy(hot, work)
+    # copy mode: writes .zap, source untouched
+    assert main(["-d", work, "-n", "3", "--apply", "--quiet"]) == 0
+    zapfile = str(tmp_path / "cli_hot.zap")
+    assert os.path.exists(zapfile)
+    dz = load_data(zapfile, pscrunch=True, quiet=True)
+    assert np.all(dz.weights[:, [3, 11]] == 0.0)
+    assert np.any(load_data(work, pscrunch=True,
+                            quiet=True).weights[:, 3] > 0.0)
+    # modify mode: rewrites in place
+    assert main(["-d", work, "-n", "3", "--apply", "--modify",
+                 "--quiet"]) == 0
+    dm_ = load_data(work, pscrunch=True, quiet=True)
+    assert np.all(dm_.weights[:, [3, 11]] == 0.0)
+    capsys.readouterr()
 
 
 @pytest.mark.slow
