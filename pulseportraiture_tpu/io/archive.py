@@ -184,7 +184,7 @@ def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
                      scales=1.0, dedispersed=False, t_scat=0.0,
                      alpha=-4.0, scint=False, xs=None, Cs=None,
                      nu_DM=np.inf, state="Stokes", telescope="GBT",
-                     seed=0, quiet=True):
+                     frontend="unknown", seed=0, quiet=True):
     """Generate a fake-pulsar PSRFITS archive from a .gmodel file.
 
     File-producing equivalent of /root/reference/pplib.py:3189-3384 —
@@ -204,14 +204,16 @@ def make_fake_pulsar(modelfile, ephemeris, outfile="fake_pulsar.fits",
             weights=weights, noise_stds=noise_stds, scales=scales,
             dedispersed=dedispersed, t_scat=t_scat, alpha=alpha,
             scint=scint, xs=xs, Cs=Cs, nu_DM=nu_DM, state=state,
-            telescope=telescope, seed=seed, quiet=quiet)
+            telescope=telescope, frontend=frontend, seed=seed,
+            quiet=quiet)
 
 
 def _make_fake_pulsar_impl(*, modelfile, ephemeris, outfile, nsub, npol,
                            nchan, nbin, nu0, bw, tsub, phase, dDM,
                            start_MJD, weights, noise_stds, scales,
                            dedispersed, t_scat, alpha, scint, xs, Cs,
-                           nu_DM, state, telescope, seed, quiet):
+                           nu_DM, state, telescope, frontend, seed,
+                           quiet):
     import jax
 
     from ..config import Dconst, host_array
@@ -308,8 +310,8 @@ def _make_fake_pulsar_impl(*, modelfile, ephemeris, outfile, nsub, npol,
                    np.full(nsub, tsub), DM=DM,
                    state=("Intensity" if npol == 1 else state),
                    dedispersed=True, source=str(par.get("PSR", "FAKE")),
-                   telescope=telescope, nu0=nu0, bw=bw,
-                   ephemeris_text=ephem_text, polyco=polyco)
+                   telescope=telescope, frontend=frontend, nu0=nu0,
+                   bw=bw, ephemeris_text=ephem_text, polyco=polyco)
     # The model is built at its intrinsic (aligned) phases = the
     # dedispersed frame; inject the (phase, dDM) rotation, then store
     # dispersed or dedispersed as requested.

@@ -18,6 +18,20 @@ _STRING_FIELDS = {"PSR", "PSRJ", "PSRB", "RAJ", "DECJ", "RA", "DEC",
                   "TIMEEPH", "T2CMETHOD", "CORRECT_TROPOSPHERE", "PLANET_SHAPIRO",
                   "DILATEFREQ", "INFO", "NITS", "IBOOT", "DMDATA"}
 
+# repeatable flag-selector lines: "<KEY> -<flag> <flagval> <value> ..."
+# (tempo2/PINT noise+offset extensions).  Stored as lists, not fields:
+#   JUMP     -> par.jumps    [{flag, flagval, offset_s, fit}]
+#   DMJUMP   -> par.dmjumps  [{flag, flagval, offset_dm, fit}]  (PINT's
+#       wideband per-receiver DM-measurement offset, pc cm^-3)
+#   T2EFAC / EFAC   -> par.efacs    [{flag, flagval, value}]
+#   T2EQUAD / EQUAD -> par.equads   [{flag, flagval, value}]  (us)
+#   DMEFAC   -> par.dmefacs  |  DMEQUAD -> par.dmequads  (pc cm^-3)
+_SELECTOR_KEYS = {"JUMP": "jumps", "DMJUMP": "dmjumps",
+                  "T2EFAC": "efacs", "EFAC": "efacs",
+                  "T2EQUAD": "equads", "EQUAD": "equads",
+                  "DMEFAC": "dmefacs", "DMEQUAD": "dmequads"}
+_OFFSET_FIELD = {"JUMP": "offset_s", "DMJUMP": "offset_dm"}
+
 
 def _parse_value(key, value):
     if key in _STRING_FIELDS:
@@ -38,6 +52,7 @@ def read_par(parfile):
     fields = {}
     fit_flags = {}
     uncertainties = {}
+    selectors = {name: [] for name in set(_SELECTOR_KEYS.values())}
     with open(parfile) as f:
         for line in f:
             toks = line.split()
@@ -45,6 +60,17 @@ def read_par(parfile):
                 continue
             key = toks[0]
             if len(toks) < 2:
+                continue
+            if key in _SELECTOR_KEYS and len(toks) >= 4 \
+                    and toks[1].startswith("-"):
+                entry = DataBunch(flag=toks[1][1:], flagval=toks[2],
+                                  value=float(toks[3].replace("D", "E")
+                                              .replace("d", "e")))
+                if key in _OFFSET_FIELD:
+                    entry[_OFFSET_FIELD[key]] = entry.pop("value")
+                    entry["fit"] = int(toks[4]) if len(toks) >= 5 \
+                        and toks[4].lstrip("+-").isdigit() else 0
+                selectors[_SELECTOR_KEYS[key]].append(entry)
                 continue
             fields[key] = _parse_value(key, toks[1])
             if len(toks) >= 3:
@@ -64,7 +90,12 @@ def read_par(parfile):
     if "PSR" not in fields and "PSRJ" in fields:
         fields["PSR"] = fields["PSRJ"]
     return DataBunch(fit_flags=fit_flags, uncertainties=uncertainties,
-                     **fields)
+                     **selectors, **fields)
+
+
+_SELECTOR_WRITE_KEYS = {"jumps": "JUMP", "dmjumps": "DMJUMP",
+                        "efacs": "T2EFAC", "equads": "T2EQUAD",
+                        "dmefacs": "DMEFAC", "dmequads": "DMEQUAD"}
 
 
 def write_par(parfile, fields, fit_flags=None, quiet=True):
@@ -73,6 +104,17 @@ def write_par(parfile, fields, fit_flags=None, quiet=True):
     with open(parfile, "w") as f:
         for key, value in fields.items():
             if key in ("fit_flags", "uncertainties"):
+                continue
+            if key in _SELECTOR_WRITE_KEYS:
+                for s in value:
+                    val = s.get("offset_s",
+                                s.get("offset_dm", s.get("value")))
+                    line = "%-12s -%s %s %.15g" % (
+                        _SELECTOR_WRITE_KEYS[key], s["flag"],
+                        s["flagval"], val)
+                    if key in ("jumps", "dmjumps"):
+                        line += " %d" % s.get("fit", 0)
+                    f.write(line + "\n")
                 continue
             if isinstance(value, float):
                 line = "%-12s %.15g" % (key, value)

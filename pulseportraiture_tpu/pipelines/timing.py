@@ -30,8 +30,8 @@ from ..config import Dconst
 from ..io.parfile import read_par
 from ..utils.mjd import MJD
 
-__all__ = ["parse_tim", "phase_residuals", "wideband_gls_fit",
-           "run_tempo_if_available"]
+__all__ = ["parse_tim", "phase_residuals", "rescaled_errors",
+           "wideband_gls_fit", "run_tempo_if_available"]
 
 
 def parse_tim(timfile):
@@ -63,6 +63,63 @@ def parse_tim(timfile):
                 mjd=MJD(int(day), float("0." + frac) * 86400.0),
                 err_us=float(err), site=site, flags=flags))
     return toas
+
+
+def _selector_mask(toas, flag, flagval):
+    """Boolean mask of TOAs whose ``-<flag> <value>`` matches a par
+    selector (JUMP/T2EFAC/... lines).  parse_tim floats numeric flag
+    values, so both string and numeric representations compare equal
+    ('800' matches 800.0)."""
+    out = np.zeros(len(toas), dtype=bool)
+    for i, t in enumerate(toas):
+        tv = t["flags"].get(flag)
+        if tv is None:
+            continue
+        if str(tv) == str(flagval):
+            out[i] = True
+        else:
+            try:
+                out[i] = float(tv) == float(flagval)
+            except (TypeError, ValueError):
+                pass
+    return out
+
+
+def rescaled_errors(toas, par):
+    """Per-TOA (err_us, dm_err) with par EFAC/EQUAD-style rescaling.
+
+    tempo2 convention: sigma' = EFAC * sqrt(sigma^2 + EQUAD^2), with
+    T2EFAC/T2EQUAD [us] selecting TOAs by flag and DMEFAC/DMEQUAD
+    [pc cm^-3] doing the same for the wideband DM uncertainties.  A TOA
+    matched by several lines of the same kind uses the first match.
+    Flagless tempo1-style global lines ('EFAC 1.5') apply to every TOA
+    a selector line did not match.
+    Returns (err_us [ntoa], dm_err [ntoa; NaN where no -pp_dme]).
+    """
+    p = par if not isinstance(par, str) else read_par(par)
+    err_us = np.array([t["err_us"] for t in toas], dtype=np.float64)
+    dm_err = np.array([t["flags"].get("pp_dme", np.nan) for t in toas],
+                      dtype=np.float64)
+
+    def first_match(lines, global_key, default):
+        # flagless global value (a plain par field) is the fallback
+        # for TOAs no selector line matched
+        fallback = p.get(global_key, default)
+        fallback = float(fallback) if not isinstance(fallback, str) \
+            else default
+        vals = np.full(len(toas), np.nan)
+        for ln in lines:
+            m = _selector_mask(toas, ln["flag"], ln["flagval"])
+            vals = np.where(np.isnan(vals) & m, ln["value"], vals)
+        return np.where(np.isnan(vals), fallback, vals)
+
+    equad = first_match(p.get("equads", []), "EQUAD", 0.0)
+    efac = first_match(p.get("efacs", []), "EFAC", 1.0)
+    err_us = efac * np.sqrt(err_us ** 2 + equad ** 2)
+    dmequad = first_match(p.get("dmequads", []), "DMEQUAD", 0.0)
+    dmefac = first_match(p.get("dmefacs", []), "DMEFAC", 1.0)
+    dm_err = dmefac * np.sqrt(dm_err ** 2 + dmequad ** 2)
+    return err_us, dm_err
 
 
 def _dispersion_term(nu):
@@ -130,8 +187,28 @@ def wideband_gls_fit(toas, par, fit_dm=None, fit_f1=None, dmx=None,
     or DMX_xxxx entries); per-epoch dDM corrections then replace the
     single global dDM, with TOAs binned into ``dmx_window_days``-long
     ranges (default: the par's DMX value, else 6.5 d, tempo's default).
+
+    Par noise/offset extensions are honored (the reference defers these
+    to tempo — notebook cells 43-56; this stage inlines them):
+
+    - ``JUMP -flag val offset [fit]`` — a receiver/backend time offset
+      [s] applied to TOAs matching ``-flag val``.  The par offset is
+      removed from the prefit residuals; lines with a fit flag of 1 get
+      a free column (the correction, in seconds).  Positive JUMP =
+      matching TOAs arrive later.  Per-jump results land in ``jumps``.
+    - ``DMJUMP -flag val offset [fit]`` — PINT's wideband per-receiver
+      DM-measurement offset [pc cm^-3]: a bias of the matching TOAs'
+      -pp_dm values (e.g. from template evolution misfit in one band),
+      NOT a physical delay — it enters the DM data rows only.  Fixed
+      offsets are subtracted from the measurements; fit=1 adds a free
+      column.  Results land in ``dmjumps``.
+    - ``T2EFAC/T2EQUAD`` (+ ``DMEFAC/DMEQUAD`` for the wideband DM
+      uncertainties): sigma' = EFAC * sqrt(sigma^2 + EQUAD^2), tempo2's
+      convention (see ``rescaled_errors``).
+
     Returns a dict with params, errors, per-epoch ``dmx`` results,
-    prefit/postfit weighted rms [us], chi2, and dof.
+    per-jump ``jumps`` results, prefit/postfit weighted rms [us], chi2,
+    and dof.
     """
     p = par if not isinstance(par, str) else read_par(par)
     if fit_dm is None:
@@ -151,8 +228,19 @@ def wideband_gls_fit(toas, par, fit_dm=None, fit_f1=None, dmx=None,
     DM0 = float(p.get("DM", 0.0))
     resid, dt, P = phase_residuals(toas, p)
     nu = np.array([t["freq"] for t in toas])
-    err_rot = np.array([t["err_us"] for t in toas]) * 1e-6 / P
+    err_us_r, dme_r = rescaled_errors(toas, p)
+    err_rot = err_us_r * 1e-6 / P
     disp = _dispersion_term(nu) / P  # phase per unit DM
+
+    # JUMPs: remove the par offsets from the prefit residuals (re-wrap
+    # after — a jump can carry a residual across the +-0.5 boundary)
+    jumps = list(p.get("jumps", []))
+    jump_masks = [_selector_mask(toas, j["flag"], j["flagval"])
+                  for j in jumps]
+    for j, m in zip(jumps, jump_masks):
+        if j["offset_s"]:
+            resid = resid - m * (j["offset_s"] / P)
+    resid = ((resid + 0.5) % 1.0) - 0.5
 
     # spin columns, in phase units
     cols = [np.ones_like(dt), dt]
@@ -177,14 +265,34 @@ def wideband_gls_fit(toas, par, fit_dm=None, fit_f1=None, dmx=None,
         if fit_dm:
             cols.append(disp)
             names.append("dDM")
+    # free JUMP columns (fit flag 1) go last so the DM-row indexing
+    # below (columns nspin..nspin+nep) stays contiguous
+    njump_start = len(cols)
+    for j, m in zip(jumps, jump_masks):
+        if j.get("fit", 0):
+            if not m.any():
+                raise ValueError(
+                    "JUMP -%s %s (fit) matches no TOAs — its design "
+                    "column would be all-zero" % (j["flag"],
+                                                  j["flagval"]))
+            cols.append(m.astype(np.float64) / P)  # rot per second
+            names.append("JUMP_%s_%s" % (j["flag"], j["flagval"]))
     M = np.stack(cols, axis=1)
     y = resid.copy()
     w = err_rot ** -2.0
 
+    dmjumps = list(p.get("dmjumps", []))
+    dmjump_masks = [_selector_mask(toas, dj["flag"], dj["flagval"])
+                    for dj in dmjumps]
+    dmjump_start = M.shape[1]
     if fit_dm:
         # wideband DM measurements as data rows: DM_i - DM0 = dDM_e(i)
         dms = np.array([t["flags"].get("pp_dm", np.nan) for t in toas])
-        dmes = np.array([t["flags"].get("pp_dme", np.nan) for t in toas])
+        # fixed DMJUMP offsets come off the measurements up front
+        for dj, m in zip(dmjumps, dmjump_masks):
+            if dj["offset_dm"]:
+                dms = dms - np.where(m, dj["offset_dm"], 0.0)
+        dmes = dme_r  # DMEFAC/DMEQUAD-rescaled
         okd = np.isfinite(dms) & np.isfinite(dmes) & (dmes > 0)
         Md = np.zeros((int(okd.sum()), M.shape[1]))
         if dmx:
@@ -194,6 +302,19 @@ def wideband_gls_fit(toas, par, fit_dm=None, fit_f1=None, dmx=None,
         M = np.vstack([M, Md])
         y = np.concatenate([y, dms[okd] - DM0])
         w = np.concatenate([w, dmes[okd] ** -2.0])
+        # free DMJUMP columns act on the DM rows alone
+        dmjump_start = M.shape[1]
+        for dj, m in zip(dmjumps, dmjump_masks):
+            if dj.get("fit", 0):
+                if not m[okd].any():
+                    raise ValueError(
+                        "DMJUMP -%s %s (fit) matches no wideband DM "
+                        "rows — its design column would be all-zero"
+                        % (dj["flag"], dj["flagval"]))
+                col = np.concatenate([np.zeros(len(toas)),
+                                      m[okd].astype(np.float64)])
+                M = np.hstack([M, col[:, None]])
+                names.append("DMJUMP_%s_%s" % (dj["flag"], dj["flagval"]))
 
     # weighted LSQ via column-scaled QR: the spin columns span ~16
     # decades (1, dt, dt^2/2 at dt~1e8 s), where forming the normal
@@ -230,9 +351,38 @@ def wideband_gls_fit(toas, par, fit_dm=None, fit_f1=None, dmx=None,
                     err=float(errs[nspin + e]),
                     ntoa=int(np.sum(eidx == e)))
                for e in range(nep)]
+    jump_out = []
+    k = njump_start
+    for j, m in zip(jumps, jump_masks):
+        jd = dict(flag=j["flag"], flagval=j["flagval"],
+                  offset_s=float(j["offset_s"]),
+                  fit=bool(j.get("fit", 0)), ntoa=int(m.sum()))
+        if jd["fit"]:
+            jd["delta_s"] = float(x[k])
+            jd["err_s"] = float(errs[k])
+            jd["total_s"] = jd["offset_s"] + jd["delta_s"]
+            k += 1
+        else:
+            jd["total_s"] = jd["offset_s"]
+        jump_out.append(jd)
+    dmjump_out = []
+    k = dmjump_start
+    for dj, m in zip(dmjumps, dmjump_masks):
+        dd = dict(flag=dj["flag"], flagval=dj["flagval"],
+                  offset_dm=float(dj["offset_dm"]),
+                  fit=bool(dj.get("fit", 0)) and fit_dm,
+                  ntoa=int(m.sum()))
+        if dd["fit"]:
+            dd["delta_dm"] = float(x[k])
+            dd["err_dm"] = float(errs[k])
+            dd["total_dm"] = dd["offset_dm"] + dd["delta_dm"]
+            k += 1
+        else:
+            dd["total_dm"] = dd["offset_dm"]
+        dmjump_out.append(dd)
     return dict(params=dict(zip(names, x)),
                 errors=dict(zip(names, errs)),
-                dmx=dmx_out,
+                dmx=dmx_out, jumps=jump_out, dmjumps=dmjump_out,
                 prefit_wrms_us=float(prefit_us),
                 postfit_wrms_us=float(wrms_us),
                 chi2=chi2, red_chi2=chi2 / max(dof, 1), dof=dof,
