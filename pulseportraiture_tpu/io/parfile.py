@@ -20,7 +20,10 @@ _STRING_FIELDS = {"PSR", "PSRJ", "PSRB", "RAJ", "DECJ", "RA", "DEC",
 
 # repeatable flag-selector lines: "<KEY> -<flag> <flagval> <value> ..."
 # (tempo2/PINT noise+offset extensions).  Stored as lists, not fields:
-#   JUMP     -> par.jumps    [{flag, flagval, offset_s, fit}]
+#   JUMP     -> par.jumps    [{flag, flagval, offset_s, fit}] for the
+#       flag form; tempo's non-flag forms parse too, as
+#       {flag: "MJD"|"FREQ", lo, hi, offset_s, fit} and
+#       {flag: "TEL", flagval: site, offset_s, fit}
 #   DMJUMP   -> par.dmjumps  [{flag, flagval, offset_dm, fit}]  (PINT's
 #       wideband per-receiver DM-measurement offset, pc cm^-3)
 #   T2EFAC / EFAC   -> par.efacs    [{flag, flagval, value}]
@@ -31,6 +34,15 @@ _SELECTOR_KEYS = {"JUMP": "jumps", "DMJUMP": "dmjumps",
                   "T2EQUAD": "equads", "EQUAD": "equads",
                   "DMEFAC": "dmefacs", "DMEQUAD": "dmequads"}
 _OFFSET_FIELD = {"JUMP": "offset_s", "DMJUMP": "offset_dm"}
+
+
+def _float_ftn(tok):
+    return float(tok.replace("D", "E").replace("d", "e"))
+
+
+def _fit_flag(toks, i):
+    return int(toks[i]) if len(toks) > i \
+        and toks[i].lstrip("+-").isdigit() else 0
 
 
 def _parse_value(key, value):
@@ -64,13 +76,27 @@ def read_par(parfile):
             if key in _SELECTOR_KEYS and len(toks) >= 4 \
                     and toks[1].startswith("-"):
                 entry = DataBunch(flag=toks[1][1:], flagval=toks[2],
-                                  value=float(toks[3].replace("D", "E")
-                                              .replace("d", "e")))
+                                  value=_float_ftn(toks[3]))
                 if key in _OFFSET_FIELD:
                     entry[_OFFSET_FIELD[key]] = entry.pop("value")
-                    entry["fit"] = int(toks[4]) if len(toks) >= 5 \
-                        and toks[4].lstrip("+-").isdigit() else 0
+                    entry["fit"] = _fit_flag(toks, 4)
                 selectors[_SELECTOR_KEYS[key]].append(entry)
+                continue
+            if key == "JUMP" and toks[1].upper() in ("MJD", "FREQ") \
+                    and len(toks) >= 5:
+                # tempo's range forms: JUMP MJD t1 t2 off [fit]
+                selectors["jumps"].append(DataBunch(
+                    flag=toks[1].upper(), lo=_float_ftn(toks[2]),
+                    hi=_float_ftn(toks[3]),
+                    offset_s=_float_ftn(toks[4]),
+                    fit=_fit_flag(toks, 5)))
+                continue
+            if key == "JUMP" and toks[1].upper() == "TEL" \
+                    and len(toks) >= 4:
+                selectors["jumps"].append(DataBunch(
+                    flag="TEL", flagval=toks[2],
+                    offset_s=_float_ftn(toks[3]),
+                    fit=_fit_flag(toks, 4)))
                 continue
             fields[key] = _parse_value(key, toks[1])
             if len(toks) >= 3:
@@ -107,13 +133,22 @@ def write_par(parfile, fields, fit_flags=None, quiet=True):
                 continue
             if key in _SELECTOR_WRITE_KEYS:
                 for s in value:
-                    val = s.get("offset_s",
-                                s.get("offset_dm", s.get("value")))
-                    line = "%-12s -%s %s %.15g" % (
-                        _SELECTOR_WRITE_KEYS[key], s["flag"],
-                        s["flagval"], val)
-                    if key in ("jumps", "dmjumps"):
-                        line += " %d" % s.get("fit", 0)
+                    if key == "jumps" and "lo" in s:
+                        line = "%-12s %s %.15g %.15g %.15g %d" % (
+                            "JUMP", s["flag"], s["lo"], s["hi"],
+                            s["offset_s"], s.get("fit", 0))
+                    elif key == "jumps" and s["flag"] == "TEL":
+                        line = "%-12s TEL %s %.15g %d" % (
+                            "JUMP", s["flagval"], s["offset_s"],
+                            s.get("fit", 0))
+                    else:
+                        val = s.get("offset_s",
+                                    s.get("offset_dm", s.get("value")))
+                        line = "%-12s -%s %s %.15g" % (
+                            _SELECTOR_WRITE_KEYS[key], s["flag"],
+                            s["flagval"], val)
+                        if key in ("jumps", "dmjumps"):
+                            line += " %d" % s.get("fit", 0)
                     f.write(line + "\n")
                 continue
             if isinstance(value, float):

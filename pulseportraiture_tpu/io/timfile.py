@@ -114,17 +114,31 @@ def write_TOAs(TOAs, inf_is_zero=True, SNR_cutoff=0.0, outfile=None,
                append=True):
     """Write .tim lines to outfile (append by default) or stdout.
 
-    Equivalent of /root/reference/pplib.py:3451-3509.
+    Equivalent of /root/reference/pplib.py:3451-3509, plus the
+    ``FORMAT 1`` header tempo2/PINT expect at the top of an IPTA-format
+    tim file — emitted whenever this call starts a fresh file (the
+    reference leaves it to the user's editor).
     """
+    import os
+
     toas = TOAs if isinstance(TOAs, (list, tuple)) else [TOAs]
     toas = filter_TOAs(toas, "snr", SNR_cutoff, ">=", pass_unflagged=False)
     lines = [format_toa_line(t, inf_is_zero) for t in toas]
     if outfile is None:
         for line in lines:
             print(line)
-    else:
+    elif lines:
+        fresh = not append or not os.path.exists(outfile) \
+            or os.path.getsize(outfile) == 0
         with open(outfile, "a" if append else "w") as of:
+            if fresh:
+                of.write("FORMAT 1\n")
             of.write("".join(line + "\n" for line in lines))
+    elif not append and os.path.exists(outfile):
+        # all TOAs culled: an overwrite call must still truncate (stale
+        # TOAs from a previous run would otherwise survive), but leave
+        # no header-only file behind and create nothing new
+        open(outfile, "w").close()
 
 
 def write_princeton_TOA(TOA_MJDi, TOA_MJDf, TOA_err, nu_ref, dDM, obs="@",

@@ -85,6 +85,28 @@ def _selector_mask(toas, flag, flagval):
     return out
 
 
+def _jump_mask(toas, j):
+    """TOA mask for one par JUMP entry, any of tempo's four forms:
+    flag selector, MJD range, FREQ range [MHz], or TEL site."""
+    if "lo" in j:  # JUMP MJD t1 t2 / JUMP FREQ f1 f2
+        if j["flag"] == "MJD":
+            vals = np.array([t["mjd"].day + t["mjd"].secs / 86400.0
+                             for t in toas])
+        else:
+            vals = np.array([t["freq"] for t in toas])
+        return (vals >= j["lo"]) & (vals <= j["hi"])
+    if j["flag"] == "TEL":
+        return np.array([t["site"] == j["flagval"] for t in toas],
+                        dtype=bool)
+    return _selector_mask(toas, j["flag"], j["flagval"])
+
+
+def _jump_label(j):
+    if "lo" in j:
+        return "JUMP_%s_%g_%g" % (j["flag"], j["lo"], j["hi"])
+    return "JUMP_%s_%s" % (j["flag"], j["flagval"])
+
+
 def rescaled_errors(toas, par):
     """Per-TOA (err_us, dm_err) with par EFAC/EQUAD-style rescaling.
 
@@ -235,8 +257,7 @@ def wideband_gls_fit(toas, par, fit_dm=None, fit_f1=None, dmx=None,
     # JUMPs: remove the par offsets from the prefit residuals (re-wrap
     # after — a jump can carry a residual across the +-0.5 boundary)
     jumps = list(p.get("jumps", []))
-    jump_masks = [_selector_mask(toas, j["flag"], j["flagval"])
-                  for j in jumps]
+    jump_masks = [_jump_mask(toas, j) for j in jumps]
     for j, m in zip(jumps, jump_masks):
         if j["offset_s"]:
             resid = resid - m * (j["offset_s"] / P)
@@ -272,11 +293,10 @@ def wideband_gls_fit(toas, par, fit_dm=None, fit_f1=None, dmx=None,
         if j.get("fit", 0):
             if not m.any():
                 raise ValueError(
-                    "JUMP -%s %s (fit) matches no TOAs — its design "
-                    "column would be all-zero" % (j["flag"],
-                                                  j["flagval"]))
+                    "%s (fit) matches no TOAs — its design column "
+                    "would be all-zero" % _jump_label(j))
             cols.append(m.astype(np.float64) / P)  # rot per second
-            names.append("JUMP_%s_%s" % (j["flag"], j["flagval"]))
+            names.append(_jump_label(j))
     M = np.stack(cols, axis=1)
     y = resid.copy()
     w = err_rot ** -2.0
@@ -354,9 +374,11 @@ def wideband_gls_fit(toas, par, fit_dm=None, fit_f1=None, dmx=None,
     jump_out = []
     k = njump_start
     for j, m in zip(jumps, jump_masks):
-        jd = dict(flag=j["flag"], flagval=j["flagval"],
+        jd = dict(flag=j["flag"], flagval=j.get("flagval"),
                   offset_s=float(j["offset_s"]),
                   fit=bool(j.get("fit", 0)), ntoa=int(m.sum()))
+        if "lo" in j:
+            jd["lo"], jd["hi"] = float(j["lo"]), float(j["hi"])
         if jd["fit"]:
             jd["delta_s"] = float(x[k])
             jd["err_s"] = float(errs[k])

@@ -1,0 +1,91 @@
+"""Generate the vendored golden wideband .tim + expected-GLS fixture.
+
+Provenance script for tests/test_timing_crossval.py.  The tim file is
+produced ONCE by the repo's own pipeline (fixed seeds: fake archives ->
+GetTOAs -> write_TOAs) and committed; the expected GLS results are then
+computed by tests/timing_oracle.py — an independent, from-the-spec
+implementation (Decimal phase arithmetic + scipy lstsq) that shares no
+code with pulseportraiture_tpu.pipelines.timing — and committed as
+JSON.  The cross-validation test asserts the package's parser and GLS
+reproduce the oracle numbers on the committed bytes, so a regression in
+either the tim format or the fit shows up against code that did not
+change with it.
+
+Run from the repo root:  python tests/data/make_golden_tim.py
+Writes, next to itself: golden_wb.tim, golden_wb.par,
+golden_wb_expected.json
+"""
+
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from pulseportraiture_tpu.io.archive import make_fake_pulsar  # noqa: E402
+from pulseportraiture_tpu.io.gmodel import write_model  # noqa: E402
+from pulseportraiture_tpu.io.timfile import write_TOAs  # noqa: E402
+from pulseportraiture_tpu.pipelines.toas import GetTOAs  # noqa: E402
+from pulseportraiture_tpu.utils.mjd import MJD  # noqa: E402
+
+from timing_oracle import gls_oracle, parse_tim_oracle  # noqa: E402
+
+F0, PEPOCH, DM0 = 100.0, 56000.0, 30.0
+OFF_INJ, DF0_INJ, DDM_INJ = 0.01, 2e-10, 3e-4
+MODEL_PARAMS = np.array([0.02, 0.0, 0.40, 0.0, 0.05, 0.0, 1.0, -0.5])
+
+
+def main():
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="golden_tim_")
+    gm = os.path.join(tmp, "g.gmodel")
+    write_model(gm, "fake", "000", 1500.0, MODEL_PARAMS,
+                np.ones(8, int), -4.0, 0, quiet=True)
+    par = os.path.join(tmp, "g.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 %.1f\n"
+                "PEPOCH %.1f\nDM %.1f\n" % (F0, PEPOCH, DM0))
+    files = []
+    for ep in range(4):
+        dt_ep = ep * 10 * 86400.0
+        fn = os.path.join(tmp, "g%d.fits" % ep)
+        make_fake_pulsar(gm, par, fn, nsub=2, nchan=16, nbin=128,
+                         nu0=1400.0, bw=400.0, tsub=60.0,
+                         phase=OFF_INJ + DF0_INJ * dt_ep, dDM=DDM_INJ,
+                         noise_stds=0.004, dedispersed=False,
+                         start_MJD=MJD.from_mjd(PEPOCH + 10 * ep),
+                         seed=777 + ep, quiet=True)
+        files.append(fn)
+    gt = GetTOAs(files, gm, quiet=True)
+    gt.get_TOAs(bary=False, quiet=True)
+    timf = os.path.join(HERE, "golden_wb.tim")
+    # archive paths in the committed file must not leak the tmpdir
+    for t in gt.TOA_list:
+        t.archive = os.path.basename(t.archive)
+        t.flags.pop("tmplt", None)
+    write_TOAs(gt.TOA_list, outfile=timf, append=False)
+    with open(os.path.join(HERE, "golden_wb.par"), "w") as f:
+        f.write("PSR J0\nF0 %.1f\nPEPOCH %.1f\nDM %.1f\nDMDATA 1\n"
+                % (F0, PEPOCH, DM0))
+    expected = gls_oracle(parse_tim_oracle(timf), F0, PEPOCH, DM0)
+    expected["injections"] = dict(offset_rot=OFF_INJ, dF0_hz=DF0_INJ,
+                                  dDM=DDM_INJ)
+    with open(os.path.join(HERE, "golden_wb_expected.json"), "w") as f:
+        json.dump(expected, f, indent=1, sort_keys=True)
+    print("wrote golden_wb.tim (%d TOAs), golden_wb.par, "
+          "golden_wb_expected.json" % len(gt.TOA_list))
+    print(json.dumps(expected, indent=1, sort_keys=True))
+
+
+if __name__ == "__main__":
+    main()

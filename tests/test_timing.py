@@ -6,6 +6,8 @@ wideband .tim + par, parse them back, and verify the joint
 [offset, dF0, dDM] fit recovers injected timing-model perturbations.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -199,6 +201,64 @@ def test_par_selector_lines(tmp_path):
     p2 = read_par(parf2)
     assert p2.jumps == p.jumps and p2.dmjumps == p.dmjumps
     assert p2.efacs == p.efacs and p2.dmequads == p.dmequads
+
+
+def test_par_jump_nonflag_forms(tmp_path, rng):
+    """tempo's non-flag JUMP forms (MJD/FREQ ranges, TEL site) parse,
+    round-trip, and select the right TOAs in the GLS."""
+    from pulseportraiture_tpu.io.parfile import read_par, write_par
+
+    parf = str(tmp_path / "nf.par")
+    with open(parf, "w") as f:
+        f.write("PSR J0\nF0 100.0\nPEPOCH 56000.0\nDM 30.0\nDMDATA 1\n"
+                "JUMP MJD 56000.4 56001.2 0.0 1\n"
+                "JUMP FREQ 1400 1700 1.0d-6\n"
+                "JUMP TEL ao 2e-6 0\n")
+    p = read_par(parf)
+    assert len(p.jumps) == 3
+    assert p.jumps[0]["flag"] == "MJD" and p.jumps[0]["lo"] == 56000.4 \
+        and p.jumps[0]["hi"] == 56001.2 and p.jumps[0]["fit"] == 1
+    assert p.jumps[1]["flag"] == "FREQ" and p.jumps[1]["offset_s"] == 1e-6
+    assert p.jumps[2]["flag"] == "TEL" and p.jumps[2]["flagval"] == "ao"
+    parf2 = str(tmp_path / "nf2.par")
+    write_par(parf2, p)
+    assert read_par(parf2).jumps == p.jumps
+    # an MJD-range jump is absorbed by the GLS like any other
+    jump_inj = 3e-5
+    toas = []
+    for i in range(40):
+        n = round(i * 3600.0 * F0)
+        nu = 1300.0 + (i % 8) * 50.0
+        in_range = 56000.4 <= 56000.0 + n * P / 86400.0 <= 56001.2
+        resid = rng.normal(0, 1e-6 / P) + (jump_inj / P if in_range
+                                           else 0.0)
+        dt = (n + resid) * P + Dconst * DM0 * nu ** -2.0
+        toas.append(TOA("a.fits", nu, MJD(int(PEPOCH), dt), 1.0,
+                        "GBT", "1", DM=DM0 + rng.normal(0, 2e-4),
+                        DM_error=2e-4, flags={"snr": 100.0}))
+    timf = str(tmp_path / "nf.tim")
+    write_TOAs(toas, outfile=timf, append=False)
+    fit = wideband_gls_fit(parse_tim(timf), parf)
+    j = fit["jumps"][0]
+    assert 0 < j["ntoa"] < 40
+    assert abs(j["delta_s"] - jump_inj) < 5 * j["err_s"] + 1e-7, j
+    assert "JUMP_MJD_56000.4_56001.2" in fit["params"]
+    # the FREQ/TEL jumps are reported unfitted with their par offsets
+    assert fit["jumps"][1]["total_s"] == 1e-6
+    assert fit["jumps"][2]["ntoa"] == 0  # site '1' != 'ao'
+
+
+def test_write_toas_empty_overwrite_truncates(tmp_path):
+    """write_TOAs(append=False) with every TOA culled truncates an
+    existing file (stale TOAs must not survive) but creates nothing."""
+    out = str(tmp_path / "t.tim")
+    with open(out, "w") as f:
+        f.write("FORMAT 1\nstale.fits 1400.0 56000.0 1.0 gbt\n")
+    write_TOAs([], outfile=out, append=False)
+    assert os.path.exists(out) and open(out).read() == ""
+    out2 = str(tmp_path / "absent.tim")
+    write_TOAs([], outfile=out2, append=False)
+    assert not os.path.exists(out2)
 
 
 @pytest.fixture

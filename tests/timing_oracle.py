@@ -1,0 +1,115 @@
+"""Independent wideband-timing oracle (tests only).
+
+A from-the-spec reimplementation of IPTA .tim parsing and the wideband
+GLS, deliberately sharing NO code path with
+``pulseportraiture_tpu.pipelines.timing``:
+
+- tim lines are parsed directly per the tempo2/IPTA convention
+  "file freq sat error site -flag value ...", with the sat (MJD) kept
+  as a ``decimal.Decimal`` — not the package's two-part MJD class;
+- pulse-phase residuals are evaluated in Decimal arithmetic (exact at
+  the sub-ns level, where float64 on a raw MJD would not be);
+- the least-squares solve goes through ``scipy.linalg.lstsq`` on the
+  whitened system — not the package's column-scaled QR;
+- the dispersion constant is written out from tempo's documented
+  1 / 2.41e-4 convention rather than imported from the package.
+
+tests/test_timing_crossval.py uses this to validate both the package's
+tim format and its GLS against code that is not the package's.
+"""
+
+from decimal import Decimal, getcontext
+
+import numpy as np
+from scipy.linalg import lstsq
+
+getcontext().prec = 40  # plenty for ns-level phase at MJD~56000
+
+# tempo's dispersion measure constant: delay[s] = DM / (2.41e-4 * nu^2)
+KD = 1.0 / 2.41e-4  # s MHz^2 / (pc cm^-3)
+
+
+def parse_tim_oracle(path):
+    """Parse an IPTA-format tim file; MJDs stay exact Decimals."""
+    toas = []
+    for ln in open(path):
+        tk = ln.split()
+        if not tk or tk[0] in ("FORMAT", "C", "#", "MODE"):
+            continue
+        d = dict(file=tk[0], freq=float(tk[1]), mjd=Decimal(tk[2]),
+                 err_us=float(tk[3]), site=tk[4], flags={})
+        i = 5
+        while i < len(tk) - 1:
+            if tk[i].startswith("-"):
+                d["flags"][tk[i][1:]] = tk[i + 1]
+                i += 2
+            else:
+                i += 1
+        toas.append(d)
+    return toas
+
+
+def phase_residuals_oracle(toas, F0, PEPOCH, DM0):
+    """Wrapped phase residuals [rot] + dt [s] in Decimal arithmetic.
+
+    The TOA is the arrival time at its own frequency; the par DM delay
+    at that frequency is removed before evaluating the spin phase
+    (frequency 0 encodes infinite frequency = no delay).
+    """
+    F0d = Decimal(repr(F0))
+    PEd = Decimal(repr(PEPOCH))
+    resid = np.empty(len(toas))
+    dt = np.empty(len(toas))
+    for i, t in enumerate(toas):
+        delay = Decimal(0)
+        if t["freq"] > 0.0:
+            delay = (Decimal(repr(DM0)) * Decimal(repr(KD))
+                     / Decimal(repr(t["freq"])) ** 2)
+        dti = (t["mjd"] - PEd) * 86400 - delay
+        ph = F0d * dti
+        frac = ph - ph.to_integral_value(rounding="ROUND_HALF_EVEN")
+        resid[i] = float(frac)
+        dt[i] = float(dti)
+    return resid, dt
+
+
+def gls_oracle(toas, F0, PEPOCH, DM0):
+    """Weighted LSQ of [offset_rot, dF0, dDM] on wideband TOAs.
+
+    DM measurements (-pp_dm / -pp_dme flags) enter as data rows, the
+    wideband-GLS structure of Pennucci+ (2014).  Solved by
+    scipy.linalg.lstsq on the whitened system.
+    """
+    P = 1.0 / F0
+    resid, dt = phase_residuals_oracle(toas, F0, PEPOCH, DM0)
+    nu = np.array([t["freq"] for t in toas])
+    err_rot = np.array([t["err_us"] for t in toas]) * 1e-6 / P
+    disp = np.where(nu > 0.0, KD / np.where(nu > 0.0, nu, 1.0) ** 2, 0.0)
+
+    M = np.stack([np.ones_like(dt), dt, disp / P], axis=1)
+    y = resid.copy()
+    w = err_rot ** -2.0
+
+    dms = np.array([float(t["flags"]["pp_dm"]) for t in toas])
+    dmes = np.array([float(t["flags"]["pp_dme"]) for t in toas])
+    Md = np.zeros((len(toas), 3))
+    Md[:, 2] = 1.0
+    M = np.vstack([M, Md])
+    y = np.concatenate([y, dms - DM0])
+    w = np.concatenate([w, dmes ** -2.0])
+
+    sw = np.sqrt(w)
+    x, _, rank, _ = lstsq(M * sw[:, None], y * sw)
+    assert rank == 3
+    post = y - M @ x
+    cov = np.linalg.inv((M * w[:, None]).T @ M)
+    ntoa = len(toas)
+    wrms_us = np.sqrt(np.sum(w[:ntoa] * post[:ntoa] ** 2)
+                      / np.sum(w[:ntoa])) * P * 1e6
+    return dict(offset_rot=float(x[0]), dF0_hz=float(x[1]),
+                dDM=float(x[2]),
+                errors=dict(offset_rot=float(np.sqrt(cov[0, 0])),
+                            dF0_hz=float(np.sqrt(cov[1, 1])),
+                            dDM=float(np.sqrt(cov[2, 2]))),
+                postfit_wrms_us=float(wrms_us),
+                chi2=float(np.sum(w * post ** 2)), dof=len(y) - 3)
