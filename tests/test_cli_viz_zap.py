@@ -326,6 +326,33 @@ def test_viz_smoke(setup):
     gt.show_subint(0, 0, savefig=p7)
     assert os.path.getsize(p7) > 1000
 
+    # content: the wrapper entry points render their owners' arrays
+    import matplotlib.pyplot as plt
+
+    def imgs(fig):
+        return [ax.images[0] for ax in fig.axes if ax.images]
+
+    fit_port, fit_model = gt.return_fit(0, 0)[:2]
+    fig = viz.show_fit(gt, 0, 0, show=False)
+    np.testing.assert_array_equal(np.asarray(imgs(fig)[0].get_array()),
+                                  fit_port)
+    np.testing.assert_array_equal(np.asarray(imgs(fig)[1].get_array()),
+                                  fit_model)
+    assert hasattr(fig, "pp_rchi2")  # chi2 payload flows through
+    assert fig.axes[0].get_title().endswith("subint 0")
+    fig = viz.show_subint(gt, 0, 0, show=False)
+    np.testing.assert_array_equal(np.asarray(imgs(fig)[0].get_array()),
+                                  fit_port)
+    fig = viz.show_model_fit(dp, show=False)
+    np.testing.assert_array_equal(np.asarray(imgs(fig)[0].get_array()),
+                                  np.asarray(dp.portx))
+    np.testing.assert_array_equal(np.asarray(imgs(fig)[1].get_array()),
+                                  np.asarray(dp.modelx))
+    fig = viz.show_data_portrait(dp, show=False)
+    np.testing.assert_array_equal(np.asarray(imgs(fig)[0].get_array()),
+                                  np.asarray(dp.portx))
+    plt.close("all")
+
 
 def test_cli_pptoas_flags_and_cuts(setup):
     from pulseportraiture_tpu.cli.pptoas import main

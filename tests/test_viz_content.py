@@ -108,3 +108,166 @@ def test_show_residual_plot_zapped_channels_excluded():
     fig = viz.show_residual_plot(port, model, show=False,
                                  noise_stds=np.full(6, 0.01))
     assert len(fig.pp_rchi2) == 5
+
+
+def test_show_profiles_offsets_and_colors():
+    """Each profile is scattered at p + i*offset with amplitude-mapped
+    colors — the rendered points ARE the input rows, in row order."""
+    model = make_port(nchan=4)
+    phases = (np.arange(32) + 0.5) / 32
+    fig, ax = plt.subplots()
+    viz.show_profiles(model, phases=phases, offset=0.5, ax=ax)
+    assert len(ax.collections) == 4
+    for i, coll in enumerate(ax.collections):
+        xy = np.asarray(coll.get_offsets())
+        np.testing.assert_array_equal(xy[:, 0], phases)
+        np.testing.assert_allclose(xy[:, 1], model[i] + 0.5 * i,
+                                   atol=1e-14)
+        # colors follow the global amplitude normalization
+        want = plt.cm.Spectral((model[i] - model.min())
+                               / (model.max() - model.min()))
+        np.testing.assert_allclose(np.asarray(coll.get_facecolor()),
+                                   want, atol=1e-12)
+
+
+def test_show_stacked_profiles_content_and_rvrsd():
+    """Stacked view: per channel one dashed model + one solid data line
+    in the model's color, offset by i*fact*range; rvrsd flips the
+    channel order and the frequency tick labels."""
+    nchan, nbin = 12, 32
+    rng = np.random.default_rng(7)
+    data = np.zeros((nchan, nbin))
+    data[:, 10] = np.linspace(1.0, 2.0, nchan)
+    model = data + 0.0
+    data = data + rng.normal(0, 0.01, data.shape)
+    phases = (np.arange(nbin) + 0.5) / nbin
+    freqs = np.linspace(1100.0, 1900.0, nchan)
+    fig = viz.show_stacked_profiles(data, model, phases=phases,
+                                    freqs=freqs, show=False)
+    ax = fig.axes[0]
+    lines = ax.get_lines()
+    assert len(lines) == 2 * nchan
+    off = (data.max() - data.min()) * 0.25
+    for i in range(nchan):
+        mline, dline = lines[2 * i], lines[2 * i + 1]
+        assert mline.get_linestyle() == "--"
+        assert dline.get_linestyle() == "-"
+        assert dline.get_color() == mline.get_color()
+        np.testing.assert_array_equal(mline.get_xdata(), phases)
+        np.testing.assert_allclose(mline.get_ydata(), model[i] + i * off,
+                                   atol=1e-14)
+        np.testing.assert_allclose(dline.get_ydata(), data[i] + i * off,
+                                   atol=1e-14)
+    assert ax.get_xlabel() == "Phase [rot]"
+    assert ax.get_ylabel() == "Approx. Frequency [MHz]"
+    # tick labels are the decimated frequency axis
+    assert [t.get_text() for t in ax.get_yticklabels()] == \
+        [str(int(round(f))) for f in freqs[::10]]
+    # rvrsd: lowest row shows the top of the band
+    fig2 = viz.show_stacked_profiles(data, model, phases=phases,
+                                     freqs=freqs, rvrsd=True, show=False)
+    lines2 = fig2.axes[0].get_lines()
+    np.testing.assert_allclose(lines2[1].get_ydata(), data[-1],
+                               atol=1e-14)
+    assert [t.get_text() for t in fig2.axes[0].get_yticklabels()] == \
+        [str(int(round(f))) for f in freqs[::-1][::10]]
+
+
+def test_show_eigenprofiles_rows_and_truncation():
+    """Row k of the figure renders mean_prof (k=0) then eigenprofile k
+    as given — a transposed eigvec matrix cannot pass; ncomp truncates;
+    smoothed overlays land in their row."""
+    nbin, ncomp = 32, 3
+    rng = np.random.default_rng(5)
+    mean = np.sin(2 * np.pi * (np.arange(nbin) + 0.5) / nbin)
+    eig = rng.normal(0, 1.0, (ncomp, nbin))  # rows = eigenprofiles
+    smooth = eig + 0.1
+    fig = viz.show_eigenprofiles(eigprofs=eig, smooth_eigprofs=smooth,
+                                 mean_prof=mean, show=False)
+    assert len(fig.axes) == 1 + ncomp
+    x = (np.arange(nbin) + 0.5) / nbin
+    np.testing.assert_array_equal(fig.axes[0].get_lines()[0].get_xdata(),
+                                  x)
+    np.testing.assert_allclose(fig.axes[0].get_lines()[0].get_ydata(),
+                               mean, atol=1e-14)
+    assert fig.axes[0].get_ylabel() == "Mean profile"
+    for k in range(ncomp):
+        ax = fig.axes[1 + k]
+        raw, sm = ax.get_lines()[:2]
+        np.testing.assert_allclose(raw.get_ydata(), eig[k], atol=1e-14)
+        np.testing.assert_allclose(sm.get_ydata(), smooth[k], atol=1e-14)
+        assert ax.get_ylabel() == "Eigenprofile %d" % (k + 1)
+    assert fig.axes[-1].get_xlabel() == "Phase [rot]"
+    # ncomp truncation drops trailing components
+    fig2 = viz.show_eigenprofiles(eigprofs=eig, mean_prof=mean, ncomp=2,
+                                  show=False)
+    assert len(fig2.axes) == 3
+
+
+def test_show_eigenprofiles_from_spline_dataportrait():
+    """The DataPortrait entry path renders sm.eigvec COLUMNS as
+    eigenprofile rows (eigvec is [nbin, ncomp]) plus the mean profile."""
+    class FakeSM:
+        pass
+
+    class FakeDP:
+        pass
+
+    nbin = 16
+    sm = FakeSM()
+    rng = np.random.default_rng(2)
+    sm.eigvec = rng.normal(0, 1, (nbin, 2))  # [nbin, ncomp] as stored
+    sm.mean_prof = rng.normal(0, 1, nbin)
+    dp = FakeDP()
+    dp.spline_model = sm
+    fig = viz.show_eigenprofiles(dp, show=False)
+    assert len(fig.axes) == 3
+    np.testing.assert_allclose(fig.axes[0].get_lines()[0].get_ydata(),
+                               sm.mean_prof, atol=1e-14)
+    for k in range(2):
+        np.testing.assert_allclose(
+            fig.axes[1 + k].get_lines()[0].get_ydata(), sm.eigvec[:, k],
+            atol=1e-14)
+
+
+def test_show_spline_curve_projections_content():
+    """Per-coordinate panel: the black polyline is the projected data
+    column vs frequency, the green curve is splev of the stored tck,
+    the stars sit at the knots."""
+    from scipy import interpolate as si
+
+    nprof, ndim = 24, 2
+    freqs = np.linspace(1100.0, 1900.0, nprof)
+    rng = np.random.default_rng(9)
+    proj = np.stack([np.linspace(-1, 1, nprof) ** 2,
+                     np.sin(freqs / 300.0)], axis=1)
+    proj = proj + rng.normal(0, 0.01, proj.shape)
+    tck, _ = si.splprep(proj.T, u=freqs, k=3, s=float(nprof))
+    fig = viz.show_spline_curve_projections(proj, tck=tck, freqs=freqs,
+                                            show=False)
+    assert len(fig.axes) == ndim
+    interp_freqs = np.linspace(freqs.min(), freqs.max(), nprof * 10)
+    curve = np.array(si.splev(interp_freqs, tck))
+    knots = np.array(si.splev(tck[0], tck))
+    for ic in range(ndim):
+        lines = fig.axes[ic].get_lines()
+        # nprof single-point markers, then data polyline, curve, knots
+        data_line, curve_line, knot_line = lines[nprof:nprof + 3]
+        np.testing.assert_array_equal(data_line.get_xdata(), freqs)
+        np.testing.assert_allclose(data_line.get_ydata(), proj[:, ic],
+                                   atol=1e-14)
+        np.testing.assert_allclose(curve_line.get_ydata(), curve[ic],
+                                   atol=1e-12)
+        np.testing.assert_array_equal(knot_line.get_xdata(),
+                                      np.asarray(tck[0]))
+        np.testing.assert_allclose(knot_line.get_ydata(), knots[ic],
+                                   atol=1e-12)
+        assert fig.axes[ic].get_ylabel() == "Coordinate %d" % (ic + 1)
+    assert fig.axes[-1].get_xlabel() == "Frequency [MHz]"
+    # icoord selects a single panel
+    fig2 = viz.show_spline_curve_projections(proj, tck=tck, freqs=freqs,
+                                             icoord=1, show=False)
+    assert len(fig2.axes) == 1
+    np.testing.assert_allclose(
+        fig2.axes[0].get_lines()[nprof].get_ydata(), proj[:, 1],
+        atol=1e-14)
