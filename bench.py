@@ -110,6 +110,81 @@ def _align_batch(n_arch):
         shutil.rmtree(adir, ignore_errors=True)
 
 
+def _hetero_stress(on_accel):
+    """Mixed-shape GetTOAs stress: one metafile whose archives differ in
+    (nchan, nbin), timed cold (per-shape compiles included) and warm
+    (all programs cached in-process).
+
+    The chunked-scan fit compiles one program per distinct archive
+    shape, so a heterogeneous metafile pays compile churn no
+    homogeneous bench sees; the cold-warm split measures exactly that
+    (the reference's serial per-archive loop has no analogue —
+    /root/reference/pptoas.py:246-346 handles mixed shapes trivially
+    because nothing is compiled).  Same-shape archives share one
+    compiled program via the jit cache regardless of metafile order, so
+    no explicit shape-bucketing is needed; the persistent XLA cache
+    (enable_compile_cache) additionally carries the programs across
+    bench runs.
+    """
+    import shutil
+    import tempfile
+
+    from pulseportraiture_tpu.io.archive import make_fake_pulsar
+    from pulseportraiture_tpu.io.gmodel import write_model
+    from pulseportraiture_tpu.pipelines.toas import GetTOAs
+
+    if on_accel:
+        shapes_mix = [(64, 512), (128, 1024), (512, 2048)]
+        nsub, reps = 4, 2
+    else:
+        shapes_mix = [(16, 128), (32, 256), (64, 512)]
+        nsub, reps = 2, 2
+    hdir = tempfile.mkdtemp(prefix="pp_bench_hetero_")
+    try:
+        hgm = os.path.join(hdir, "h.gmodel")
+        write_model(hgm, "bench", "000", 1500.0,
+                    np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5]),
+                    np.ones(8, int), -4.0, 0, quiet=True)
+        hpar = os.path.join(hdir, "h.par")
+        with open(hpar, "w") as f:
+            f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                    "PEPOCH 56000.0\nDM 30.0\n")
+        h_rng = np.random.default_rng(6)
+        hfiles = []
+        for r in range(reps):
+            for si, (hchan, hbin) in enumerate(shapes_mix):
+                out = os.path.join(hdir, "h%d_%d.fits" % (si, r))
+                make_fake_pulsar(
+                    hgm, hpar, out, nsub=nsub, nchan=hchan, nbin=hbin,
+                    nu0=1500.0, bw=800.0, tsub=60.0,
+                    phase=float(h_rng.uniform(-0.2, 0.2)),
+                    dDM=float(h_rng.normal(0, 1e-3)), noise_stds=0.01,
+                    dedispersed=False, seed=500 + 10 * si + r,
+                    quiet=True)
+                hfiles.append(out)
+        # generation order is already shape-interleaved (A,B,C,A,B,C):
+        # the cold run meets each shape before any repeats, the
+        # worst-case ordering for compile churn
+        _stage('hetero stress: cold run (%d archives, %d shapes)'
+               % (len(hfiles), len(shapes_mix)))
+        t0 = time.time()
+        gt = GetTOAs(hfiles, hgm, quiet=True)
+        gt.get_TOAs(bary=False, quiet=True)
+        cold = time.time() - t0
+        ntoa = len(gt.TOA_list)
+        _stage('hetero stress: cold %.1fs; warm run' % cold)
+        t0 = time.time()
+        gt2 = GetTOAs(hfiles, hgm, quiet=True)
+        gt2.get_TOAs(bary=False, quiet=True)
+        warm = time.time() - t0
+        _stage('hetero stress: warm %.1fs' % warm)
+        config = "+".join("%dx(%dx%dx%d)" % (reps, nsub, c, b)
+                          for c, b in shapes_mix)
+        return cold, warm, ntoa, config
+    finally:
+        shutil.rmtree(hdir, ignore_errors=True)
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -297,12 +372,17 @@ def main():
                                   lambda o: materialize(o.phi),
                                   'IPTA sweep')
 
-    # ---- ppalign batch (BASELINE '500 homogeneous archives', scaled) --
-    # 100 archives exercises the streaming-block host-memory bound
-    # (pipelines/align.py caps resident subints per block); generation
-    # (host-side FITS writing) is outside the timed region
-    n_arch = 100 if on_accel else 8
+    # ---- ppalign batch (BASELINE row 4: 500 homogeneous archives) -----
+    # the full 500-archive config, driver-captured every round (r04 ran
+    # 100 and left the 500-archive number to a PERF.md hand-run); the
+    # streaming blocks cap resident subints so host memory stays flat.
+    # Generation (host-side FITS writing) is outside the timed region
+    n_arch = 500 if on_accel else 8
     align_dur = _align_batch(n_arch=n_arch)
+
+    # ---- heterogeneous-shape GetTOAs stress (mixed channelizations) ---
+    hetero_cold, hetero_warm, hetero_ntoa, hetero_config = \
+        _hetero_stress(on_accel)
 
     # ---- rough sustained FLOP/s for the main config -------------------
     # per subint: rFFT (5 N log2 N per channel) + ~n_iter fused moment
@@ -342,6 +422,14 @@ def main():
             "ipta_config": f"{np_}x{ne}x{inchan}x{inbin}",
             "align_archives_per_sec": round(n_arch / align_dur, 3),
             "align_config": f"{n_arch}x4x64x256 incl. FITS IO",
+            "align_duration_sec": round(align_dur, 3),
+            "hetero_cold_sec": round(hetero_cold, 3),
+            "hetero_warm_sec": round(hetero_warm, 3),
+            "hetero_compile_overhead_sec": round(hetero_cold
+                                                 - hetero_warm, 3),
+            "hetero_toas_per_sec_warm": round(hetero_ntoa / hetero_warm,
+                                              3),
+            "hetero_config": hetero_config + " incl. FITS IO",
             "gflops_approx": round(float(gflops), 1),
         },
     }
