@@ -60,6 +60,23 @@ def _load_oracle():
     return mod
 
 
+def _bench_source(adir):
+    """One gmodel + ephemeris shared by every archive-producing bench
+    stage (align, hetero) — a single definition so the stages provably
+    bench the same pulsar."""
+    from pulseportraiture_tpu.io.gmodel import write_model
+
+    gm = os.path.join(adir, "b.gmodel")
+    write_model(gm, "bench", "000", 1500.0,
+                np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5]),
+                np.ones(8, int), -4.0, 0, quiet=True)
+    par = os.path.join(adir, "b.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    return gm, par
+
+
 def _align_batch(n_arch):
     """Generate, warm up, and time the ppalign batch config; the temp
     directory is removed even when a stage raises."""
@@ -67,20 +84,11 @@ def _align_batch(n_arch):
     import tempfile
 
     from pulseportraiture_tpu.io.archive import make_fake_pulsar
-    from pulseportraiture_tpu.io.gmodel import write_model
     from pulseportraiture_tpu.pipelines.align import align_archives
 
     adir = tempfile.mkdtemp(prefix="pp_bench_align_")
     try:
-        agm = os.path.join(adir, "b.gmodel")
-        write_model(agm, "bench", "000",
-                    1500.0, np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0,
-                                      -0.5]),
-                    np.ones(8, int), -4.0, 0, quiet=True)
-        apar = os.path.join(adir, "b.par")
-        with open(apar, "w") as f:
-            f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
-                    "PEPOCH 56000.0\nDM 30.0\n")
+        agm, apar = _bench_source(adir)
         a_rng = np.random.default_rng(4)
         afiles = []
         for i in range(n_arch):
@@ -130,7 +138,6 @@ def _hetero_stress(on_accel):
     import tempfile
 
     from pulseportraiture_tpu.io.archive import make_fake_pulsar
-    from pulseportraiture_tpu.io.gmodel import write_model
     from pulseportraiture_tpu.pipelines.toas import GetTOAs
 
     if on_accel:
@@ -141,14 +148,7 @@ def _hetero_stress(on_accel):
         nsub, reps = 2, 2
     hdir = tempfile.mkdtemp(prefix="pp_bench_hetero_")
     try:
-        hgm = os.path.join(hdir, "h.gmodel")
-        write_model(hgm, "bench", "000", 1500.0,
-                    np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5]),
-                    np.ones(8, int), -4.0, 0, quiet=True)
-        hpar = os.path.join(hdir, "h.par")
-        with open(hpar, "w") as f:
-            f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
-                    "PEPOCH 56000.0\nDM 30.0\n")
+        hgm, hpar = _bench_source(hdir)
         h_rng = np.random.default_rng(6)
         hfiles = []
         for r in range(reps):

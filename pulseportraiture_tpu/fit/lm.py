@@ -75,7 +75,7 @@ def lm_solve(residual_fn, x0, fit_flags=None, bounds=None, max_iter=100,
         trial = jnp.clip(x + step, lo, hi)
         r_t = res(trial)
         f_t = jnp.sum(r_t * r_t)
-        return trial, f_t
+        return trial, f_t, g, x + step
 
     state = dict(x=x0, f=f0, mu=jnp.asarray(1e-3),
                  done=jnp.asarray(False), it=jnp.asarray(0),
@@ -85,7 +85,7 @@ def lm_solve(residual_fn, x0, fit_flags=None, bounds=None, max_iter=100,
         return (~s["done"]) & (s["it"] < max_iter)
 
     def body(s):
-        trial, f_t = normal_step(s["x"], s["f"], s["mu"])
+        trial, f_t, g, raw_trial = normal_step(s["x"], s["f"], s["mu"])
         accept = f_t < s["f"]
         mu = jnp.where(accept, jnp.maximum(s["mu"] * 0.3, 1e-14),
                        s["mu"] * 5.0)
@@ -96,12 +96,24 @@ def lm_solve(residual_fn, x0, fit_flags=None, bounds=None, max_iter=100,
         f_conv = accept & (df <= ftol * jnp.maximum(f_new, 1.0))
         x_conv = accept & (dx <= xtol * jnp.maximum(
             jnp.max(jnp.abs(x_new)), 1.0))
+        # a REJECTED, unclipped step whose own predicted decrease
+        # (2 g . step for the gradient of sum r^2) is below ftol marks
+        # the arithmetic floor — without this the lane spirals mu to
+        # 1e12 (~25 rejected residual+Jacobian passes) before ``stuck``
+        # fires; under vmap every lane pays the slowest lane's spiral
+        # (same fix as portrait._solve).  Clipped or uphill proposals
+        # keep the mu-inflation path.
+        pred_dec = -2.0 * jnp.dot(g, trial - s["x"])
+        unclipped = jnp.all((raw_trial >= lo) & (raw_trial <= hi))
+        plateau = (~accept) & unclipped & (pred_dec >= 0.0) & \
+            (pred_dec <= ftol * jnp.maximum(s["f"], 1.0))
         stuck = (~accept) & (mu > 1e12)
-        rc = jnp.where(f_conv, 1, jnp.where(x_conv, 2,
-                                            jnp.where(stuck, 4, s["rc"])))
+        rc = jnp.where(f_conv | plateau, 1,
+                       jnp.where(x_conv, 2, jnp.where(stuck, 4,
+                                                      s["rc"])))
         return dict(x=x_new, f=f_new, mu=mu,
-                    done=f_conv | x_conv | stuck, it=s["it"] + 1,
-                    nfev=s["nfev"] + 2, rc=rc)
+                    done=f_conv | x_conv | plateau | stuck,
+                    it=s["it"] + 1, nfev=s["nfev"] + 2, rc=rc)
 
     out = jax.lax.while_loop(cond, body, state)
     x = out["x"]

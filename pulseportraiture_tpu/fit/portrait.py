@@ -692,10 +692,34 @@ def _solve(init_params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
         f_conv = accept & (df <= ftol * jnp.maximum(jnp.abs(f_new), 1.0))
         x_conv = accept & (dx <= xtol * jnp.maximum(jnp.max(jnp.abs(x_new)),
                                                     1.0))
+        # a REJECTED step whose own model predicts less than ftol of
+        # improvement (-g . step, the first-order decrease of the
+        # damped-Newton step actually taken) marks the arithmetic
+        # floor: without this, plateaued lanes spiral mu 1e-4 -> 1e12
+        # (~27 rejected trips, each a full moments pass) before
+        # terminating via ``stuck`` — the measured lockstep tail of
+        # the vmapped solve (nfev max 32 vs median 5; every lane in
+        # the chunk pays the slowest lane's spiral).  The predicted-
+        # decrease test distinguishes the floor from a ridge overshoot
+        # (|f_trial - f| small but g still large), where damped steps
+        # genuinely keep improving.
+        # pred_dec < 0 is an uphill proposal from an indefinite H far
+        # from the optimum — that lane must inflate mu and retry, not
+        # stop.  A bound-clipped step is excluded too: pred_dec then
+        # measures only the clipped movement, which can be tiny while
+        # large feasible descent remains in the unclipped coordinates
+        # (e.g. tau pinned at its lower bound with phi/DM still far) —
+        # such lanes keep the mu-inflation path, which decouples the
+        # coordinates as mu grows.
+        pred_dec = -jnp.dot(g, trial - x)
+        unclipped = jnp.all((x + step >= lo) & (x + step <= hi))
+        plateau = (~accept) & unclipped & (pred_dec >= 0.0) & \
+            (pred_dec <= ftol * jnp.maximum(jnp.abs(f), 1.0))
         stuck = (~accept) & (new_mu > mu_max)
-        done = f_conv | x_conv | stuck
-        rc = jnp.where(f_conv, 1, jnp.where(x_conv, 2,
-                                            jnp.where(stuck, 4, s["rc"])))
+        done = f_conv | x_conv | plateau | stuck
+        rc = jnp.where(f_conv | plateau, 1,
+                       jnp.where(x_conv, 2, jnp.where(stuck, 4,
+                                                      s["rc"])))
         return dict(x=x_new, f=f_new, g=g_new, H=H_new, mu=new_mu,
                     done=done, it=s["it"] + 1, nfev=s["nfev"] + 1, rc=rc)
 

@@ -131,7 +131,9 @@ def test_toa_write_and_filter(tmp_path):
     assert len(kept) == 1 and kept[0].flags["subint"] == 0
     out = str(tmp_path / "toas.tim")
     tf.write_TOAs(toas, outfile=out, append=False)
-    lines = open(out).read().strip().split("\n")
+    all_lines = open(out).read().strip().split("\n")
+    assert all_lines[0] == "FORMAT 1"  # IPTA header on fresh files
+    lines = all_lines[1:]
     assert len(lines) == 2
     assert "-pp_dm 30.0001234" in lines[0]
     assert "-pp_dme" in lines[0]
