@@ -1038,7 +1038,12 @@ class GetTOAs:
         NOTE: unexercised in this environment — no psrchive install
         exists here, so tests cover only the RuntimeError gate
         (tests/test_pipeline_toas.py); the pat-driving body has never
-        run against real bindings.
+        run against real bindings.  The independent cross-validation
+        this hook exists for is covered WITHOUT psrchive by
+        tests/test_timing_crossval.py: a from-the-spec tim parser +
+        GLS oracle (tests/timing_oracle.py, Decimal arithmetic + scipy
+        lstsq) validates the written tim format and the wideband GLS
+        against committed expected results.
         """
         try:
             import psrchive as pr
