@@ -15,7 +15,7 @@ import time
 
 import numpy as np
 
-from ..config import host_array, scattering_alpha
+from ..config import host_array, host_stats_device, scattering_alpha
 from ..fit.phase_shift import fit_phase_shift
 from ..fit.portrait import auto_scan_size, fit_portrait_full_batch
 from ..fit.transforms import guess_fit_freq, phase_transform
@@ -364,10 +364,17 @@ class GetTOAs:
             # reference frequencies for fit and output
             nu_means = (freqs_b * wok).sum(-1) / wok.sum(-1)
             if nu_fit_tuple is None:
-                nu_fit = np.array([
-                    float(np.asarray(guess_fit_freq(freqs_b[i][wok[i] > 0],
-                                                    SNRs_b[i][wok[i] > 0])))
-                    for i in range(B)])
+                # tiny per-subint reductions: pinned to the host device —
+                # through a remote-dispatch tunnel each device call costs
+                # a ~150-400 ms round trip, which at B calls per archive
+                # dominated the warm per-archive wall of the mixed-shape
+                # bench stage
+                with host_stats_device():
+                    nu_fit = np.array([
+                        float(np.asarray(guess_fit_freq(
+                            freqs_b[i][wok[i] > 0],
+                            SNRs_b[i][wok[i] > 0])))
+                        for i in range(B)])
                 nu_fits_b = np.stack([nu_fit, nu_fit, nu_fit], axis=1)
             else:
                 nu_fits_b = np.tile([nu_fit_tuple[0], nu_fit_tuple[0],
@@ -393,15 +400,16 @@ class GetTOAs:
 
             # -- initial guesses (batched) ------------------------------
             DM_guess = DM_stored
-            # per-subint nu_mean reference: fold it into the shift by
-            # rotating each subint with its own nu_ref via broadcasting
-            rot_ports = np.stack([
-                np.asarray(rotate_data(ports[i], 0.0, DM_guess,
-                                       float(Ps_b[i]), freqs_b[i],
-                                       float(nu_means[i])))
-                for i in range(B)]) if not same_freqs else \
-                np.asarray(rotate_data(ports, 0.0, DM_guess, Ps_b,
-                                       freqs_b, float(nu_means[0])))
+            # per-subint nu_mean reference folded into the shift via
+            # broadcasting (nu_ref [B, 1] against freqs [B, nchan]):
+            # ONE batched device call for the whole archive — the
+            # previous per-subint loop paid B dispatch round trips
+            # through the remote tunnel, and the same_freqs fast path
+            # referenced every row to nu_means[0] while the downstream
+            # phase_transform assumed each row's own nu_means[i]
+            rot_ports = np.asarray(rotate_data(ports, 0.0, DM_guess,
+                                               Ps_b, freqs_b,
+                                               nu_means[:, None]))
             # weighted band-average profiles
             rot_profs = (rot_ports * wok[..., None]).sum(1) / \
                 wok.sum(-1)[:, None]
