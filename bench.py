@@ -128,11 +128,13 @@ def _hetero_stress(on_accel):
     homogeneous bench sees; the cold-warm split measures exactly that
     (the reference's serial per-archive loop has no analogue —
     /root/reference/pptoas.py:246-346 handles mixed shapes trivially
-    because nothing is compiled).  Same-shape archives share one
-    compiled program via the jit cache regardless of metafile order, so
-    no explicit shape-bucketing is needed; the persistent XLA cache
-    (enable_compile_cache) additionally carries the programs across
-    bench runs.
+    because nothing is compiled).  Two mitigations are exercised here:
+    same-(nchan, nbin) archives share programs via the jit cache
+    regardless of metafile order, and differing subint counts land in
+    one power-of-two batch bucket (fit_portrait_full_batch(pad_to=...),
+    GetTOAs' default — the reps deliberately use different nsub); the
+    persistent XLA cache additionally carries programs across bench
+    runs.
     """
     import shutil
     import tempfile
@@ -142,10 +144,11 @@ def _hetero_stress(on_accel):
 
     if on_accel:
         shapes_mix = [(64, 512), (128, 1024), (512, 2048)]
-        nsub, reps = 4, 2
+        nsub_list = (5, 7)  # differ per rep; one power-of-two bucket (8)
     else:
         shapes_mix = [(16, 128), (32, 256), (64, 512)]
-        nsub, reps = 2, 2
+        nsub_list = (2, 3)  # shared bucket 4
+    reps = len(nsub_list)
     hdir = tempfile.mkdtemp(prefix="pp_bench_hetero_")
     try:
         hgm, hpar = _bench_source(hdir)
@@ -155,7 +158,8 @@ def _hetero_stress(on_accel):
             for si, (hchan, hbin) in enumerate(shapes_mix):
                 out = os.path.join(hdir, "h%d_%d.fits" % (si, r))
                 make_fake_pulsar(
-                    hgm, hpar, out, nsub=nsub, nchan=hchan, nbin=hbin,
+                    hgm, hpar, out, nsub=nsub_list[r], nchan=hchan,
+                    nbin=hbin,
                     nu0=1500.0, bw=800.0, tsub=60.0,
                     phase=float(h_rng.uniform(-0.2, 0.2)),
                     dDM=float(h_rng.normal(0, 1e-3)), noise_stds=0.01,
@@ -178,8 +182,9 @@ def _hetero_stress(on_accel):
         gt2.get_TOAs(bary=False, quiet=True)
         warm = time.time() - t0
         _stage('hetero stress: warm %.1fs' % warm)
-        config = "+".join("%dx(%dx%dx%d)" % (reps, nsub, c, b)
-                          for c, b in shapes_mix)
+        config = "+".join(
+            "(%sx%dx%d)" % ("/".join(map(str, nsub_list)), c, b)
+            for c, b in shapes_mix)
         return cold, warm, ntoa, config
     finally:
         shutil.rmtree(hdir, ignore_errors=True)

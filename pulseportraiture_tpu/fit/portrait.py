@@ -82,6 +82,29 @@ def auto_scan_size(batch_size, profiles=False):
     return size if batch_size > threshold else None
 
 
+def bucket_batch_size(batch_size, lo=4):
+    """Shape-bucketed batch size: next power of two (>= ``lo``).
+
+    Pass as ``pad_to`` to fit_portrait_full_batch so small batches
+    with different subint counts share one compiled program per bucket
+    — without it every distinct B compiles its own program, and
+    through a remote-compile tunnel a mixed-survey metafile pays
+    minutes per new shape (the hetero bench stage measures this).  The
+    padded rows (copies of the last subint) waste at most 2x of a
+    small batch's compute above ``lo`` (up to lo/B below it — B=1 pads
+    to 4), orders below one compile.  Scan-engaged batches are not
+    bucketed here: their per-chunk program is shaped by scan_size, but
+    the scan's trip count still varies with the padded chunk COUNT, so
+    archives with different chunk counts compile separately (bucketing
+    that axis would pad up to 2x of a LARGE batch's real compute —
+    not worth it).
+    """
+    b = int(batch_size)
+    if b <= lo:
+        return lo
+    return 1 << (b - 1).bit_length()
+
+
 def _phase_shift_derivs(freqs, nu_DM, nu_GM, P):
     """[3, nchan] gradient of per-channel phase shifts wrt (phi, DM, GM)."""
     dphi = jnp.ones_like(freqs)
@@ -1127,7 +1150,8 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
                             kmax=None, scan_size=None, cast=None,
                             polish_iter=None, seed=None,
                             scat_hint=None, coarse_kmax=None,
-                            coarse_iter=None, data_spectra=None):
+                            coarse_iter=None, data_spectra=None,
+                            pad_to=None):
     """vmapped+jitted fit over a batch of subints: data [B, nchan, nbin].
 
     model_ports/freqs broadcast over the batch; returns a DataBunch of
@@ -1164,6 +1188,10 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
     vmapped while_loop runs to the SLOWEST lane; Newton convergence
     from the f32 plateau typically takes 2-3 steps).  None = the full
     ``max_iter`` budget.
+
+    ``pad_to``: pad the batch up to this size (copies of the last
+    subint, dropped from the outputs) so different batch sizes share
+    one compiled program per bucket — see ``bucket_batch_size``.
     """
     # static harmonic cutoff from the (concrete, pre-broadcast) model
     if kmax is None:
@@ -1239,16 +1267,22 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
         nu_outs_b = jnp.broadcast_to(jnp.asarray(nu_outs,
                                                  dtype=jnp.float64),
                                      (B, 3))
+    # target batch shape: ``pad_to`` buckets small batches (shape
+    # sharing across archives with different subint counts, see
+    # bucket_batch_size); scan rounds up to a chunk multiple
+    target = B if pad_to is None else max(B, int(pad_to))
     if scan_size is not None:
         scan_size = int(scan_size)
-        if B <= scan_size:
+        if target <= scan_size:
             scan_size = None
+        elif target % scan_size != 0:
+            target = -(-target // scan_size) * scan_size
     batched = [data_ports, init_b, Ps_b, freqs_b, errs_b, weights_b,
                nu_fits_b, nu_outs_b]
     if model_ports.ndim == 3:
         batched.insert(1, model_ports)
-    if scan_size is not None and B % scan_size != 0:
-        pad = scan_size - B % scan_size
+    if target != B:
+        pad = target - B
 
         def _pad(a):
             return jnp.concatenate(

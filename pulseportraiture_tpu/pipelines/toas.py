@@ -17,7 +17,8 @@ import numpy as np
 
 from ..config import host_array, host_stats_device, scattering_alpha
 from ..fit.phase_shift import fit_phase_shift
-from ..fit.portrait import auto_scan_size, fit_portrait_full_batch
+from ..fit.portrait import (auto_scan_size, bucket_batch_size,
+                            fit_portrait_full_batch)
 from ..fit.transforms import guess_fit_freq, phase_transform
 from ..io.archive import file_is_type, load_data, parse_metafile
 from ..io.gmodel import read_model
@@ -354,7 +355,7 @@ class GetTOAs:
             Ps_b = d.Ps[ok]
             wok = (weights_b > 0.0).astype(np.float64)
 
-            models_b, same_freqs = self._prepare_models(
+            models_b, _ = self._prepare_models(
                 d, ports, freqs_b, Ps_b, fit_scat,
                 add_instrumental_response, datafile)
             if models_b is None:
@@ -404,9 +405,10 @@ class GetTOAs:
             # broadcasting (nu_ref [B, 1] against freqs [B, nchan]):
             # ONE batched device call for the whole archive — the
             # previous per-subint loop paid B dispatch round trips
-            # through the remote tunnel, and the same_freqs fast path
-            # referenced every row to nu_means[0] while the downstream
-            # phase_transform assumed each row's own nu_means[i]
+            # through the remote tunnel, and the removed same-freqs
+            # fast path referenced every row to nu_means[0] while the
+            # downstream phase_transform assumed each row's own
+            # nu_means[i]
             rot_ports = np.asarray(rotate_data(ports, 0.0, DM_guess,
                                                Ps_b, freqs_b,
                                                nu_means[:, None]))
@@ -480,7 +482,12 @@ class GetTOAs:
                 # chunked scan: the compile footprint stays that of a
                 # 100-subint program (bigger monolithic batches can
                 # exhaust the compiler) while the whole archive stays
-                # one device dispatch
+                # one device dispatch.  Small batches are padded to a
+                # power-of-two bucket instead so archives with
+                # different subint counts share compiled programs — a
+                # mixed-survey metafile otherwise pays one multi-minute
+                # remote compile per distinct nsub
+                scan = auto_scan_size(len(sel))
                 out = fit_portrait_full_batch(
                     ports[sel], models_b[sel], init[sel], Ps_b[sel],
                     freqs_b[sel], errs=errs_b[sel],
@@ -490,8 +497,9 @@ class GetTOAs:
                         None if col is None else col[sel]
                         for col in nu_outs_b),
                     bounds=bounds_eff, log10_tau=log10_tau,
-                    max_iter=max_iter,
-                    scan_size=auto_scan_size(len(sel)),
+                    max_iter=max_iter, scan_size=scan,
+                    pad_to=None if scan is not None
+                    else bucket_batch_size(len(sel)),
                     polish_iter=polish_iter, coarse_iter=coarse_iter,
                     coarse_kmax=coarse_kmax)
                 for j, i in enumerate(idxs):
@@ -798,7 +806,7 @@ class GetTOAs:
             Ps_b = d.Ps[ok]
             wok = (weights_b > 0.0).astype(np.float64)
 
-            models_b, same_freqs = self._prepare_models(
+            models_b, _ = self._prepare_models(
                 d, ports, freqs_b, Ps_b, fit_scat,
                 add_instrumental_response, datafile)
             if models_b is None:
@@ -869,15 +877,16 @@ class GetTOAs:
                     bounds_eff = [tuple(bounds[0]), (None, None),
                                   (None, None), tuple(bounds[1]),
                                   (-10.0, 10.0)]
+                nb_scan = auto_scan_size(len(profs), profiles=True)
                 out = fit_portrait_full_batch(
                     profs[:, None, :], mods[:, None, :], init, Psx,
                     nusx[:, None], errs=errsx[:, None],
                     fit_flags=(1, 0, 0, 1, 0),
                     nu_fits=np.stack([nusx] * 3, axis=1),
                     bounds=bounds_eff, log10_tau=log10_tau,
-                    max_iter=max_iter,
-                    scan_size=auto_scan_size(len(profs),
-                                             profiles=True),
+                    max_iter=max_iter, scan_size=nb_scan,
+                    pad_to=None if nb_scan is not None
+                    else bucket_batch_size(len(profs)),
                     polish_iter=polish_iter, coarse_iter=coarse_iter,
                     coarse_kmax=coarse_kmax)
                 phis_fit = np.asarray(out["phi"])

@@ -326,6 +326,49 @@ def test_pair_path_matches_complex128():
     assert abs(10 ** float(s_p.tau) - 3e-3) / 3e-3 < 0.1
 
 
+def test_pad_to_bucketing_matches_plain_batch(rng):
+    """pad_to pads the batch with copies of the last subint and drops
+    them from the outputs: results identical to the unpadded batch, and
+    different batch sizes in one bucket share a compiled program."""
+    model = make_model()
+    phis = rng.uniform(-0.2, 0.2, 7)
+    datas = np.stack([
+        np.asarray(rotate_data(model, -phis[i], 0.0, P0, FREQS,
+                               np.mean(FREQS))) for i in range(7)])
+    datas = datas + rng.normal(0, 0.01, datas.shape)
+    weights = np.ones((7, NCHAN))
+    weights[2, 5] = 0.0  # a zapped channel must survive the padding
+    kw = dict(errs=np.full((7, NCHAN), 0.01), weights=weights,
+              fit_flags=(1, 1, 0, 0, 0), log10_tau=False, max_iter=50)
+    init = np.zeros((7, 5))
+    init[:, 0] = phis
+    ref = fp.fit_portrait_full_batch(datas, model[None], init, P0, FREQS,
+                                     **kw)
+    padded = fp.fit_portrait_full_batch(datas, model[None], init, P0,
+                                        FREQS, pad_to=8, **kw)
+    assert padded.phi.shape == (7,)
+    np.testing.assert_allclose(np.asarray(padded.phi),
+                               np.asarray(ref.phi), rtol=0, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(padded.DM),
+                               np.asarray(ref.DM), rtol=0, atol=1e-12)
+    # bucket sizing: powers of two with a floor
+    assert fp.bucket_batch_size(1) == 4
+    assert fp.bucket_batch_size(4) == 4
+    assert fp.bucket_batch_size(5) == 8
+    assert fp.bucket_batch_size(9) == 16
+    # two batch sizes in one bucket reuse the same compiled program
+    kw5 = {**kw, "errs": kw["errs"][:5], "weights": weights[:5]}
+    n0 = fp._batch_impl._cache_size()
+    fp.fit_portrait_full_batch(datas[:5], model[None], init[:5], P0,
+                               FREQS, pad_to=8, **kw5)
+    n1 = fp._batch_impl._cache_size()
+    kw6 = {**kw, "errs": kw["errs"][:6], "weights": weights[:6]}
+    fp.fit_portrait_full_batch(datas[:6], model[None], init[:6], P0,
+                               FREQS, pad_to=8, **kw6)
+    assert fp._batch_impl._cache_size() == n1  # 6 reused the 8-bucket
+    assert n1 == n0 + 1 or n0 == n1  # (7->8 above may already cache it)
+
+
 def test_fast32_chi2_survives_dc_baseline(rng):
     """fast32's chi2 normalization (Sd) must not catastrophically cancel
     on data with a large un-removed DC baseline: nbin*sum(x^2) - X0^2 in
