@@ -11,8 +11,10 @@ tim writing) carries over.
 """
 
 import os
+import sys
 import time
 
+import jax
 import numpy as np
 
 from ..config import host_array, host_stats_device, scattering_alpha
@@ -168,6 +170,10 @@ class GetTOAs:
         self.quiet = quiet
         self.instrumental_response_dict = self.ird = \
             {"DM": 0.0, "wids": [], "irf_types": []}
+        # archives dropped by the degraded modes: (datafile, reason) —
+        # load failures stay silent-but-skipped as before; device/
+        # tunnel failures are recorded here
+        self.failed_datafiles = []
         # per-archive result lists (names per the reference)
         for attr in ["order", "obs", "doppler_fs", "nu0s", "nu_fits",
                      "nu_refs", "ok_idatafiles", "ok_isubs", "epochs",
@@ -355,157 +361,170 @@ class GetTOAs:
             Ps_b = d.Ps[ok]
             wok = (weights_b > 0.0).astype(np.float64)
 
-            models_b, _ = self._prepare_models(
-                d, ports, freqs_b, Ps_b, fit_scat,
-                add_instrumental_response, datafile)
-            if models_b is None:
+            # transient device/tunnel failures (the remote-
+            # compile tunnel here has died mid-run for hours at
+            # a time) must not kill a many-archive survey run:
+            # the archive is recorded on failed_datafiles and
+            # skipped, like any other unreadable archive
+            n_okid = len(self.ok_idatafiles)
+            try:
+                models_b, _ = self._prepare_models(
+                    d, ports, freqs_b, Ps_b, fit_scat,
+                    add_instrumental_response, datafile)
+                if models_b is None:
+                    continue
+                self.ok_idatafiles.append(iarch)
+
+                # reference frequencies for fit and output
+                nu_means = (freqs_b * wok).sum(-1) / wok.sum(-1)
+                if nu_fit_tuple is None:
+                    # tiny per-subint reductions: pinned to the host device —
+                    # through a remote-dispatch tunnel each device call costs
+                    # a ~150-400 ms round trip, which at B calls per archive
+                    # dominated the warm per-archive wall of the mixed-shape
+                    # bench stage
+                    with host_stats_device():
+                        nu_fit = np.array([
+                            float(np.asarray(guess_fit_freq(
+                                freqs_b[i][wok[i] > 0],
+                                SNRs_b[i][wok[i] > 0])))
+                            for i in range(B)])
+                    nu_fits_b = np.stack([nu_fit, nu_fit, nu_fit], axis=1)
+                else:
+                    nu_fits_b = np.tile([nu_fit_tuple[0], nu_fit_tuple[0],
+                                         nu_fit_tuple[-1]], (B, 1))
+                if nu_ref_tuple is None:
+                    nu_outs_b = None
+                else:
+                    nu_ref_DM = nu_ref_tuple[0]
+                    nu_ref_tau = nu_ref_tuple[-1]
+                    # bary: the requested (barycentric) tau reference maps to
+                    # a per-subint topocentric one (pptoas.py:410-415)
+                    if bary and nu_ref_tau:
+                        taus_ref = nu_ref_tau / d.doppler_factors[ok]
+                    else:
+                        taus_ref = np.full(B, np.nan if nu_ref_tau is None
+                                           else nu_ref_tau)
+                    col = np.full(B, np.nan if nu_ref_DM is None
+                                  else nu_ref_DM)
+                    nu_outs_b = (
+                        None if nu_ref_DM is None else col,
+                        None if nu_ref_DM is None else col,
+                        None if nu_ref_tau is None else taus_ref)
+
+                # -- initial guesses (batched) ------------------------------
+                DM_guess = DM_stored
+                # per-subint nu_mean reference folded into the shift via
+                # broadcasting (nu_ref [B, 1] against freqs [B, nchan]):
+                # ONE batched device call for the whole archive — the
+                # previous per-subint loop paid B dispatch round trips
+                # through the remote tunnel, and the removed same-freqs
+                # fast path referenced every row to nu_means[0] while the
+                # downstream phase_transform assumed each row's own
+                # nu_means[i]
+                rot_ports = np.asarray(rotate_data(ports, 0.0, DM_guess,
+                                                   Ps_b, freqs_b,
+                                                   nu_means[:, None]))
+                # weighted band-average profiles
+                rot_profs = (rot_ports * wok[..., None]).sum(1) / \
+                    wok.sum(-1)[:, None]
+                model_profs = (models_b * wok[..., None]).sum(1) / \
+                    wok.sum(-1)[:, None]
+                tau_guess = np.zeros(B)
+                alpha_guess = np.zeros(B)
+                if fit_scat:
+                    if self.scat_guess is not None:
+                        tg_s, tg_ref, ag = self.scat_guess
+                        tau_guess[:] = (tg_s / Ps_b) * \
+                            (nu_fits_b[:, 2] / tg_ref) ** ag
+                        alpha_guess[:] = ag
+                    else:
+                        alpha_guess[:] = getattr(self, "alpha",
+                                                 scattering_alpha)
+                        if hasattr(self, "gparams"):
+                            tau_guess[:] = (self.gparams[1] / Ps_b) * \
+                                (nu_fits_b[:, 2] / self.model_nu_ref) \
+                                ** alpha_guess
+                    # scatter the model mean profile for the phase guess
+                    taus_g = np.asarray(scattering_times(
+                        tau_guess, alpha_guess, nu_fits_b[:, 2],
+                        nu_fits_b[:, 2]))
+                    spFT = host_array(scattering_portrait_FT(taus_g, nbin))
+                    model_profs = np.fft.irfft(
+                        spFT * np.fft.rfft(model_profs, axis=-1), nbin,
+                        axis=-1)
+                    if log10_tau:
+                        tau_guess = np.log10(np.where(tau_guess == 0.0,
+                                                      1.0 / nbin, tau_guess))
+                guess = fit_phase_shift(rot_profs, model_profs,
+                                        noise=np.asarray(
+                                            np.median(errs_b, axis=-1)),
+                                        Ns=100)
+                phi_guess = np.asarray(phase_transform(
+                    np.asarray(guess.phase), DM_guess, nu_means,
+                    nu_fits_b[:, 0], Ps_b, mod=True))
+                init = np.stack([phi_guess, np.full(B, DM_guess),
+                                 np.zeros(B), tau_guess, alpha_guess], axis=1)
+
+                if bounds is None:
+                    tau_lo = np.log10(1.0 / (10 * nbin)) if log10_tau else 0.0
+                    bounds_eff = [(None, None), (None, None), (None, None),
+                                  (tau_lo, None), (-10.0, 10.0)] \
+                        if fit_scat else None
+                else:
+                    bounds_eff = bounds
+
+                # -- degraded modes: group subints by effective fit flags ---
+                nchanx = wok.sum(-1).astype(int)
+                flags_groups = {}
+                flags_used = [None] * B
+                for i in range(B):
+                    if nchanx[i] == 1:
+                        fl = (1, 0, 0, 0, 0)
+                    elif nchanx[i] == 2 and fit_DM and fit_GM:
+                        fl = (1, 1, 0, self.fit_flags[3], self.fit_flags[4])
+                    else:
+                        fl = tuple(self.fit_flags)
+                    flags_used[i] = fl
+                    flags_groups.setdefault(fl, []).append(i)
+
+                results = [None] * B
+                for fl, idxs in flags_groups.items():
+                    sel = np.asarray(idxs)
+                    # long observations (hundreds of subints) run as a
+                    # chunked scan: the compile footprint stays that of a
+                    # 100-subint program (bigger monolithic batches can
+                    # exhaust the compiler) while the whole archive stays
+                    # one device dispatch.  Small batches are padded to a
+                    # power-of-two bucket instead so archives with
+                    # different subint counts share compiled programs — a
+                    # mixed-survey metafile otherwise pays one multi-minute
+                    # remote compile per distinct nsub
+                    scan = auto_scan_size(len(sel))
+                    out = fit_portrait_full_batch(
+                        ports[sel], models_b[sel], init[sel], Ps_b[sel],
+                        freqs_b[sel], errs=errs_b[sel],
+                        weights=weights_b[sel], fit_flags=fl,
+                        nu_fits=nu_fits_b[sel],
+                        nu_outs=None if nu_outs_b is None else tuple(
+                            None if col is None else col[sel]
+                            for col in nu_outs_b),
+                        bounds=bounds_eff, log10_tau=log10_tau,
+                        max_iter=max_iter, scan_size=scan,
+                        pad_to=None if scan is not None
+                        else bucket_batch_size(len(sel)),
+                        polish_iter=polish_iter, coarse_iter=coarse_iter,
+                        coarse_kmax=coarse_kmax)
+                    for j, i in enumerate(idxs):
+                        results[i] = {key: np.asarray(val)[j]
+                                      for key, val in out.items()}
+                fit_duration = time.time() - fit_start
+            except jax.errors.JaxRuntimeError as e:
+                del self.ok_idatafiles[n_okid:]
+                self.failed_datafiles.append((datafile, str(e)))
+                print(f"Device error fitting {datafile}: {e}; "
+                      "skipping it.", file=sys.stderr)
                 continue
-            self.ok_idatafiles.append(iarch)
-
-            # reference frequencies for fit and output
-            nu_means = (freqs_b * wok).sum(-1) / wok.sum(-1)
-            if nu_fit_tuple is None:
-                # tiny per-subint reductions: pinned to the host device —
-                # through a remote-dispatch tunnel each device call costs
-                # a ~150-400 ms round trip, which at B calls per archive
-                # dominated the warm per-archive wall of the mixed-shape
-                # bench stage
-                with host_stats_device():
-                    nu_fit = np.array([
-                        float(np.asarray(guess_fit_freq(
-                            freqs_b[i][wok[i] > 0],
-                            SNRs_b[i][wok[i] > 0])))
-                        for i in range(B)])
-                nu_fits_b = np.stack([nu_fit, nu_fit, nu_fit], axis=1)
-            else:
-                nu_fits_b = np.tile([nu_fit_tuple[0], nu_fit_tuple[0],
-                                     nu_fit_tuple[-1]], (B, 1))
-            if nu_ref_tuple is None:
-                nu_outs_b = None
-            else:
-                nu_ref_DM = nu_ref_tuple[0]
-                nu_ref_tau = nu_ref_tuple[-1]
-                # bary: the requested (barycentric) tau reference maps to
-                # a per-subint topocentric one (pptoas.py:410-415)
-                if bary and nu_ref_tau:
-                    taus_ref = nu_ref_tau / d.doppler_factors[ok]
-                else:
-                    taus_ref = np.full(B, np.nan if nu_ref_tau is None
-                                       else nu_ref_tau)
-                col = np.full(B, np.nan if nu_ref_DM is None
-                              else nu_ref_DM)
-                nu_outs_b = (
-                    None if nu_ref_DM is None else col,
-                    None if nu_ref_DM is None else col,
-                    None if nu_ref_tau is None else taus_ref)
-
-            # -- initial guesses (batched) ------------------------------
-            DM_guess = DM_stored
-            # per-subint nu_mean reference folded into the shift via
-            # broadcasting (nu_ref [B, 1] against freqs [B, nchan]):
-            # ONE batched device call for the whole archive — the
-            # previous per-subint loop paid B dispatch round trips
-            # through the remote tunnel, and the removed same-freqs
-            # fast path referenced every row to nu_means[0] while the
-            # downstream phase_transform assumed each row's own
-            # nu_means[i]
-            rot_ports = np.asarray(rotate_data(ports, 0.0, DM_guess,
-                                               Ps_b, freqs_b,
-                                               nu_means[:, None]))
-            # weighted band-average profiles
-            rot_profs = (rot_ports * wok[..., None]).sum(1) / \
-                wok.sum(-1)[:, None]
-            model_profs = (models_b * wok[..., None]).sum(1) / \
-                wok.sum(-1)[:, None]
-            tau_guess = np.zeros(B)
-            alpha_guess = np.zeros(B)
-            if fit_scat:
-                if self.scat_guess is not None:
-                    tg_s, tg_ref, ag = self.scat_guess
-                    tau_guess[:] = (tg_s / Ps_b) * \
-                        (nu_fits_b[:, 2] / tg_ref) ** ag
-                    alpha_guess[:] = ag
-                else:
-                    alpha_guess[:] = getattr(self, "alpha",
-                                             scattering_alpha)
-                    if hasattr(self, "gparams"):
-                        tau_guess[:] = (self.gparams[1] / Ps_b) * \
-                            (nu_fits_b[:, 2] / self.model_nu_ref) \
-                            ** alpha_guess
-                # scatter the model mean profile for the phase guess
-                taus_g = np.asarray(scattering_times(
-                    tau_guess, alpha_guess, nu_fits_b[:, 2],
-                    nu_fits_b[:, 2]))
-                spFT = host_array(scattering_portrait_FT(taus_g, nbin))
-                model_profs = np.fft.irfft(
-                    spFT * np.fft.rfft(model_profs, axis=-1), nbin,
-                    axis=-1)
-                if log10_tau:
-                    tau_guess = np.log10(np.where(tau_guess == 0.0,
-                                                  1.0 / nbin, tau_guess))
-            guess = fit_phase_shift(rot_profs, model_profs,
-                                    noise=np.asarray(
-                                        np.median(errs_b, axis=-1)),
-                                    Ns=100)
-            phi_guess = np.asarray(phase_transform(
-                np.asarray(guess.phase), DM_guess, nu_means,
-                nu_fits_b[:, 0], Ps_b, mod=True))
-            init = np.stack([phi_guess, np.full(B, DM_guess),
-                             np.zeros(B), tau_guess, alpha_guess], axis=1)
-
-            if bounds is None:
-                tau_lo = np.log10(1.0 / (10 * nbin)) if log10_tau else 0.0
-                bounds_eff = [(None, None), (None, None), (None, None),
-                              (tau_lo, None), (-10.0, 10.0)] \
-                    if fit_scat else None
-            else:
-                bounds_eff = bounds
-
-            # -- degraded modes: group subints by effective fit flags ---
-            nchanx = wok.sum(-1).astype(int)
-            flags_groups = {}
-            flags_used = [None] * B
-            for i in range(B):
-                if nchanx[i] == 1:
-                    fl = (1, 0, 0, 0, 0)
-                elif nchanx[i] == 2 and fit_DM and fit_GM:
-                    fl = (1, 1, 0, self.fit_flags[3], self.fit_flags[4])
-                else:
-                    fl = tuple(self.fit_flags)
-                flags_used[i] = fl
-                flags_groups.setdefault(fl, []).append(i)
-
-            results = [None] * B
-            for fl, idxs in flags_groups.items():
-                sel = np.asarray(idxs)
-                # long observations (hundreds of subints) run as a
-                # chunked scan: the compile footprint stays that of a
-                # 100-subint program (bigger monolithic batches can
-                # exhaust the compiler) while the whole archive stays
-                # one device dispatch.  Small batches are padded to a
-                # power-of-two bucket instead so archives with
-                # different subint counts share compiled programs — a
-                # mixed-survey metafile otherwise pays one multi-minute
-                # remote compile per distinct nsub
-                scan = auto_scan_size(len(sel))
-                out = fit_portrait_full_batch(
-                    ports[sel], models_b[sel], init[sel], Ps_b[sel],
-                    freqs_b[sel], errs=errs_b[sel],
-                    weights=weights_b[sel], fit_flags=fl,
-                    nu_fits=nu_fits_b[sel],
-                    nu_outs=None if nu_outs_b is None else tuple(
-                        None if col is None else col[sel]
-                        for col in nu_outs_b),
-                    bounds=bounds_eff, log10_tau=log10_tau,
-                    max_iter=max_iter, scan_size=scan,
-                    pad_to=None if scan is not None
-                    else bucket_batch_size(len(sel)),
-                    polish_iter=polish_iter, coarse_iter=coarse_iter,
-                    coarse_kmax=coarse_kmax)
-                for j, i in enumerate(idxs):
-                    results[i] = {key: np.asarray(val)[j]
-                                  for key, val in out.items()}
-            fit_duration = time.time() - fit_start
 
             # -- assemble per-archive outputs ---------------------------
             nu_refs_arr = np.zeros([nsub, 3])
@@ -806,107 +825,120 @@ class GetTOAs:
             Ps_b = d.Ps[ok]
             wok = (weights_b > 0.0).astype(np.float64)
 
-            models_b, _ = self._prepare_models(
-                d, ports, freqs_b, Ps_b, fit_scat,
-                add_instrumental_response, datafile)
-            if models_b is None:
+            # transient device/tunnel failures (the remote-
+            # compile tunnel here has died mid-run for hours at
+            # a time) must not kill a many-archive survey run:
+            # the archive is recorded on failed_datafiles and
+            # skipped, like any other unreadable archive
+            n_okid = len(self.ok_idatafiles)
+            try:
+                models_b, _ = self._prepare_models(
+                    d, ports, freqs_b, Ps_b, fit_scat,
+                    add_instrumental_response, datafile)
+                if models_b is None:
+                    continue
+                self.ok_idatafiles.append(iarch)
+
+                # flatten live (subint, channel) pairs into one fit batch
+                jj, cc = np.nonzero(wok)                      # [M], [M]
+                sub_idx = ok[jj]                 # archive subint index per fit
+                profs = ports[jj, cc]                         # [M, nbin]
+                mods = np.ascontiguousarray(models_b[jj, cc])
+                errsx = errs_b[jj, cc]
+                nusx = freqs_b[jj, cc]
+                Psx = Ps_b[jj]
+                M = len(jj)
+
+                taus_fit = np.zeros(M)
+                tau_errs_fit = np.zeros(M)
+                covariances = np.zeros([nsub, nchan, self.nfit, self.nfit])
+                nfevals = np.zeros([nsub, nchan], dtype=int)
+                rcs_a = np.zeros([nsub, nchan], dtype=int)
+                # caller bounds follow the reference's [(phi), (tau)] contract
+                phi_bounds = (-0.5, 0.5)
+                if bounds is not None and bounds[0] is not None \
+                        and None not in bounds[0]:
+                    phi_bounds = tuple(bounds[0])
+                if not fit_scat:
+                    r = fit_phase_shift(profs, mods, noise=errsx,
+                                        bounds=phi_bounds, Ns=100)
+                    phis_fit = np.asarray(r.phase)
+                    phi_errs_fit = np.asarray(r.phase_err)
+                    scales_fit = np.asarray(r.scale)
+                    scale_errs_fit = np.asarray(r.scale_err)
+                    snrs_fit = np.asarray(r.snr)
+                    red_chi2s_fit = np.asarray(r.red_chi2)
+                else:
+                    # per-channel tau guess at each channel's frequency
+                    alpha_guess = getattr(self, "alpha", scattering_alpha)
+                    if self.scat_guess is not None:
+                        tg_s, tg_ref, alpha_guess = self.scat_guess
+                        tau_g = (tg_s / Psx) * (nusx / tg_ref) ** alpha_guess
+                    elif hasattr(self, "gparams"):
+                        tau_g = (self.gparams[1] / Psx) * \
+                            (nusx / self.model_nu_ref) ** alpha_guess
+                    else:
+                        tau_g = np.zeros(M)
+                    # phase guess vs the scattered model
+                    taus_g = np.asarray(scattering_times(tau_g, alpha_guess,
+                                                         nusx, nusx))
+                    spFT = host_array(scattering_portrait_FT(taus_g, nbin))
+                    mods_scat = np.fft.irfft(spFT * np.fft.rfft(mods, axis=-1),
+                                             nbin, axis=-1)
+                    guess = fit_phase_shift(profs, mods_scat, noise=errsx,
+                                            Ns=100)
+                    if log10_tau:
+                        tau_g = np.log10(np.where(tau_g == 0.0, 1.0 / nbin,
+                                                  tau_g))
+                    init = np.stack([np.asarray(guess.phase),
+                                     np.full(M, d.DM), np.zeros(M), tau_g,
+                                     np.full(M, alpha_guess)], axis=1)
+                    if bounds is None:
+                        tau_lo = np.log10(1.0 / (10 * nbin)) if log10_tau \
+                            else 0.0
+                        bounds_eff = [(None, None), (None, None),
+                                      (None, None), (tau_lo, None),
+                                      (-10.0, 10.0)]
+                    else:
+                        bounds_eff = [tuple(bounds[0]), (None, None),
+                                      (None, None), tuple(bounds[1]),
+                                      (-10.0, 10.0)]
+                    nb_scan = auto_scan_size(len(profs), profiles=True)
+                    out = fit_portrait_full_batch(
+                        profs[:, None, :], mods[:, None, :], init, Psx,
+                        nusx[:, None], errs=errsx[:, None],
+                        fit_flags=(1, 0, 0, 1, 0),
+                        nu_fits=np.stack([nusx] * 3, axis=1),
+                        bounds=bounds_eff, log10_tau=log10_tau,
+                        max_iter=max_iter, scan_size=nb_scan,
+                        pad_to=None if nb_scan is not None
+                        else bucket_batch_size(len(profs)),
+                        polish_iter=polish_iter, coarse_iter=coarse_iter,
+                        coarse_kmax=coarse_kmax)
+                    phis_fit = np.asarray(out["phi"])
+                    phi_errs_fit = np.asarray(out["phi_err"])
+                    taus_fit = np.asarray(out["tau"])
+                    tau_errs_fit = np.asarray(out["tau_err"])
+                    scales_fit = np.asarray(out["scales"])[:, 0]
+                    scale_errs_fit = np.asarray(out["scale_errs"])[:, 0]
+                    snrs_fit = np.asarray(out["snr"])
+                    red_chi2s_fit = np.asarray(out["red_chi2"])
+                    # (phi, tau) covariance block from the 5-param kernel's
+                    # packed [nfit, nfit] matrix (fit order: phi, tau)
+                    cov = np.asarray(out["covariance_matrix"])
+                    covariances[sub_idx, cc, 0, 0] = cov[:, 0, 0]
+                    covariances[sub_idx, cc, 0, 1] = cov[:, 0, 1]
+                    covariances[sub_idx, cc, 1, 0] = cov[:, 1, 0]
+                    covariances[sub_idx, cc, 1, 1] = cov[:, 1, 1]
+                    nfevals[sub_idx, cc] = np.asarray(out["nfeval"])
+                    rcs_a[sub_idx, cc] = np.asarray(out["return_code"])
+                fit_duration = time.time() - fit_start
+            except jax.errors.JaxRuntimeError as e:
+                del self.ok_idatafiles[n_okid:]
+                self.failed_datafiles.append((datafile, str(e)))
+                print(f"Device error fitting {datafile}: {e}; "
+                      "skipping it.", file=sys.stderr)
                 continue
-            self.ok_idatafiles.append(iarch)
-
-            # flatten live (subint, channel) pairs into one fit batch
-            jj, cc = np.nonzero(wok)                      # [M], [M]
-            sub_idx = ok[jj]                 # archive subint index per fit
-            profs = ports[jj, cc]                         # [M, nbin]
-            mods = np.ascontiguousarray(models_b[jj, cc])
-            errsx = errs_b[jj, cc]
-            nusx = freqs_b[jj, cc]
-            Psx = Ps_b[jj]
-            M = len(jj)
-
-            taus_fit = np.zeros(M)
-            tau_errs_fit = np.zeros(M)
-            covariances = np.zeros([nsub, nchan, self.nfit, self.nfit])
-            nfevals = np.zeros([nsub, nchan], dtype=int)
-            rcs_a = np.zeros([nsub, nchan], dtype=int)
-            # caller bounds follow the reference's [(phi), (tau)] contract
-            phi_bounds = (-0.5, 0.5)
-            if bounds is not None and bounds[0] is not None \
-                    and None not in bounds[0]:
-                phi_bounds = tuple(bounds[0])
-            if not fit_scat:
-                r = fit_phase_shift(profs, mods, noise=errsx,
-                                    bounds=phi_bounds, Ns=100)
-                phis_fit = np.asarray(r.phase)
-                phi_errs_fit = np.asarray(r.phase_err)
-                scales_fit = np.asarray(r.scale)
-                scale_errs_fit = np.asarray(r.scale_err)
-                snrs_fit = np.asarray(r.snr)
-                red_chi2s_fit = np.asarray(r.red_chi2)
-            else:
-                # per-channel tau guess at each channel's frequency
-                alpha_guess = getattr(self, "alpha", scattering_alpha)
-                if self.scat_guess is not None:
-                    tg_s, tg_ref, alpha_guess = self.scat_guess
-                    tau_g = (tg_s / Psx) * (nusx / tg_ref) ** alpha_guess
-                elif hasattr(self, "gparams"):
-                    tau_g = (self.gparams[1] / Psx) * \
-                        (nusx / self.model_nu_ref) ** alpha_guess
-                else:
-                    tau_g = np.zeros(M)
-                # phase guess vs the scattered model
-                taus_g = np.asarray(scattering_times(tau_g, alpha_guess,
-                                                     nusx, nusx))
-                spFT = host_array(scattering_portrait_FT(taus_g, nbin))
-                mods_scat = np.fft.irfft(spFT * np.fft.rfft(mods, axis=-1),
-                                         nbin, axis=-1)
-                guess = fit_phase_shift(profs, mods_scat, noise=errsx,
-                                        Ns=100)
-                if log10_tau:
-                    tau_g = np.log10(np.where(tau_g == 0.0, 1.0 / nbin,
-                                              tau_g))
-                init = np.stack([np.asarray(guess.phase),
-                                 np.full(M, d.DM), np.zeros(M), tau_g,
-                                 np.full(M, alpha_guess)], axis=1)
-                if bounds is None:
-                    tau_lo = np.log10(1.0 / (10 * nbin)) if log10_tau \
-                        else 0.0
-                    bounds_eff = [(None, None), (None, None),
-                                  (None, None), (tau_lo, None),
-                                  (-10.0, 10.0)]
-                else:
-                    bounds_eff = [tuple(bounds[0]), (None, None),
-                                  (None, None), tuple(bounds[1]),
-                                  (-10.0, 10.0)]
-                nb_scan = auto_scan_size(len(profs), profiles=True)
-                out = fit_portrait_full_batch(
-                    profs[:, None, :], mods[:, None, :], init, Psx,
-                    nusx[:, None], errs=errsx[:, None],
-                    fit_flags=(1, 0, 0, 1, 0),
-                    nu_fits=np.stack([nusx] * 3, axis=1),
-                    bounds=bounds_eff, log10_tau=log10_tau,
-                    max_iter=max_iter, scan_size=nb_scan,
-                    pad_to=None if nb_scan is not None
-                    else bucket_batch_size(len(profs)),
-                    polish_iter=polish_iter, coarse_iter=coarse_iter,
-                    coarse_kmax=coarse_kmax)
-                phis_fit = np.asarray(out["phi"])
-                phi_errs_fit = np.asarray(out["phi_err"])
-                taus_fit = np.asarray(out["tau"])
-                tau_errs_fit = np.asarray(out["tau_err"])
-                scales_fit = np.asarray(out["scales"])[:, 0]
-                scale_errs_fit = np.asarray(out["scale_errs"])[:, 0]
-                snrs_fit = np.asarray(out["snr"])
-                red_chi2s_fit = np.asarray(out["red_chi2"])
-                # (phi, tau) covariance block from the 5-param kernel's
-                # packed [nfit, nfit] matrix (fit order: phi, tau)
-                cov = np.asarray(out["covariance_matrix"])
-                covariances[sub_idx, cc, 0, 0] = cov[:, 0, 0]
-                covariances[sub_idx, cc, 0, 1] = cov[:, 0, 1]
-                covariances[sub_idx, cc, 1, 0] = cov[:, 1, 0]
-                covariances[sub_idx, cc, 1, 1] = cov[:, 1, 1]
-                nfevals[sub_idx, cc] = np.asarray(out["nfeval"])
-                rcs_a[sub_idx, cc] = np.asarray(out["return_code"])
-            fit_duration = time.time() - fit_start
 
             # -- assemble per-archive [nsub, nchan] outputs -------------
             phis = np.zeros([nsub, nchan])

@@ -48,6 +48,42 @@ def fake_archives(fixture_dir):
     return files, phases, dDMs, gmodel
 
 
+def test_device_error_skips_archive(fake_archives, monkeypatch, capsys):
+    """A transient device/tunnel failure (jax.errors.JaxRuntimeError)
+    while fitting one archive must not kill the run: the archive lands
+    on failed_datafiles, its partial state is rolled back, and the
+    remaining archives produce consistent per-archive results."""
+    import jax
+
+    from pulseportraiture_tpu.pipelines import toas as toas_mod
+
+    files, phases, dDMs, gmodel = fake_archives
+    real_fit = toas_mod.fit_portrait_full_batch
+    calls = {"n": 0}
+
+    def flaky_fit(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 2:  # second archive's fit dies
+            raise jax.errors.JaxRuntimeError(
+                "UNAVAILABLE: remote_compile: Connection refused")
+        return real_fit(*a, **k)
+
+    monkeypatch.setattr(toas_mod, "fit_portrait_full_batch", flaky_fit)
+    gt = GetTOAs(files, gmodel, quiet=True)
+    gt.get_TOAs(bary=False, quiet=True)
+    assert len(gt.failed_datafiles) == 1
+    assert gt.failed_datafiles[0][0] == files[1]
+    assert "Connection refused" in gt.failed_datafiles[0][1]
+    # archives 0 and 2 came through with aligned per-archive lists
+    assert gt.order == [files[0], files[2]]
+    assert len(gt.ok_idatafiles) == 2 and gt.ok_idatafiles == [0, 2]
+    assert len(gt.TOA_list) == 8  # 2 archives x 4 subints
+    assert len(gt.phis) == len(gt.DMs) == len(gt.channel_snrs) == 2
+    # downstream consumers (zap proposals) still line up
+    zaps = gt.get_channels_to_zap(SNR_threshold=0.0, rchi2_threshold=5.0)
+    assert len(zaps) == 2
+
+
 @pytest.mark.slow
 def test_get_toas_recovers_injected_dDM(fake_archives):
     files, phases, dDMs, gmodel = fake_archives
