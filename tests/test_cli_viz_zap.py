@@ -152,6 +152,24 @@ def test_apply_zaps_e2e(setup, tmp_path):
     assert np.all(da.weights[:, [3, 11]] == 0.0)
 
 
+def test_apply_zaps_fourpol(setup, tmp_path):
+    """Zap application on a 4-pol archive: weights are per-(subint,
+    channel) regardless of npol, and all four pols survive the
+    rewrite."""
+    tmp, gm, par, hot, clean = setup
+    arch = str(tmp_path / "fourpol.fits")
+    make_fake_pulsar(gm, par, arch, nsub=2, npol=4, nchan=16, nbin=128,
+                     nu0=1500.0, bw=800.0, tsub=60.0, noise_stds=0.01,
+                     dedispersed=True, state="Stokes", seed=9,
+                     quiet=True)
+    apply_zaps([arch], [[[2, 9], [9]]], modify=True, quiet=True)
+    d = load_data(arch, pscrunch=False, quiet=True)
+    assert d.npol == 4
+    assert np.all(d.weights[0, [2, 9]] == 0.0)
+    assert np.all(d.weights[1, 9] == 0.0)
+    assert d.weights[1, 2] > 0.0
+
+
 def test_cli_ppzap_apply(setup, tmp_path, capsys):
     """ppzap --apply natively zaps through the CLI in both copy and
     modify modes (no psrchive required)."""
