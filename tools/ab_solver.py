@@ -1,0 +1,108 @@
+"""Solver A/B harness: north-star configs through bench_common.NorthStar.
+
+Times fit_main and fit_scat exactly as bench.py does and reports
+per-lane nfev statistics + TOA parity vs the CPU-f64 exact oracle —
+the harness behind PERF.md SS5's plateau-exit measurements.  Run with
+PYTHONPATH=/root/.axon_site:/root/repo python tools/ab_solver.py
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from bench_common import (COARSE_ITER, POLISH_ITER, SCAT_COARSE_KMAX,
+                          NorthStar, enable_compile_cache, materialize,
+                          stage, timed_passes)
+
+enable_compile_cache(jax)
+ns = NorthStar(jax)
+P0 = 0.005
+
+stage("building main data")
+data_all = ns.main_data()
+stage("compile+time main (plateau fix, caps %d+%d)"
+      % (COARSE_ITER, POLISH_ITER))
+materialize(ns.fit_main(data_all).phi)
+dur, out = timed_passes(lambda: ns.fit_main(data_all),
+                        lambda o: materialize(o.phi), "main")
+nf = materialize(out.nfeval)
+print("MAIN: %.3f s  %.1f TOAs/s  nfev med %d p90 %d max %d"
+      % (dur, ns.nsub / dur, np.median(nf), np.percentile(nf, 90),
+         nf.max()), flush=True)
+
+del data_all
+stage("building scat data")
+sdata = ns.scat_data()
+stage("compile+time scat")
+materialize(ns.fit_scat(sdata).phi)
+sdur, sout = timed_passes(lambda: ns.fit_scat(sdata),
+                          lambda o: materialize(o.phi), "scat")
+snf = materialize(sout.nfeval)
+tau_fit = np.median(10 ** materialize(sout.tau))
+print("SCAT: %.3f s  %.1f fits/s  nfev med %d p90 %d max %d  tau_rel %.4f"
+      % (sdur, ns.nsub / sdur, np.median(snf), np.percentile(snf, 90),
+         snf.max(), abs(tau_fit - 3e-3) / 3e-3), flush=True)
+
+# parity: device timed path vs CPU f64 exact on a 32-subint slice
+from pulseportraiture_tpu.fit.portrait import fit_portrait_full_batch
+
+K = 32
+nus = ns.nus_pin(K)
+init = np.zeros((K, 5))
+init[:, 0] = ns.phis_inj[:K]
+init[:, 1] = ns.dDMs_inj[:K]
+
+
+def pinned(data, dtype_sel, kmax, cast=None, polish_iter=None,
+           coarse_iter=None, flags=(1, 1, 0, 0, 0), init_p=None,
+           log10_tau=False, coarse_kmax=None):
+    return fit_portrait_full_batch(
+        jnp.asarray(data, dtype_sel), ns.model64_dev,
+        init if init_p is None else init_p, ns.Ps[:K], ns.freqs_j,
+        errs=ns.errs[:K], fit_flags=flags, nu_fits=nus,
+        nu_outs=(nus[:, 0], nus[:, 1], nus[:, 2]), log10_tau=log10_tau,
+        max_iter=30 if cast is not None else 50, kmax=kmax, cast=cast,
+        polish_iter=polish_iter, coarse_iter=coarse_iter,
+        coarse_kmax=coarse_kmax)
+
+
+stage("parity main: device")
+data_par = ns.main_data()[:K]
+dev = pinned(data_par, ns.dtype, ns.kmax, cast=jnp.float64,
+             polish_iter=POLISH_ITER, coarse_iter=COARSE_ITER)
+dev_phi = materialize(dev.phi)
+stage("parity main: cpu f64")
+cpu_dev = jax.devices("cpu")[0]
+with jax.default_device(cpu_dev):
+    cpu = pinned(np.asarray(data_par, np.float64), jnp.float64,
+                 ns.nbin // 2 + 1)
+    cpu_phi = np.asarray(cpu.phi)
+d = (dev_phi - cpu_phi + 0.5) % 1.0 - 0.5
+print("MAIN parity vs cpu-f64: %.4f ns" % (np.abs(d).max() * P0 * 1e9),
+      flush=True)
+
+sinit = ns.scat_init()[:K]
+stage("parity scat: device")
+sdata_par = sdata[:K]
+sdev = pinned(sdata_par, ns.dtype, ns.kmax, cast=jnp.float64,
+              polish_iter=POLISH_ITER, coarse_iter=COARSE_ITER,
+              flags=(1, 1, 0, 1, 1), init_p=sinit, log10_tau=True,
+              coarse_kmax=SCAT_COARSE_KMAX)
+sdev_phi = materialize(sdev.phi)
+stage("parity scat: cpu f64")
+with jax.default_device(cpu_dev):
+    scpu = pinned(np.asarray(sdata_par, np.float64), jnp.float64,
+                  ns.nbin // 2 + 1, flags=(1, 1, 0, 1, 1), init_p=sinit,
+                  log10_tau=True)
+    scpu_phi = np.asarray(scpu.phi)
+sd = (sdev_phi - scpu_phi + 0.5) % 1.0 - 0.5
+print("SCAT parity vs cpu-f64: %.4f ns" % (np.abs(sd).max() * P0 * 1e9),
+      flush=True)
+print("DONE", flush=True)
