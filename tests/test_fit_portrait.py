@@ -326,6 +326,68 @@ def test_pair_path_matches_complex128():
     assert abs(10 ** float(s_p.tau) - 3e-3) / 3e-3 < 0.1
 
 
+@pytest.mark.slow
+def test_plateau_exit_parity_sweep(rng):
+    """Stress the predicted-decrease plateau exit: across SNR regimes,
+    wrap-edge phases, zapped channels, and scattering on/off, the
+    hybrid path with plateau termination stays within the parity budget
+    of the uncapped exact-f64 path."""
+    model = make_model()
+    nu0 = float(np.mean(FREQS))
+    configs = []
+    for noise in (0.01, 0.1, 0.5):          # SNR sweep incl. low-SNR
+        for phi in (-0.4999, -0.2, 0.3, 0.4999):   # wrap edges
+            configs.append((phi, float(rng.uniform(-2e-3, 2e-3)),
+                            noise, False))
+    configs += [(0.1, 1e-3, 0.02, True), (-0.45, -1.5e-3, 0.05, True)]
+    B = len(configs)
+    datas = np.empty((B, NCHAN, NBIN))
+    inits = np.zeros((B, 5))
+    for i, (phi, dDM, noise, scat) in enumerate(configs):
+        tau = 3e-3 if scat else 0.0
+        _, port = make_data(phi=phi, dDM=dDM, tau=tau, noise=noise,
+                            seed=100 + i)
+        datas[i] = port
+        inits[i] = [phi, dDM, 0.0,
+                    np.log10(4e-3) if scat else -np.inf, -4.0]
+    weights = np.ones((B, NCHAN))
+    weights[3, :5] = 0.0  # a partially-zapped band in the sweep
+    errs = np.array([[c[2]] * NCHAN for c in configs])
+    nus = np.tile([nu0, nu0, nu0], (B, 1))
+
+    def run(data, scat_rows, pair, kmax, **kw):
+        sel = np.asarray(scat_rows)
+        flags = (1, 1, 0, 1, 1) if kw.pop("scat") else (1, 1, 0, 0, 0)
+        return fp.fit_portrait_full_batch(
+            data[sel], model[None].astype(data.dtype), inits[sel], P0,
+            FREQS, errs=errs[sel], weights=weights[sel],
+            fit_flags=flags, nu_fits=nus[sel],
+            nu_outs=(nus[sel, 0], nus[sel, 1], nus[sel, 2]),
+            log10_tau=True, max_iter=50, pair=pair, kmax=kmax, **kw)
+
+    plain_rows = [i for i, c in enumerate(configs) if not c[3]]
+    scat_rows = [i for i, c in enumerate(configs) if c[3]]
+    for rows, scat in ((plain_rows, False), (scat_rows, True)):
+        hyb = run(datas.astype(np.float32), rows, "hybrid", None,
+                  cast=np.float64, scat=scat,
+                  coarse_kmax=64 if scat else None)
+        exact = run(datas.astype(np.float64), rows, True,
+                    NBIN // 2 + 1, scat=scat)
+        d_ns = np.abs(((np.asarray(hyb.phi) - np.asarray(exact.phi)
+                        + 0.5) % 1.0) - 0.5) * P0 * 1e9
+        assert d_ns.max() < 0.05, (scat, d_ns)
+        np.testing.assert_allclose(np.asarray(hyb.DM),
+                                   np.asarray(exact.DM), atol=2e-8)
+        np.testing.assert_allclose(np.asarray(hyb.red_chi2),
+                                   np.asarray(exact.red_chi2),
+                                   rtol=1e-4)
+        # plateau exits keep the TYPICAL trip count low; an occasional
+        # wrap-edge low-SNR lane may genuinely need tens of accepted
+        # steps (progress, not the reject spiral this guards against)
+        nf = np.asarray(hyb.nfeval)
+        assert np.median(nf) <= 10 and nf.max() <= 45, nf
+
+
 def test_pad_to_bucketing_matches_plain_batch(rng):
     """pad_to pads the batch with copies of the last subint and drops
     them from the outputs: results identical to the unpadded batch, and
