@@ -515,6 +515,11 @@ class GetTOAs:
                         else bucket_batch_size(len(sel)),
                         polish_iter=polish_iter, coarse_iter=coarse_iter,
                         coarse_kmax=coarse_kmax)
+                    # ONE host transfer for the whole result tree —
+                    # per-key np.asarray would issue ~24 sequential
+                    # device->host round trips per archive (each
+                    # ~150-400 ms through a remote-dispatch tunnel)
+                    out = jax.device_get(dict(out))
                     for j, i in enumerate(idxs):
                         results[i] = {key: np.asarray(val)[j]
                                       for key, val in out.items()}
@@ -860,14 +865,15 @@ class GetTOAs:
                         and None not in bounds[0]:
                     phi_bounds = tuple(bounds[0])
                 if not fit_scat:
-                    r = fit_phase_shift(profs, mods, noise=errsx,
-                                        bounds=phi_bounds, Ns=100)
-                    phis_fit = np.asarray(r.phase)
-                    phi_errs_fit = np.asarray(r.phase_err)
-                    scales_fit = np.asarray(r.scale)
-                    scale_errs_fit = np.asarray(r.scale_err)
-                    snrs_fit = np.asarray(r.snr)
-                    red_chi2s_fit = np.asarray(r.red_chi2)
+                    r = jax.device_get(dict(fit_phase_shift(
+                        profs, mods, noise=errsx, bounds=phi_bounds,
+                        Ns=100)))  # one host transfer for all fields
+                    phis_fit = np.asarray(r["phase"])
+                    phi_errs_fit = np.asarray(r["phase_err"])
+                    scales_fit = np.asarray(r["scale"])
+                    scale_errs_fit = np.asarray(r["scale_err"])
+                    snrs_fit = np.asarray(r["snr"])
+                    red_chi2s_fit = np.asarray(r["red_chi2"])
                 else:
                     # per-channel tau guess at each channel's frequency
                     alpha_guess = getattr(self, "alpha", scattering_alpha)
@@ -915,6 +921,9 @@ class GetTOAs:
                         else bucket_batch_size(len(profs)),
                         polish_iter=polish_iter, coarse_iter=coarse_iter,
                         coarse_kmax=coarse_kmax)
+                    # one host transfer for the whole result tree (see
+                    # the wideband driver)
+                    out = jax.device_get(dict(out))
                     phis_fit = np.asarray(out["phi"])
                     phi_errs_fit = np.asarray(out["phi_err"])
                     taus_fit = np.asarray(out["tau"])
