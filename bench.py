@@ -29,6 +29,10 @@ extra carries the other BASELINE.md configs and the accuracy criterion:
 - scat_fits_per_sec: the joint phase+DM+tau+alpha fit (flags 11011).
 - ipta_fits_per_sec: the 20 pulsars x 10 epochs sharded sweep
   (parallel.sharded_fit.ipta_sweep_fit).
+- align_*: the full BASELINE row-4 config (500 archives incl. FITS IO).
+- hetero_*: mixed-shape GetTOAs stress — cold (per-shape compiles
+  included) vs warm wall, their difference being the compile churn a
+  heterogeneous survey pays once per shape set (_hetero_stress).
 - gflops_approx: rough sustained FLOP/s from an rFFT+iteration count.
 """
 
