@@ -1,0 +1,240 @@
+"""Opt-in runtime sanitizer: retrace accounting + non-finite checks.
+
+The static half of the repo's safety net is ``tools/jaxlint`` (AST rules
+J001-J005); this module is the runtime half.  Everything here is gated
+on the ``PPTPU_SANITIZE`` environment variable and collapses to a no-op
+when it is unset, so production and bench paths pay nothing:
+
+* unset / ``0`` / ``off``  — disabled (the default);
+* ``1`` / ``raise``        — violations raise (:class:`RetraceError`,
+  :class:`NonFiniteError`);
+* ``warn``                 — violations emit a ``RuntimeWarning``.
+
+Facilities
+----------
+``retrace_budget(budget=..., name=...)`` wraps an already-jitted
+callable and, after each call, compares the number of traced variants
+(`jit`'s ``_cache_size``) against the declared budget.  A hot path that
+silently retraces — a varying Python scalar closed over as a traced
+constant, an unhashable static arg rebuilt per call — blows its budget
+within a few calls and fails loudly instead of eating a compile per
+call through the device tunnel.  Unknown attributes forward to the
+wrapped function (``lower``, ``clear_cache``, ``_cache_size`` keep
+working).
+
+``trace_counter()`` counts process-wide jaxpr traces and backend
+compiles via ``jax.monitoring`` while the context is open — the precise
+tool for regression tests of the form "the second same-shaped batch
+must not compile anything" (tests/test_retrace_budget.py).  It is
+always active (no env gate): a counter you opened explicitly should
+count.
+
+``check_finite(value, name)`` / ``check_fit_result(bunch)`` are the
+NaN hooks for fit residuals: host-side checks of concrete outputs
+(traced values are skipped — the host-level batch entry points see the
+concrete results).  ``fit_portrait_full_batch`` calls
+``check_fit_result`` on every batch it returns when the sanitizer is
+on, so a NaN chi-squared or parameter vector fails at the fit that
+produced it instead of three pipelines later in a .tim file.
+"""
+
+import contextlib
+import functools
+import os
+import warnings
+
+import numpy as np
+
+__all__ = ["enabled", "sanitize_mode", "RetraceError", "NonFiniteError",
+           "retrace_budget", "trace_counter", "TraceCount",
+           "check_finite", "check_fit_result"]
+
+
+def sanitize_mode():
+    """None (disabled), 'warn', or 'raise' from PPTPU_SANITIZE."""
+    v = os.environ.get("PPTPU_SANITIZE", "").strip().lower()
+    if v in ("", "0", "false", "off", "no"):
+        return None
+    return "warn" if v in ("warn", "log") else "raise"
+
+
+def enabled():
+    return sanitize_mode() is not None
+
+
+class RetraceError(RuntimeError):
+    """A jitted function exceeded its declared trace budget."""
+
+
+class NonFiniteError(FloatingPointError):
+    """A sanitized value contained NaN/Inf."""
+
+
+def _violate(exc_type, msg):
+    if sanitize_mode() == "warn":
+        warnings.warn(msg, RuntimeWarning, stacklevel=3)
+    else:
+        raise exc_type(msg)
+
+
+# -- retrace accounting -----------------------------------------------------
+
+class _RetraceGuard:
+    """Callable proxy over a jitted function with a trace budget."""
+
+    def __init__(self, fn, budget, name):
+        self._fn = fn
+        self.trace_budget = budget
+        self.trace_name = name or getattr(fn, "__name__", repr(fn))
+        functools.update_wrapper(self, fn,
+                                 assigned=("__module__", "__name__",
+                                           "__qualname__", "__doc__"),
+                                 updated=())
+
+    def __call__(self, *args, **kwargs):
+        out = self._fn(*args, **kwargs)
+        if enabled():
+            try:
+                n = int(self._fn._cache_size())
+            except Exception:  # non-jit callable or API drift: no check
+                n = None
+            if n is not None and n > self.trace_budget:
+                _violate(RetraceError,
+                         "%s traced %d variants (budget %d) — a hot "
+                         "path is retracing; check for varying Python "
+                         "scalars / unstable static args (jaxlint J004, "
+                         "docs/LINTING.md)"
+                         % (self.trace_name, n, self.trace_budget))
+        return out
+
+    def __getattr__(self, attr):  # lower/clear_cache/_cache_size/... pass
+        return getattr(self._fn, attr)
+
+
+def retrace_budget(fn=None, *, budget=8, name=None):
+    """Decorator/wrapper declaring a trace budget for a jitted callable.
+
+    Stack ABOVE jax.jit::
+
+        @retrace_budget(budget=16, name="fit.portrait._solve")
+        @partial(jax.jit, static_argnames=(...))
+        def _solve(...): ...
+
+    The budget bounds *distinct traced variants over the process
+    lifetime* (legitimate static-config and shape buckets included), so
+    it is a loose ceiling, not "one": pick the largest variant count a
+    sane run produces.  Checked only when the sanitizer is enabled.
+    """
+    if fn is None:
+        return lambda f: _RetraceGuard(f, budget, name)
+    return _RetraceGuard(fn, budget, name)
+
+
+class TraceCount:
+    """Mutable counter yielded by :func:`trace_counter`."""
+
+    def __init__(self):
+        self.traces = 0
+        self.compiles = 0
+
+    @property
+    def total(self):
+        return self.traces + self.compiles
+
+    def __repr__(self):
+        return ("TraceCount(traces=%d, compiles=%d)"
+                % (self.traces, self.compiles))
+
+
+_active_counters = []
+_listener_installed = False
+
+# jax.monitoring has no unregister API — one permanent listener fans out
+# to whatever counters are currently open (none: early return).
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _install_listener():
+    global _listener_installed
+    if _listener_installed:
+        return
+    import jax.monitoring
+
+    def _on_duration(event, duration=0.0, **kwargs):
+        if not _active_counters:
+            return
+        if event == _TRACE_EVENT:
+            for c in _active_counters:
+                c.traces += 1
+        elif event == _COMPILE_EVENT:
+            for c in _active_counters:
+                c.compiles += 1
+
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    _listener_installed = True
+
+
+@contextlib.contextmanager
+def trace_counter():
+    """Count jaxpr traces / backend compiles process-wide while open.
+
+    Usage::
+
+        with trace_counter() as c:
+            run_batch(...)
+        assert c.compiles == 0   # everything was cache-hit
+    """
+    _install_listener()
+    c = TraceCount()
+    _active_counters.append(c)
+    try:
+        yield c
+    finally:
+        _active_counters.remove(c)
+
+
+# -- non-finite checks ------------------------------------------------------
+
+def check_finite(value, name="value", allow_inf=False):
+    """Raise/warn when a *concrete* array value holds NaN (or Inf).
+
+    Returns ``value`` unchanged; a no-op when the sanitizer is off.
+    Traced values pass through silently — the concrete check runs at
+    the host-level entry points, which see real numbers.  Forces a
+    device->host transfer, which is the sanitizer's documented cost.
+    """
+    if not enabled():
+        return value
+    import jax
+
+    if isinstance(value, jax.core.Tracer):
+        return value
+    from .config import host_array  # complex-safe device->host
+
+    arr = np.asarray(host_array(value))
+    if not np.issubdtype(arr.dtype, np.number):
+        return value
+    bad = np.isnan(arr) if allow_inf else ~np.isfinite(arr)
+    if np.any(bad):
+        _violate(NonFiniteError,
+                 "%s: %d non-finite value(s) out of %d"
+                 % (name, int(bad.sum()), arr.size))
+    return value
+
+
+def check_fit_result(result, where="fit"):
+    """NaN hook for fit outputs: params and the residual chi-squared.
+
+    NaN-only (``allow_inf=True``): Inf appears by design — a frozen
+    log10(tau) of -inf encodes "no scattering", and error fields carry
+    Inf on zapped channels — while NaN always means a poisoned fit.
+    No-op when the sanitizer is off; returns ``result``.
+    """
+    if not enabled():
+        return result
+    for field in ("params", "chi2"):
+        if isinstance(result, dict) and field in result:
+            check_finite(result[field], name="%s.%s" % (where, field),
+                         allow_inf=True)
+    return result

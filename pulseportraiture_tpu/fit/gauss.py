@@ -13,12 +13,10 @@ jitted program per (model_code, ngauss) instead of lmfit's per-call
 MINPACK host loop.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..config import wid_max
-from ..ops.fourier import get_bin_centers
 from ..ops.profiles import (gaussian_profile, gen_gaussian_portrait,
                             gen_gaussian_profile)
 from ..utils.databunch import DataBunch

@@ -45,11 +45,11 @@ def lm_solve(residual_fn, x0, fit_flags=None, bounds=None, max_iter=100,
         return jax.vmap(lambda x: one(x))(x0)
 
     nparam = x0.shape[0]
-    flags = jnp.ones(nparam) if fit_flags is None else \
+    flags = jnp.ones(nparam, dtype=jnp.float64) if fit_flags is None else \
         jnp.asarray(fit_flags, dtype=jnp.float64)
     if bounds is None:
-        lo = jnp.full(nparam, -jnp.inf)
-        hi = jnp.full(nparam, jnp.inf)
+        lo = jnp.full(nparam, -jnp.inf, dtype=jnp.float64)
+        hi = jnp.full(nparam, jnp.inf, dtype=jnp.float64)
     else:
         lo = jnp.asarray(bounds[0], dtype=jnp.float64)
         hi = jnp.asarray(bounds[1], dtype=jnp.float64)
@@ -58,7 +58,7 @@ def lm_solve(residual_fn, x0, fit_flags=None, bounds=None, max_iter=100,
         return jnp.asarray(residual_fn(x, *args), dtype=jnp.float64)
 
     jac = jax.jacfwd(res)
-    unfit = jnp.eye(nparam) * (1.0 - flags)
+    unfit = jnp.eye(nparam, dtype=jnp.float64) * (1.0 - flags)
 
     r0 = res(x0)
     ndata = r0.shape[0]
@@ -77,7 +77,7 @@ def lm_solve(residual_fn, x0, fit_flags=None, bounds=None, max_iter=100,
         f_t = jnp.sum(r_t * r_t)
         return trial, f_t, g, x + step
 
-    state = dict(x=x0, f=f0, mu=jnp.asarray(1e-3),
+    state = dict(x=x0, f=f0, mu=jnp.asarray(1e-3, dtype=jnp.float64),
                  done=jnp.asarray(False), it=jnp.asarray(0),
                  nfev=jnp.asarray(1), rc=jnp.asarray(3))
 
@@ -130,7 +130,7 @@ def lm_solve(residual_fn, x0, fit_flags=None, bounds=None, max_iter=100,
     colnorm = jnp.sum(J * J, axis=0)
     ident = flags * (colnorm > 1e-30)
     J = J * ident[None, :]
-    JtJ = J.T @ J + jnp.eye(nparam) * (1.0 - ident)
+    JtJ = J.T @ J + jnp.eye(nparam, dtype=jnp.float64) * (1.0 - ident)
     nfit = jnp.sum(flags)
     dof = jnp.maximum(ndata - nfit, 1.0)
     chi2 = out["f"]

@@ -40,6 +40,7 @@ import numpy as np
 
 from ..config import (Dconst, F0_fact, as_fft_operand,
                       backend_supports_complex128)
+from ..debug import check_fit_result, retrace_budget
 from ..ops.fourier import rfft_pair
 from ..ops.noise import get_noise
 from ..ops.scattering import (
@@ -646,6 +647,7 @@ def _scat_hint(fit_flags, init_params, log10_tau):
     return bool(np.any(tau0 != 0.0))
 
 
+@retrace_budget(budget=32, name="fit.portrait._solve")
 @partial(jax.jit, static_argnames=("fit_flags", "log10_tau", "nbin",
                                    "max_iter", "scat", "coarse"))
 def _solve(init_params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
@@ -918,8 +920,8 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
         freqs.mean() if nf is None else nf for nf in nu_fits]
 
     if bounds is None:
-        lo = jnp.full(5, -jnp.inf)
-        hi = jnp.full(5, jnp.inf)
+        lo = jnp.full(5, -jnp.inf, dtype=jnp.float64)
+        hi = jnp.full(5, jnp.inf, dtype=jnp.float64)
     else:
         lo = jnp.asarray([-jnp.inf if b[0] is None else b[0]
                           for b in bounds])
@@ -1023,7 +1025,7 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
     chi2 = Sd + sol["f"]
     red_chi2 = chi2 / dof
 
-    return DataBunch(
+    return check_fit_result(DataBunch(
         params=params_out, param_errs=param_errs,
         phi=phi_out, phi_err=param_errs[0],
         DM=DM_fit, DM_err=param_errs[1],
@@ -1034,7 +1036,8 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
         nu_DM=nu_out_DM, nu_GM=nu_out_GM, nu_tau=nu_out_tau,
         covariance_matrix=cov_fit, chi2=chi2, red_chi2=red_chi2,
         snr=snr, channel_snrs=channel_snrs,
-        nfeval=sol["nfev"], return_code=sol["rc"])
+        nfeval=sol["nfev"], return_code=sol["rc"]),
+        where="fit_portrait_full")
 
 
 def _seed_phases(data_ports, model_ports, errs_b, weights_b, cast):
@@ -1068,6 +1071,7 @@ def _seed_phases(data_ports, model_ports, errs_b, weights_b, cast):
     return out.phase.astype(jnp.float64)
 
 
+@retrace_budget(budget=16, name="fit.portrait._batch_impl")
 @partial(jax.jit, static_argnames=("fit_flags", "bounds", "log10_tau",
                                    "max_iter", "nu_outs_mask", "scat",
                                    "pair", "kmax", "scan_size", "cast",
@@ -1229,7 +1233,7 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
         errs_b = jnp.broadcast_to(jnp.asarray(errs),
                                   data_ports.shape[:-1])
     if weights is None:
-        weights_b = jnp.ones(data_ports.shape[:-1])
+        weights_b = jnp.ones(data_ports.shape[:-1], dtype=jnp.float64)
     else:
         weights_b = jnp.broadcast_to(jnp.asarray(weights),
                                      data_ports.shape[:-1])
@@ -1238,7 +1242,7 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
          None if b[1] is None else float(b[1])) for b in bounds)
     if nu_fits is None or (isinstance(nu_fits, tuple)
                            and all(nf is None for nf in nu_fits)):
-        nu_fits_b = jnp.full((B, 3), jnp.nan)
+        nu_fits_b = jnp.full((B, 3), jnp.nan, dtype=jnp.float64)
     elif isinstance(nu_fits, tuple):
         nu_fits_b = jnp.broadcast_to(jnp.asarray(
             [jnp.nan if nf is None else float(nf) for nf in nu_fits]),
@@ -1321,7 +1325,9 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
                       data_spectra=data_spectra_t)
     if data_ports.shape[0] != B:  # drop scan padding
         out = jax.tree_util.tree_map(lambda a: a[:B], out)
-    return out
+    # opt-in NaN hook (PPTPU_SANITIZE): fail at the fit that produced a
+    # non-finite solution, not pipelines later
+    return check_fit_result(out, where="fit_portrait_full_batch")
 
 
 def get_scales_full(params, data_port, model_port, P, freqs, nu_DM, nu_GM,
@@ -1353,7 +1359,9 @@ def get_scales(data, model, phase, DM, P, freqs, nu_ref=jnp.inf):
     """
     params = jnp.stack([jnp.asarray(phase, dtype=jnp.float64),
                         jnp.asarray(DM, dtype=jnp.float64),
-                        jnp.zeros(()), jnp.zeros(()), jnp.zeros(())])
+                        jnp.zeros((), dtype=jnp.float64),
+                        jnp.zeros((), dtype=jnp.float64),
+                        jnp.zeros((), dtype=jnp.float64)])
     return get_scales_full(params, data, model, P, freqs, nu_ref, jnp.inf,
                            jnp.asarray(freqs).mean(), log10_tau=False)
 

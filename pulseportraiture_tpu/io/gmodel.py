@@ -19,7 +19,6 @@ when building a portrait.
 
 import numpy as np
 
-from ..ops.fourier import get_bin_centers
 from ..ops.profiles import gen_gaussian_portrait
 
 __all__ = ["write_model", "read_model"]

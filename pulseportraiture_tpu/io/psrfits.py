@@ -26,11 +26,9 @@ import sys
 
 import numpy as np
 
-from ..utils.databunch import DataBunch
 from ..utils.mjd import MJD
-from .fits import HDU, Header, read_fits, write_bintable_hdu, write_fits
-from .polyco import (ChebyModelSet, Polyco, PolycoSegment,
-                     parse_t2predict_text)
+from .fits import HDU, read_fits, write_bintable_hdu, write_fits
+from .polyco import Polyco, PolycoSegment, parse_t2predict_text
 
 __all__ = ["Archive", "read_archive", "write_archive_file"]
 

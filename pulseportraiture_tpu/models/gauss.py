@@ -12,7 +12,7 @@ convergence check reuses the 2-parameter device fit kernel.
 
 import numpy as np
 
-from ..config import default_model, scattering_alpha, wid_max
+from ..config import default_model, scattering_alpha
 from ..dataportrait import DataPortrait
 from ..fit.gauss import (auto_gauss_seed, fit_gaussian_portrait,
                          peak_pick_seed)

@@ -292,4 +292,5 @@ def get_bin_centers(nbin, lo=0.0, hi=1.0):
     Equivalent of /root/reference/pplib.py:671-684.
     """
     diff = hi - lo
-    return jnp.linspace(lo + diff / (2 * nbin), hi - diff / (2 * nbin), nbin)
+    return jnp.linspace(lo + diff / (2 * nbin), hi - diff / (2 * nbin),
+                        nbin, dtype=jnp.float64)

@@ -57,7 +57,7 @@ def half_triangle_function(a, b, dc, N):
     Equivalent of /root/reference/pplib.py:1436-1446.
     """
     a = jnp.floor(a)
-    k = jnp.arange(N)
+    k = jnp.arange(N, dtype=jnp.result_type(a, b, dc))
     return dc + jnp.where(k < a, b - (b / a) * k, 0.0)
 
 
@@ -77,17 +77,18 @@ def find_kc(pows, fn="exp_dc", Ns=20):
     pows = jnp.asarray(pows)
     N = pows.shape[-1]
     logp = jnp.log10(pows)
+    rdt = logp.dtype  # grids track the (possibly TPU-clamped) spectrum
     lmin, lmax = logp.min(), logp.max()
     # scipy.optimize.brute with Ns points spans [lo, hi) like mgrid slices
     # with complex step: inclusive endpoints.
-    b_grid = jnp.linspace(0.0, lmax - lmin, Ns)
-    dc_grid = jnp.linspace(lmin, lmax, Ns)
-    k = jnp.arange(N)
+    b_grid = jnp.linspace(0.0, lmax - lmin, Ns, dtype=rdt)
+    dc_grid = jnp.linspace(lmin, lmax, Ns, dtype=rdt)
+    k = jnp.arange(N, dtype=rdt)
     if fn == "exp_dc":
-        a_grid = jnp.linspace(1.0 / N, 1.0, Ns)
+        a_grid = jnp.linspace(1.0 / N, 1.0, Ns, dtype=rdt)
         shape_ak = jnp.exp(-a_grid[:, None] * k[None, :])      # [Ns, N]
     elif fn == "half_tri":
-        a_grid = jnp.linspace(1.0, float(N), Ns)
+        a_grid = jnp.linspace(1.0, float(N), Ns, dtype=rdt)
         fa = jnp.floor(a_grid)[:, None]
         shape_ak = jnp.where(k[None, :] < fa, 1.0 - k[None, :] / fa, 0.0)
     else:
@@ -118,7 +119,7 @@ def get_noise_fit(data, fact=1.1, fn="exp_dc"):
 
     def one(p):
         k_crit = jnp.minimum(fact * find_kc(p, fn=fn), int(0.99 * npow))
-        mask = jnp.arange(npow) >= k_crit
+        mask = jnp.arange(npow, dtype=jnp.int32) >= k_crit
         return jnp.sqrt(jnp.sum(jnp.where(mask, p, 0.0)) / jnp.sum(mask))
 
     if data.ndim == 1:
@@ -158,7 +159,8 @@ def brickwall_filter(N, kc):
     """Binary low-pass filter: ones below harmonic kc, zeros above
     (equivalent of /root/reference/pplib.py:1410-1418; jit-safe for
     traced kc, batched over kc's leading dims)."""
-    return jnp.where(jnp.arange(N) < jnp.asarray(kc)[..., None], 1.0, 0.0)
+    return jnp.where(jnp.arange(N, dtype=jnp.int32)
+                     < jnp.asarray(kc)[..., None], 1.0, 0.0)
 
 
 def fit_brickwall(prof, noise):
@@ -176,12 +178,13 @@ def fit_brickwall(prof, noise):
 
 def _fit_brickwall_from_wf(wf):
     # X2(kc) = sum_{i<kc} (wf_i - 1)^2 + sum_{i>=kc} wf_i^2
-    ones_cost = jnp.concatenate([jnp.zeros(wf.shape[:-1] + (1,)),
+    ones_cost = jnp.concatenate([jnp.zeros(wf.shape[:-1] + (1,),
+                                           dtype=wf.dtype),
                                  jnp.cumsum((wf - 1.0) ** 2, axis=-1)],
                                 axis=-1)
     tot = jnp.sum(wf ** 2, axis=-1, keepdims=True)
     zeros_cost = tot - jnp.concatenate(
-        [jnp.zeros(wf.shape[:-1] + (1,)),
+        [jnp.zeros(wf.shape[:-1] + (1,), dtype=wf.dtype),
          jnp.cumsum(wf ** 2, axis=-1)], axis=-1)
     return jnp.argmin(ones_cost + zeros_cost, axis=-1).astype(jnp.int32)
 

@@ -59,4 +59,7 @@ def unnormalize_portrait(norm_port, norm_vals):
     Equivalent of DataPortrait.unnormalize_portrait
     (/root/reference/pplib.py:384-398).
     """
-    return jnp.asarray(norm_port) * jnp.asarray(norm_vals)[..., None]
+    # norm_port is normalize_portrait's own (already-converted) output in
+    # every caller; one conversion of the norms suffices — the multiply
+    # promotes array-likes itself
+    return norm_port * jnp.asarray(norm_vals)[..., None]

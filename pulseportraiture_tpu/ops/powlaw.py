@@ -35,10 +35,12 @@ def powlaw_freqs(lo, hi, N, alpha, mid=False):
     Equivalent of /root/reference/pplib.py:1068-1096.
     """
     alpha = jnp.asarray(alpha, dtype=jnp.float64)
-    log_nus = jnp.exp(jnp.linspace(jnp.log(lo), jnp.log(hi), N + 1))
+    log_nus = jnp.exp(jnp.linspace(jnp.log(lo), jnp.log(hi), N + 1,
+                                   dtype=jnp.float64))
     safe_alpha = jnp.where(alpha == -1.0, 0.0, alpha)
     gen_nus = jnp.power(
-        jnp.linspace(lo ** (1 + safe_alpha), hi ** (1 + safe_alpha), N + 1),
+        jnp.linspace(lo ** (1 + safe_alpha), hi ** (1 + safe_alpha), N + 1,
+                     dtype=jnp.float64),
         (1 + safe_alpha) ** -1)
     nus = jnp.where(alpha == -1.0, log_nus, gen_nus)
     if mid:

@@ -180,7 +180,7 @@ def scattering_kernel(tau, nu_ref, freqs, nbin, P=1.0, alpha=-4.0):
     sum.  Legacy-path equivalent of /root/reference/pplib.py:1098-1119.
     """
     freqs = jnp.asarray(freqs)
-    ts = jnp.arange(nbin) * (P / nbin)
+    ts = jnp.arange(nbin, dtype=jnp.float64) * (P / nbin)
     taus = scattering_times(tau, alpha, freqs, nu_ref)  # [nchan], in sec
     taus = jnp.where(taus == 0.0, jnp.finfo(ts.dtype).tiny, taus)
     kern = jnp.exp(-ts[None, :] / taus[:, None])

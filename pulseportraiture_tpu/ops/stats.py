@@ -56,11 +56,11 @@ def get_red_chi2(data, model, errs=None, dof=None):
     matching the reference (pplib.py:727-750).
     """
     data = jnp.asarray(data)
-    model = jnp.asarray(model)
     resids = data - model
     if errs is None:
-        errs = get_noise(data)
-    errs = jnp.asarray(errs)
+        errs = get_noise(data)  # already an array of data's dtype
+    else:
+        errs = jnp.asarray(errs)
     if dof is None:
         dof = sum(data.shape)
     if data.ndim == 1:

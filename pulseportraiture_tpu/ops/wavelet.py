@@ -39,7 +39,6 @@ import numpy as np
 
 from ..config import as_fft_operand, complex_dtype_for, fft_real_dtype
 from .noise import get_noise
-from .stats import get_red_chi2
 
 __all__ = ["daubechies_dec_lo", "swt", "iswt", "wavelet_smooth",
            "smart_smooth", "threshold"]
@@ -185,7 +184,7 @@ def _smart_smooth_grid(port, try_nlevels, nfact, rchi2_tol, wavelet,
     port = jnp.asarray(port)
     nbin = port.shape[-1]
     errs = get_noise(port)                      # [...] per profile
-    facts = jnp.linspace(0.0, 3.0, nfact)
+    facts = jnp.linspace(0.0, 3.0, nfact, dtype=port.dtype)
 
     # reduced chi2 of smooth-vs-raw with dof = nbin.  The gate is
     # one-sided, chi2 <= 1 + tol: over-distortion (removing more than
@@ -200,7 +199,7 @@ def _smart_smooth_grid(port, try_nlevels, nfact, rchi2_tol, wavelet,
         return jnp.sum(r * r, axis=-1) / nbin
 
     best = jnp.zeros_like(port)
-    best_snr = jnp.full(port.shape[:-1], -jnp.inf)
+    best_snr = jnp.full(port.shape[:-1], -jnp.inf, dtype=port.dtype)
     for ilevel in range(try_nlevels):
         # [nfact, ..., nbin] candidates for this decomposition depth
         fgrid = facts.reshape((nfact,) + (1,) * (port.ndim - 1))

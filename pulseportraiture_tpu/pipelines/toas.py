@@ -291,7 +291,7 @@ class GetTOAs:
                  fit_scat=False, log10_tau=True, scat_guess=None,
                  fix_alpha=False, print_phase=False, print_flux=False,
                  print_parangle=False, add_instrumental_response=False,
-                 addtnl_toa_flags={}, method="trust-ncg", bounds=None,
+                 addtnl_toa_flags=None, method="trust-ncg", bounds=None,
                  nu_fits=None, show_plot=False, quiet=None,
                  max_iter=50, checkpoint=None, polish_iter=None,
                  coarse_iter=None, coarse_kmax=None):
@@ -686,7 +686,7 @@ class GetTOAs:
                 if print_parangle:
                     toa_flags["par_angle"] = \
                         float(d.parallactic_angles[isub])
-                toa_flags.update(addtnl_toa_flags)
+                toa_flags.update(addtnl_toa_flags or {})
                 self.TOA_list.append(TOA(
                     datafile, float(r["nu_DM"]), TOA_epoch, TOA_err_us,
                     d.telescope, d.telescope_code, DM_out, DM_err_out,
@@ -776,7 +776,7 @@ class GetTOAs:
                             scat_guess=None, print_phase=False,
                             print_flux=False, print_parangle=False,
                             add_instrumental_response=False,
-                            addtnl_toa_flags={}, method="trust-ncg",
+                            addtnl_toa_flags=None, method="trust-ncg",
                             bounds=None, show_plot=False, quiet=None,
                             max_iter=50, polish_iter=None,
                             coarse_iter=None, coarse_kmax=None):
@@ -1036,7 +1036,7 @@ class GetTOAs:
                 if print_parangle:
                     toa_flags["par_angle"] = \
                         float(d.parallactic_angles[isub])
-                toa_flags.update(addtnl_toa_flags)
+                toa_flags.update(addtnl_toa_flags or {})
                 self.TOA_list.append(TOA(
                     datafile, float(nusx[m]), TOA_epoch, TOA_err_us,
                     d.telescope, d.telescope_code, None, None, toa_flags))

@@ -1,0 +1,6 @@
+"""Exemption check: a file named config.py may mutate jax.config."""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+jax.config.jax_default_matmul_precision = "highest"

@@ -1,0 +1,86 @@
+"""J001 fixtures: Python loops over array axes inside jitted functions.
+
+Lines carrying a violation end with an EXPECT marker comment;
+tests/test_jaxlint.py asserts the linter fires on exactly those lines.
+This file is excluded from the package lint (engine skips
+jaxlint_fixtures/) and from ruff.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_iter_param(x):
+    total = jnp.zeros((), dtype=jnp.float64)
+    for row in x:  # EXPECT: J001
+        total = total + row.sum()
+    return total
+
+
+@jax.jit
+def bad_range_shape(x):
+    acc = x[0]
+    for i in range(x.shape[0]):  # EXPECT: J001
+        acc = acc + x[i]
+    return acc
+
+
+@jax.jit
+def bad_range_len(x):
+    acc = x[0]
+    for i in range(len(x)):  # EXPECT: J001
+        acc = acc + x[i]
+    return acc
+
+
+@jax.jit
+def bad_while_traced(x):
+    while x > 0:  # EXPECT: J001
+        x = x - 1
+    return x
+
+
+@jax.jit
+def bad_enumerate(x):
+    acc = x[0]
+    for i, row in enumerate(x):  # EXPECT: J001
+        acc = acc + row
+    return acc
+
+
+@partial(jax.jit, static_argnames=("n",))
+def ok_static_argname_loop(x, n):
+    for _ in range(n):  # n is static: unrolling is intentional
+        x = x * 2.0
+    return x
+
+
+@partial(jax.jit, static_argnums=(1,))
+def ok_static_argnum_loop(x, n):
+    for _ in range(n):
+        x = x * 2.0
+    return x
+
+
+@jax.jit
+def ok_literal_loop(x):
+    for _ in range(3):  # small fixed unroll
+        x = x + 1.0
+    return x
+
+
+@jax.jit
+def ok_suppressed(x):
+    total = x[0]
+    for row in x:  # jaxlint: disable=J001
+        total = total + row
+    return total
+
+
+def ok_not_jitted(x):
+    for row in x:  # plain python: the loop runs on the host
+        _ = row
+    return x

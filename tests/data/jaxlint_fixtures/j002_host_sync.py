@@ -1,0 +1,51 @@
+"""J002 fixtures: host-sync calls on traced values inside jit."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_float(x):
+    return jnp.sin(float(x))  # EXPECT: J002
+
+
+@jax.jit
+def bad_int(x):
+    return x[int(x[0])]  # EXPECT: J002
+
+
+@jax.jit
+def bad_item(x):
+    return x.sum().item()  # EXPECT: J002
+
+
+@jax.jit
+def bad_tolist(x):
+    return x.tolist()  # EXPECT: J002
+
+
+@jax.jit
+def bad_np_asarray(x):
+    return jnp.asarray(np.asarray(x))  # EXPECT: J002
+
+
+@jax.jit
+def bad_np_array_expr(x):
+    return np.array(x * 2.0)  # EXPECT: J002
+
+
+@jax.jit
+def ok_host_constant(x):
+    # float() of a host-side constant is not a sync
+    return x * float(np.finfo(np.float32).eps)
+
+
+@jax.jit
+def ok_suppressed(x):
+    return float(x)  # jaxlint: disable=J002
+
+
+def ok_not_jitted(x):
+    return float(np.asarray(x).sum())

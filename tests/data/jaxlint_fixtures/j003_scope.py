@@ -1,0 +1,10 @@
+"""Scope check: J003 is path-scoped to ops//fit/ — no findings here."""
+
+import jax.numpy as jnp
+
+
+def fresh_arrays_outside_kernel_scope():
+    # identical code to ops/j003_dtype.py, but outside the kernel layers
+    a = jnp.zeros(4)
+    b = jnp.linspace(0.0, 1.0, 5)
+    return a, b

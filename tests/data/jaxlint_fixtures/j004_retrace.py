@@ -1,0 +1,48 @@
+"""J004 fixtures: jit cache/retrace hazards."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_mutable_default(x, opts=[]):  # EXPECT: J004
+    return x
+
+
+@partial(jax.jit, static_argnames=("table",))
+def bad_static_mutable_default(x, table={}):  # EXPECT: J004
+    return x
+
+
+def _double(y):
+    return y * 2.0
+
+
+def bad_jit_in_function(x):
+    f = jax.jit(_double)  # EXPECT: J004
+    return f(x)
+
+
+def bad_immediate_invocation(x):
+    return jax.jit(_double)(x)  # EXPECT: J004
+
+
+def bad_jit_lambda_in_function(x):
+    f = jax.jit(lambda y: y + 1.0)  # EXPECT: J004
+    return f(x)
+
+
+# module-scope construction is the legitimate pattern
+ok_module_level = jax.jit(_double)
+
+
+@jax.jit
+def ok_tuple_default(x, shape=(4, 4)):
+    return jnp.broadcast_to(x, shape)
+
+
+def ok_suppressed(x):
+    f = jax.jit(_double)  # jaxlint: disable=J004
+    return f(x)

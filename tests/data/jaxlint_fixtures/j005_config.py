@@ -1,0 +1,14 @@
+"""J005 fixtures: jax.config mutation outside config.py."""
+
+import jax
+from jax import config
+
+jax.config.update("jax_enable_x64", True)  # EXPECT: J005
+config.update("jax_debug_nans", True)  # EXPECT: J005
+jax.config.jax_default_matmul_precision = "highest"  # EXPECT: J005
+
+jax.config.update("jax_enable_x64", False)  # jaxlint: disable=J005
+
+
+def mutated_inside_a_function():
+    jax.config.update("jax_platforms", "cpu")  # EXPECT: J005

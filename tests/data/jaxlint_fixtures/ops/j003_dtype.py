@@ -1,0 +1,33 @@
+"""J003 fixtures: dtype-less constructors in a kernel-scope path.
+
+This file lives under an ``ops/`` path segment, which is what arms the
+rule — the same code outside ops//fit/ is exempt (see j003_scope.py).
+"""
+
+import jax.numpy as jnp
+
+
+def fresh_arrays(n):
+    a = jnp.zeros(4)  # EXPECT: J003
+    b = jnp.arange(n)  # EXPECT: J003
+    c = jnp.linspace(0.0, 1.0, 5)  # EXPECT: J003
+    d = jnp.full((2, 2), 0.5)  # EXPECT: J003
+    e = jnp.eye(3)  # EXPECT: J003
+    f = jnp.asarray(1.5)  # EXPECT: J003
+    g = jnp.array([1.0, 2.0])  # EXPECT: J003
+    return a, b, c, d, e, f, g
+
+
+def ok_arrays(x, n):
+    a = jnp.zeros(4, dtype=jnp.float32)
+    b = jnp.zeros((2,), jnp.float32)  # positional dtype
+    c = jnp.arange(n, dtype=jnp.int32)
+    d = jnp.asarray(x)  # dtype-preserving conversion of an array value
+    e = jnp.asarray(1.5, jnp.float32)
+    f = jnp.zeros_like(x)
+    g = jnp.asarray([0, 1, 2])  # int literals don't promote to f64
+    return a, b, c, d, e, f, g
+
+
+def ok_suppressed():
+    return jnp.zeros(3)  # jaxlint: disable=J003

@@ -1,0 +1,10 @@
+"""File-wide pragma fixture: every J003 here is suppressed."""
+# jaxlint: disable-file=J003
+
+import jax.numpy as jnp
+
+
+def fresh_arrays():
+    a = jnp.zeros(4)
+    b = jnp.linspace(0.0, 1.0, 5)
+    return a, b

@@ -1,7 +1,6 @@
 """Golden tests for ops.fourier against NumPy oracles."""
 
 import numpy as np
-import pytest
 
 from pulseportraiture_tpu.config import Dconst
 from pulseportraiture_tpu.ops import fourier as f

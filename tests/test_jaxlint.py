@@ -1,0 +1,89 @@
+"""jaxlint rule tests over the fixture corpus.
+
+Each fixture marks its violations with ``# EXPECT: JXXX`` on the
+offending line; the linter must fire on exactly those (rule, line)
+pairs and nowhere else — which also proves the ``# jaxlint: disable=``
+pragmas in the fixtures suppress what they claim to.
+"""
+
+import re
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.jaxlint import RULES, lint_file, lint_source  # noqa: E402
+
+FIXTURES = REPO / "tests" / "data" / "jaxlint_fixtures"
+_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([A-Z0-9, ]+)")
+
+ALL_FIXTURES = sorted(FIXTURES.rglob("*.py"))
+
+
+def _expected(path):
+    exp = set()
+    for lineno, line in enumerate(path.read_text().splitlines(), 1):
+        m = _EXPECT_RE.search(line)
+        if m:
+            for rid in m.group(1).split(","):
+                exp.add((rid.strip(), lineno))
+    return exp
+
+
+def test_fixture_corpus_present():
+    # every rule must be exercised by at least one fixture expectation
+    all_expected = set()
+    for path in ALL_FIXTURES:
+        all_expected |= {rule for rule, _ in _expected(path)}
+    assert all_expected == set(RULES), \
+        "fixtures do not cover every rule: %s" % sorted(
+            set(RULES) - all_expected)
+
+
+@pytest.mark.parametrize(
+    "path", ALL_FIXTURES,
+    ids=[str(p.relative_to(FIXTURES)) for p in ALL_FIXTURES])
+def test_fixture_findings_match(path):
+    findings, _ = lint_file(path)
+    got = {(f.rule, f.line) for f in findings}
+    assert got == _expected(path), (
+        "jaxlint findings diverge from the fixture's EXPECT markers.\n"
+        "unexpected: %s\nmissing: %s"
+        % (sorted(got - _expected(path)), sorted(_expected(path) - got)))
+
+
+def test_line_pragma_counts_as_suppressed():
+    findings, nsup = lint_file(FIXTURES / "j001_loops.py")
+    assert nsup == 1  # the ok_suppressed loop
+
+
+def test_filewide_pragma_suppresses_all():
+    findings, nsup = lint_file(FIXTURES / "ops" / "j003_filewide.py")
+    assert findings == []
+    assert nsup == 2
+
+
+def test_config_py_exempt_from_j005():
+    findings, nsup = lint_file(FIXTURES / "config.py")
+    assert findings == [] and nsup == 0
+
+
+def test_select_restricts_rules():
+    findings, _ = lint_file(FIXTURES / "ops" / "j003_dtype.py",
+                            select=["J001"])
+    assert findings == []
+
+
+def test_syntax_error_is_a_finding():
+    findings, _ = lint_source("def broken(:\n", "broken.py")
+    assert len(findings) == 1 and findings[0].rule == "J000"
+
+
+def test_finding_render_is_clickable():
+    findings, _ = lint_file(FIXTURES / "j005_config.py")
+    line = findings[0].render()
+    assert re.match(r".+\.py:\d+:\d+: J005 ", line)

@@ -1,0 +1,46 @@
+"""Gate: the package tree must lint clean; seeded fixtures must not.
+
+This is the test-suite wiring of the static half of the safety net —
+any PR that introduces a J001-J005 hazard into pulseportraiture_tpu/
+fails here (or carries an explicit, reviewable pragma).
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.jaxlint import lint_paths  # noqa: E402
+
+
+def test_package_lints_clean():
+    findings, _, nfiles = lint_paths([REPO / "pulseportraiture_tpu"])
+    assert nfiles > 40, "package walk looks truncated (%d files)" % nfiles
+    assert findings == [], "unsuppressed jaxlint findings:\n%s" % \
+        "\n".join(f.render() for f in findings)
+
+
+def test_tools_lint_clean_too():
+    # the linter and perf tools hold themselves to the same rules
+    findings, _, _ = lint_paths([REPO / "tools"])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_exit_zero_on_package():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.jaxlint", "pulseportraiture_tpu"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_exit_nonzero_on_seeded_violations():
+    fixture = Path("tests") / "data" / "jaxlint_fixtures" / "ops" / \
+        "j003_dtype.py"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.jaxlint", str(fixture)],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "J003" in proc.stdout

@@ -8,8 +8,7 @@ from pulseportraiture_tpu.io.archive import make_fake_pulsar
 from pulseportraiture_tpu.io.gmodel import write_model as write_gmodel
 from pulseportraiture_tpu.io.splmodel import read_spline_model
 from pulseportraiture_tpu.models.spline import (SplineModelPortrait,
-                                                make_spline_model,
-                                                write_model)
+                                                make_spline_model)
 from pulseportraiture_tpu.ops.pca import (find_significant_eigvec, pca,
                                           reconstruct_portrait)
 from pulseportraiture_tpu.ops.profiles import gaussian_profile

@@ -9,8 +9,7 @@ per-subint periods differ.
 import numpy as np
 import pytest
 
-from pulseportraiture_tpu.io.polyco import (ChebyModel, ChebyModelSet,
-                                            parse_polyco_text,
+from pulseportraiture_tpu.io.polyco import (parse_polyco_text,
                                             parse_t2predict_text,
                                             polyco_from_spin)
 from pulseportraiture_tpu.io.psrfits import (read_archive,

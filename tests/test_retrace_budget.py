@@ -1,0 +1,113 @@
+"""Retrace sanitizer regression tests (pulseportraiture_tpu.debug).
+
+The load-bearing guarantee: running the portrait fit twice over
+same-shaped batches traces each jit boundary exactly once — the second
+batch must be a pure cache hit.  A regression here (a varying Python
+scalar reaching a traced position, an unstable static arg) costs one
+full XLA compile per batch through the device tunnel, silently erasing
+every BENCH win.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pulseportraiture_tpu import debug
+from pulseportraiture_tpu.fit import portrait as fp
+
+# deliberately odd geometry + iteration budget so this test's static
+# config never collides with programs other tests already compiled in
+# the shared pytest process (the cache-delta assertions stay exact)
+NBIN = 96
+NCHAN = 5
+B = 7
+MAX_ITER = 37
+P0 = 0.004
+FREQS = np.linspace(1220.0, 1580.0, NCHAN)
+
+
+def _make_batch(seed):
+    rng = np.random.default_rng(seed)
+    phases = (np.arange(NBIN) + 0.5) / NBIN
+    prof = np.exp(-0.5 * ((phases - 0.5) / 0.02) ** 2)
+    model = np.broadcast_to(prof, (NCHAN, NBIN)).copy()
+    data = model[None] * rng.uniform(0.8, 1.2, (B, NCHAN, 1)) \
+        + rng.normal(0.0, 0.01, (B, NCHAN, NBIN))
+    return model, data
+
+
+def _fit(data, model):
+    out = fp.fit_portrait_full_batch(
+        data, model, None, P0, FREQS,
+        errs=np.full((B, NCHAN), 0.01), max_iter=MAX_ITER)
+    jax.block_until_ready(out.params)
+    return out
+
+
+def test_one_trace_per_jit_boundary(monkeypatch):
+    monkeypatch.setenv("PPTPU_SANITIZE", "1")
+    model, data1 = _make_batch(1)
+    _, data2 = _make_batch(2)
+
+    # _batch_impl is the top-level jit boundary the pipelines dispatch
+    # through; _solve traces *inside* it (inner jit calls don't populate
+    # their own top-level cache), so _batch_impl's cache is the
+    # boundary count
+    solve0 = fp._solve._cache_size()
+    batch0 = fp._batch_impl._cache_size()
+    with debug.trace_counter() as c1:
+        _fit(data1, model)
+    # exactly one new traced variant for a fresh configuration
+    assert fp._batch_impl._cache_size() - batch0 == 1
+    assert c1.compiles > 0  # the counter saw the compilation happen
+
+    with debug.trace_counter() as c2:
+        _fit(data2, model)  # same shapes/config, different values
+    assert c2.traces == 0 and c2.compiles == 0, \
+        "same-shaped second batch retraced: %r" % c2
+    assert fp._batch_impl._cache_size() - batch0 == 1
+    assert fp._solve._cache_size() == solve0
+
+
+def test_retrace_budget_violation_raises(monkeypatch):
+    monkeypatch.setenv("PPTPU_SANITIZE", "1")
+
+    @debug.retrace_budget(budget=1, name="toy")
+    @jax.jit
+    def f(x):
+        return x * 2.0
+
+    f(jnp.ones(3))
+    with pytest.raises(debug.RetraceError, match="toy traced 2"):
+        f(jnp.ones(5))  # second shape bucket exceeds the budget of 1
+
+
+def test_retrace_budget_disabled_is_noop(monkeypatch):
+    monkeypatch.delenv("PPTPU_SANITIZE", raising=False)
+
+    @debug.retrace_budget(budget=1)
+    @jax.jit
+    def f(x):
+        return x * 2.0
+
+    f(jnp.ones(3))
+    f(jnp.ones(5))  # over budget, but the sanitizer is off
+    assert f._cache_size() == 2  # attribute passthrough to the jit fn
+
+
+def test_nan_hook_fires_on_poisoned_batch(monkeypatch):
+    monkeypatch.setenv("PPTPU_SANITIZE", "1")
+    model, data = _make_batch(3)
+    data[0, 0, 0] = np.nan
+    with pytest.raises(debug.NonFiniteError):
+        _fit(data, model)
+
+
+def test_nan_hook_warn_mode(monkeypatch):
+    monkeypatch.setenv("PPTPU_SANITIZE", "warn")
+    model, data = _make_batch(4)
+    data[0, 0, 0] = np.nan
+    with pytest.warns(RuntimeWarning):
+        _fit(data, model)

@@ -14,7 +14,6 @@ plays their role.)
 import json
 import os
 
-import numpy as np
 import pytest
 
 from pulseportraiture_tpu.pipelines.timing import (parse_tim,

@@ -1,0 +1,2 @@
+"""Repo tooling: perf probes (perf_probe.py, trace_summary.py) and the
+jaxlint static-analysis package (``python -m tools.jaxlint``)."""

@@ -7,7 +7,6 @@ PYTHONPATH=/root/.axon_site:/root/repo python tools/ab_solver.py
 """
 
 import sys
-import time
 
 import numpy as np
 

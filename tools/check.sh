@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# One-command verification gate (see docs/LINTING.md):
+#
+#   1. jaxlint  — repo-native JAX/TPU static analysis (J001-J005)
+#   2. ruff     — generic python lint (skipped when not installed;
+#                 configuration lives in pyproject.toml [tool.ruff])
+#   3. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
+#
+# Exit status is non-zero when any stage fails.
+set -u
+cd "$(dirname "$0")/.."
+
+fail=0
+
+echo "== jaxlint (python -m tools.jaxlint) =="
+python -m tools.jaxlint pulseportraiture_tpu tools || fail=1
+
+echo
+echo "== ruff =="
+if command -v ruff >/dev/null 2>&1; then
+    ruff check . || fail=1
+else
+    echo "ruff not installed — skipped (pip install ruff to enable)"
+fi
+
+echo
+echo "== tier-1 tests (ROADMAP.md) =="
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+[ "$rc" -ne 0 ] && fail=1
+
+exit $fail

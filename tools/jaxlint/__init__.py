@@ -1,0 +1,32 @@
+"""jaxlint: repo-native static analysis for the JAX/TPU timing stack.
+
+Five AST rules encode the invariants the kernels in this repo depend on
+(see docs/LINTING.md for the full catalogue and rationale):
+
+* J001 — Python ``for``/``while`` loop over an array axis inside a
+  ``@jax.jit``-decorated function (unrolls at trace time; use
+  ``lax.scan``/``vmap``/``fori_loop``).
+* J002 — host-sync call (``float()``, ``int()``, ``.item()``,
+  ``.tolist()``, ``np.asarray``) on a traced value inside a jitted
+  function.
+* J003 — dtype-less array constructor (``jnp.zeros``/``arange``/
+  ``linspace``/float-literal ``asarray`` ...) in the ``ops/`` and
+  ``fit/`` kernel layers, where an implicit f64/complex128 default is a
+  TPU hazard.
+* J004 — retrace/cache hazards around ``jax.jit`` itself: mutable
+  default arguments on jitted functions, ``jax.jit`` applied inside a
+  function body (fresh compile cache per call), immediate
+  ``jax.jit(f)(...)`` invocation.
+* J005 — ``jax.config`` mutation outside ``config.py``.
+
+Suppress a finding with a same-line ``# jaxlint: disable=J00X`` pragma
+(comma-separate several IDs, or ``disable=all``); a whole file opts out
+of one rule with ``# jaxlint: disable-file=J00X`` on any line.
+
+Run as ``python -m tools.jaxlint pulseportraiture_tpu``.
+"""
+
+from .engine import Finding, lint_file, lint_paths, lint_source
+from .rules import RULES
+
+__all__ = ["Finding", "lint_file", "lint_paths", "lint_source", "RULES"]

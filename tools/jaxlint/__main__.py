@@ -1,0 +1,55 @@
+"""CLI: ``python -m tools.jaxlint [paths...] [--select J001,J003]``.
+
+Exit status 0 when the tree is clean, 1 when findings remain, 2 on
+usage errors.  Rule catalogue and suppression syntax: docs/LINTING.md.
+"""
+
+import argparse
+import sys
+
+from .engine import lint_paths, report
+from .rules import RULES
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.jaxlint",
+        description="Repo-native JAX/TPU static analysis (rules "
+                    "J001-J005; see docs/LINTING.md).")
+    parser.add_argument("paths", nargs="*", default=["pulseportraiture_tpu"],
+                        help="files or directories to lint "
+                             "(default: pulseportraiture_tpu)")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule IDs to enable "
+                             "(default: all)")
+    parser.add_argument("--statistics", action="store_true",
+                        help="print per-rule counts after the findings")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalogue and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(RULES):
+            print("%s  %s" % (rule, RULES[rule]))
+        return 0
+
+    select = None
+    if args.select:
+        select = [s.strip().upper() for s in args.select.split(",") if
+                  s.strip()]
+        unknown = [s for s in select if s not in RULES]
+        if unknown:
+            print("unknown rule(s): %s" % ", ".join(unknown),
+                  file=sys.stderr)
+            return 2
+
+    findings, nsup, nfiles = lint_paths(args.paths, select=select)
+    if nfiles == 0:
+        print("jaxlint: no python files found under: %s"
+              % " ".join(args.paths), file=sys.stderr)
+        return 2
+    return report(findings, nsup, nfiles, statistics=args.statistics)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

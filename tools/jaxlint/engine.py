@@ -1,0 +1,145 @@
+"""jaxlint engine: pragma handling, file walking, reporting.
+
+The rule logic lives in rules.py; this module turns (source, path) into
+pragma-filtered Finding records and provides the CLI entry points.
+"""
+
+import ast
+import io
+import re
+import sys
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+
+from .rules import RULES, run_rules
+
+__all__ = ["Finding", "lint_source", "lint_file", "lint_paths", "report"]
+
+_PRAGMA_RE = re.compile(
+    r"#\s*jaxlint:\s*(disable|disable-file)\s*=\s*"
+    r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+# directories never worth descending into
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "build", "dist",
+              "jaxlint_fixtures"}
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def render(self):
+        return "%s:%d:%d: %s %s" % (self.path, self.line, self.col + 1,
+                                    self.rule, self.message)
+
+
+def _pragmas(source):
+    """(line -> set of disabled rule IDs, file-wide disabled IDs).
+
+    ``# jaxlint: disable=J001[,J002...]`` suppresses on its own line;
+    ``# jaxlint: disable-file=J001`` (any line) suppresses file-wide;
+    the ID ``all`` matches every rule.
+    """
+    per_line = {}
+    per_file = set()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA_RE.search(tok.string)
+            if not m:
+                continue
+            ids = {s.strip().upper() for s in m.group(2).split(",")}
+            if m.group(1) == "disable-file":
+                per_file |= ids
+            else:
+                per_line.setdefault(tok.start[0], set()).update(ids)
+    except tokenize.TokenError:
+        pass
+    return per_line, per_file
+
+
+def _suppressed(rule, line, per_line, per_file):
+    if "ALL" in per_file or rule in per_file:
+        return True
+    ids = per_line.get(line, ())
+    return "ALL" in ids or rule in ids
+
+
+def lint_source(source, path, select=None):
+    """Lint one module's source text.
+
+    ``path`` scopes the path-sensitive rules (J003 kernel layers, J005
+    config.py exemption) and labels the findings; ``select`` restricts
+    to an iterable of rule IDs.  Returns (findings, n_suppressed); a
+    syntax error surfaces as a single J000 finding rather than a crash
+    (a file the linter cannot parse cannot be certified clean).
+    """
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as e:
+        return [Finding(str(path), e.lineno or 1, (e.offset or 1) - 1,
+                        "J000", "syntax error: %s" % e.msg)], 0
+    per_line, per_file = _pragmas(source)
+    selected = None if select is None else {s.upper() for s in select}
+    findings, nsup = [], 0
+    for rule, line, col, message in run_rules(tree, str(path)):
+        if selected is not None and rule not in selected:
+            continue
+        if _suppressed(rule, line, per_line, per_file):
+            nsup += 1
+            continue
+        findings.append(Finding(str(path), line, col, rule, message))
+    return sorted(findings), nsup
+
+
+def lint_file(path, select=None):
+    with open(path, encoding="utf-8") as fh:
+        return lint_source(fh.read(), path, select=select)
+
+
+def _iter_py_files(paths):
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if not any(part in _SKIP_DIRS for part in f.parts):
+                    yield f
+        elif p.suffix == ".py":
+            yield p
+
+
+def lint_paths(paths, select=None):
+    """Lint files/directories; returns (findings, n_suppressed,
+    n_files)."""
+    findings, nsup, nfiles = [], 0, 0
+    for f in _iter_py_files(paths):
+        nfiles += 1
+        fnd, sup = lint_file(f, select=select)
+        findings.extend(fnd)
+        nsup += sup
+    return findings, nsup, nfiles
+
+
+def report(findings, nsup, nfiles, stream=sys.stdout, statistics=False):
+    """Human-readable report; returns the process exit code."""
+    for f in findings:
+        print(f.render(), file=stream)
+    if statistics and findings:
+        counts = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        print("", file=stream)
+        for rule in sorted(counts):
+            print("%-5s %4d  %s" % (rule, counts[rule],
+                                    RULES.get(rule, "")), file=stream)
+    tail = " (%d suppressed by pragma)" % nsup if nsup else ""
+    print("jaxlint: %d finding(s) in %d file(s)%s"
+          % (len(findings), nfiles, tail), file=stream)
+    return 1 if findings else 0

@@ -1,0 +1,375 @@
+"""AST rules J001-J005.
+
+Each rule favors precision over recall: a finding should point at a
+*real* JAX/TPU hazard, and patterns the checker cannot resolve
+statically (locals derived from parameters, cross-function dataflow)
+are deliberately out of scope rather than guessed at.  The catalogue,
+rationale, and known blind spots are documented in docs/LINTING.md.
+"""
+
+import ast
+from pathlib import PurePath
+
+RULES = {
+    "J001": "Python loop over an array axis inside a jitted function "
+            "(unrolled at trace time; use lax.scan/vmap/fori_loop)",
+    "J002": "host-sync call on a traced value inside a jitted function",
+    "J003": "array constructor without an explicit dtype in a kernel "
+            "module (implicit f64/complex128 promotion risk on TPU)",
+    "J004": "jax.jit cache/retrace hazard (mutable default, per-call "
+            "jit construction, or immediate invocation)",
+    "J005": "jax.config mutated outside config.py",
+}
+
+# jnp constructors that materialize a FRESH array with a default dtype,
+# mapped to the 1-based positional slot their dtype argument occupies
+# (dtype passed positionally counts as explicit).
+_FRESH_CONSTRUCTORS = {
+    "zeros": 2, "ones": 2, "empty": 2, "identity": 2,
+    "full": 3, "eye": 4, "arange": 4, "linspace": 6,
+}
+
+_HOST_SYNC_CALLS = {"float", "int", "bool", "complex"}
+_HOST_SYNC_NP = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_HOST_SYNC_METHODS = {"item", "tolist"}
+
+_JNP_PREFIXES = ("jnp.", "jax.numpy.")
+
+
+def dotted_name(node):
+    """'jax.numpy.zeros'-style dotted string for a Name/Attribute chain,
+    or None for anything more dynamic (calls, subscripts, ...)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_expr(node):
+    return dotted_name(node) in ("jax.jit", "jit")
+
+
+def _static_argnames(call):
+    """Static parameter names declared on a jax.jit(...) /
+    partial(jax.jit, ...) call expression (string constants only)."""
+    names = set()
+    nums = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, str):
+                        names.add(el.value)
+        elif kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums.append(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, int):
+                        nums.append(el.value)
+    return names, nums
+
+
+def _jit_decoration(func):
+    """(is_jitted, static_names) from a function's decorator list.
+
+    Recognizes @jax.jit, @jit, @jax.jit(...), and
+    @[functools.]partial(jax.jit, ...).
+    """
+    for dec in func.decorator_list:
+        if _is_jit_expr(dec):
+            return True, set()
+        if isinstance(dec, ast.Call):
+            if _is_jit_expr(dec.func):
+                names, nums = _static_argnames(dec)
+            elif dotted_name(dec.func) in ("partial", "functools.partial") \
+                    and dec.args and _is_jit_expr(dec.args[0]):
+                names, nums = _static_argnames(dec)
+            else:
+                continue
+            params = [a.arg for a in (func.args.posonlyargs
+                                      + func.args.args)]
+            for i in nums:
+                if 0 <= i < len(params):
+                    names.add(params[i])
+            return True, names
+    return False, set()
+
+
+def _param_names(func):
+    args = func.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return names
+
+
+def _float_literalish(node):
+    """True for float literals (incl. signed) and list/tuple literals
+    containing at least one float element — the forms where a dtype-less
+    jnp.asarray/array bakes in the x64-default f64."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, float)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op,
+                                                    (ast.UAdd, ast.USub)):
+        return _float_literalish(node.operand)
+    if isinstance(node, (ast.List, ast.Tuple)):
+        elts = node.elts
+        return bool(elts) and any(_float_literalish(e) for e in elts) \
+            and all(isinstance(e, ast.Constant)
+                    or _float_literalish(e) for e in elts)
+    return False
+
+
+class _FuncCtx:
+    __slots__ = ("node", "jitted", "static_names", "params")
+
+    def __init__(self, node, jitted, static_names):
+        self.node = node
+        self.jitted = jitted
+        self.static_names = static_names
+        self.params = set(_param_names(node))
+
+
+class RuleVisitor(ast.NodeVisitor):
+    """Single-pass visitor applying all rules to one module."""
+
+    def __init__(self, path):
+        parts = PurePath(path).parts
+        self.findings = []
+        # J003 applies in the kernel layers; J005 everywhere but config.py
+        self.dtype_scope = any(p in ("ops", "fit") for p in parts)
+        self.is_config = parts[-1] == "config.py" if parts else False
+        self.stack = []
+        # inner jit-calls already reported as immediate invocations
+        self._reported_jit_calls = set()
+
+    def _add(self, rule, node, detail):
+        self.findings.append((rule, node.lineno, node.col_offset, detail))
+
+    # -- jit context helpers ------------------------------------------------
+
+    def _in_jit(self):
+        return any(ctx.jitted for ctx in self.stack)
+
+    def _traced_names(self):
+        """Parameter names that hold traced values in the current scope:
+        every param of the nearest jitted ancestor (minus its declared
+        static args) and of all functions nested inside it."""
+        names = set()
+        start = None
+        for i, ctx in enumerate(self.stack):
+            if ctx.jitted:
+                start = i
+                break
+        if start is None:
+            return names
+        for ctx in self.stack[start:]:
+            names |= ctx.params - ctx.static_names
+        return names
+
+    def _refs_traced(self, node):
+        traced = self._traced_names()
+        return any(isinstance(n, ast.Name) and n.id in traced
+                   for n in ast.walk(node))
+
+    # -- function scaffolding ----------------------------------------------
+
+    def _visit_func(self, node):
+        jitted, static_names = _jit_decoration(node)
+        if jitted:
+            self._check_mutable_defaults(node)
+        self.stack.append(_FuncCtx(node, jitted, static_names))
+        # visit the body only: decorator expressions and defaults are
+        # evaluated at definition time, outside the traced scope (and a
+        # jit call in a decorator is the legitimate construction site)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def _check_mutable_defaults(self, func):
+        args = func.args
+        pos = args.posonlyargs + args.args
+        pos_defaults = list(zip(pos[len(pos) - len(args.defaults):],
+                                args.defaults))
+        kw_defaults = [(a, d) for a, d in zip(args.kwonlyargs,
+                                              args.kw_defaults)
+                       if d is not None]
+        for arg, default in pos_defaults + kw_defaults:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set,
+                                    ast.ListComp, ast.DictComp,
+                                    ast.SetComp)) or (
+                    isinstance(default, ast.Call)
+                    and dotted_name(default.func) in ("list", "dict",
+                                                      "set")):
+                self._add("J004", default,
+                          "jitted function '%s' has a mutable default "
+                          "for '%s' — unhashable as a static arg and a "
+                          "shared-state trap; use None or a tuple"
+                          % (func.name, arg.arg))
+
+    # -- J001 ---------------------------------------------------------------
+
+    def _loop_over_array(self, it):
+        """True when a loop's iterator syntactically spans an array axis
+        of a traced value."""
+        traced = self._traced_names()
+        if isinstance(it, ast.Name):
+            return it.id in traced
+        if isinstance(it, ast.Call):
+            fname = dotted_name(it.func)
+            if fname in ("range", "enumerate", "zip", "reversed"):
+                return any(self._loop_over_array(a) or
+                           self._iter_len_of_traced(a) for a in it.args)
+        return False
+
+    def _iter_len_of_traced(self, node):
+        traced = self._traced_names()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Attribute) and n.attr == "shape" and \
+                    isinstance(n.value, ast.Name) and \
+                    n.value.id in traced:
+                return True
+            if isinstance(n, ast.Call) and dotted_name(n.func) == "len" \
+                    and n.args and isinstance(n.args[0], ast.Name) and \
+                    n.args[0].id in traced:
+                return True
+        return False
+
+    def visit_For(self, node):
+        if self._in_jit() and (self._loop_over_array(node.iter)
+                               or self._iter_len_of_traced(node.iter)):
+            self._add("J001", node,
+                      "Python for-loop over an array axis inside a "
+                      "jitted function — this unrolls at trace time; "
+                      "use lax.scan/vmap/fori_loop")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        if self._in_jit() and self._refs_traced(node.test):
+            self._add("J001", node,
+                      "Python while-loop conditioned on a traced value "
+                      "inside a jitted function — use lax.while_loop")
+        self.generic_visit(node)
+
+    # -- calls: J002 / J003 / J004 / J005 ----------------------------------
+
+    def visit_Call(self, node):
+        fname = dotted_name(node.func)
+
+        # J005: jax.config mutation
+        if not self.is_config and fname is not None:
+            if fname == "jax.config.update" or (
+                    fname.endswith("config.update") and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value.startswith("jax_")):
+                self._add("J005", node,
+                          "jax.config mutated outside config.py — global "
+                          "numerics/backend policy lives in config.py "
+                          "only")
+
+        # J004: jit constructed per call / immediately invoked
+        if isinstance(node.func, ast.Call) and _is_jit_expr(node.func.func):
+            self._add("J004", node,
+                      "jax.jit(f)(...) compiles into a cache that is "
+                      "dropped immediately — bind the jitted function "
+                      "once at module scope")
+            self._reported_jit_calls.add(id(node.func))
+        elif _is_jit_expr(node.func) and self.stack and \
+                id(node) not in self._reported_jit_calls:
+            self._add("J004", node,
+                      "jax.jit applied inside a function body — the "
+                      "compilation cache is keyed on the fresh wrapper "
+                      "and lost on return (silent recompiles); jit at "
+                      "module scope")
+
+        # J002: host sync on traced values
+        if self._in_jit():
+            if fname in _HOST_SYNC_CALLS and node.args and \
+                    self._refs_traced(node.args[0]):
+                self._add("J002", node,
+                          "%s() on a traced value inside a jitted "
+                          "function — host sync breaks tracing; keep "
+                          "it as an array op" % fname)
+            elif fname in _HOST_SYNC_NP and node.args and \
+                    self._refs_traced(node.args[0]):
+                self._add("J002", node,
+                          "%s on a traced value inside a jitted "
+                          "function — materializes to host; use jnp"
+                          % fname)
+            elif fname is None and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _HOST_SYNC_METHODS and \
+                    self._refs_traced(node.func.value):
+                self._add("J002", node.func,
+                          ".%s() on a traced value inside a jitted "
+                          "function — host sync breaks tracing"
+                          % node.func.attr)
+            elif fname is not None and "." in fname:
+                head, attr = fname.rsplit(".", 1)
+                if attr in _HOST_SYNC_METHODS and \
+                        self._refs_traced(node.func):
+                    self._add("J002", node,
+                              ".%s() on a traced value inside a jitted "
+                              "function — host sync breaks tracing"
+                              % attr)
+
+        # J003: dtype-less constructors in kernel modules
+        if self.dtype_scope and fname is not None and \
+                fname.startswith(_JNP_PREFIXES):
+            attr = fname.rsplit(".", 1)[1]
+            has_dtype = any(kw.arg == "dtype" for kw in node.keywords)
+            if attr in _FRESH_CONSTRUCTORS:
+                if not has_dtype and \
+                        len(node.args) < _FRESH_CONSTRUCTORS[attr]:
+                    self._add("J003", node,
+                              "jnp.%s without an explicit dtype in a "
+                              "kernel module — the x64-default here is "
+                              "f64, which degrades or breaks TPU "
+                              "kernels; pass dtype= explicitly" % attr)
+            elif attr in ("asarray", "array"):
+                if not has_dtype and len(node.args) == 1 and \
+                        _float_literalish(node.args[0]):
+                    self._add("J003", node,
+                              "jnp.%s of a float literal without dtype "
+                              "in a kernel module — promotes to f64 "
+                              "under x64; pass dtype= explicitly" % attr)
+
+        self.generic_visit(node)
+
+    # -- J005: attribute-assignment form -----------------------------------
+
+    def visit_Assign(self, node):
+        if not self.is_config:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute):
+                    base = dotted_name(tgt.value)
+                    if base in ("jax.config", "config") and \
+                            tgt.attr.startswith("jax_"):
+                        self._add("J005", node,
+                                  "jax.config attribute assigned outside "
+                                  "config.py — global numerics/backend "
+                                  "policy lives in config.py only")
+        self.generic_visit(node)
+
+
+def run_rules(tree, path):
+    """All raw findings (rule, line, col, message) for a parsed module."""
+    v = RuleVisitor(path)
+    v.visit(tree)
+    return v.findings
