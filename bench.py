@@ -50,6 +50,7 @@ from bench_common import (COARSE_ITER, MODEL_PARAMS, NOISE, P0,
                           POLISH_ITER, SCAT_COARSE_KMAX, TAU_INJ,
                           NorthStar, enable_compile_cache, materialize,
                           stage as _stage, timed_passes)
+from pulseportraiture_tpu import obs
 
 # kill -USR1 <pid> dumps all Python stacks to stderr (hang diagnosis)
 faulthandler.register(signal.SIGUSR1, all_threads=True)
@@ -194,6 +195,7 @@ def _hetero_stress(on_accel):
         shutil.rmtree(hdir, ignore_errors=True)
 
 
+@obs.scoped_run("bench")
 def main():
     import jax
     import jax.numpy as jnp
@@ -203,8 +205,11 @@ def main():
     from pulseportraiture_tpu.config import Dconst
     from pulseportraiture_tpu.fit.portrait import fit_portrait_full_batch
 
+    # NorthStar resolves the backend itself (bench_common.
+    # resolve_devices): a dead accelerator tunnel degrades the round
+    # to CPU with "backend_fallback": true in the JSON instead of rc=1
     ns = NorthStar(jax)
-    platform = jax.devices()[0].platform
+    platform = ns.platform
     on_accel = ns.on_accel
     nsub, nchan, nbin, scan = ns.nsub, ns.nchan, ns.nbin, ns.scan
     fit_dtype = ns.fit_dtype
@@ -212,21 +217,29 @@ def main():
     phis_inj, dDMs_inj = ns.phis_inj, ns.dDMs_inj
     errs, Ps = ns.errs, ns.Ps
     model64_dev, KMAX = ns.model64_dev, ns.kmax
+    obs.configure(pipeline="bench", platform=platform,
+                  backend_fallback=ns.backend_fallback,
+                  nsub=nsub, nchan=nchan, nbin=nbin, scan=scan,
+                  kmax=int(KMAX))
 
-    data_all = ns.main_data()
+    with obs.span("load", config="main"):
+        data_all = ns.main_data()
     _stage('data generated on device')
 
     _stage('compiling seed+fit program')
-    materialize(ns.fit_main(data_all).phi)
+    with obs.span("compile", config="main"):
+        materialize(ns.fit_main(data_all).phi)
     _stage('compiled; timing main config')
 
     # timed end-to-end on device (seed + scanned fit = ONE dispatch);
     # best of two passes — the TPU tunnel's dispatch latency varies
     # with ambient host load, and the sustained-throughput number is
     # the less-loaded pass
-    duration, out = timed_passes(lambda: ns.fit_main(data_all),
-                                 lambda o: materialize(o.phi),
-                                 'main config')
+    with obs.span("solve", config="main"), \
+            obs.trace_capture("bench_main"):
+        duration, out = timed_passes(lambda: ns.fit_main(data_all),
+                                     lambda o: materialize(o.phi),
+                                     'main config')
 
     # accuracy vs injections: transform fitted phi back to the injection
     # reference frequency and compare [ns]
@@ -310,10 +323,13 @@ def main():
     scat_data = ns.scat_data(scat_B)
 
     _stage('scattering fit: compiling')
-    materialize(ns.fit_scat(scat_data, scat_B).phi)  # compile
-    scat_dur, sout = timed_passes(lambda: ns.fit_scat(scat_data, scat_B),
-                                  lambda o: materialize(o.phi),
-                                  'scattering')
+    with obs.span("compile", config="scat"):
+        materialize(ns.fit_scat(scat_data, scat_B).phi)  # compile
+    with obs.span("solve", config="scat"), \
+            obs.trace_capture("bench_scat"):
+        scat_dur, sout = timed_passes(
+            lambda: ns.fit_scat(scat_data, scat_B),
+            lambda o: materialize(o.phi), 'scattering')
     tau_fit = np.median(10 ** materialize(sout.tau))
 
     # scattering parity: the coarse-harmonic f32 stage + capped polish
@@ -376,10 +392,12 @@ def main():
             log10_tau=False, max_iter=20, kmax=i_kmax)
 
     _stage('IPTA sweep: compiling')
-    materialize(ipta_run().phi)  # compile
-    ipta_dur, iout = timed_passes(ipta_run,
-                                  lambda o: materialize(o.phi),
-                                  'IPTA sweep')
+    with obs.span("compile", config="ipta"):
+        materialize(ipta_run().phi)  # compile
+    with obs.span("solve", config="ipta"):
+        ipta_dur, iout = timed_passes(ipta_run,
+                                      lambda o: materialize(o.phi),
+                                      'IPTA sweep')
 
     # ---- ppalign batch (BASELINE row 4: 500 homogeneous archives) -----
     # the full 500-archive config, driver-captured every round (r04 ran
@@ -387,11 +405,13 @@ def main():
     # streaming blocks cap resident subints so host memory stays flat.
     # Generation (host-side FITS writing) is outside the timed region
     n_arch = 500 if on_accel else 8
-    align_dur = _align_batch(n_arch=n_arch)
+    with obs.span("align", n_arch=n_arch):
+        align_dur = _align_batch(n_arch=n_arch)
 
     # ---- heterogeneous-shape GetTOAs stress (mixed channelizations) ---
-    hetero_cold, hetero_warm, hetero_ntoa, hetero_config = \
-        _hetero_stress(on_accel)
+    with obs.span("hetero"):
+        hetero_cold, hetero_warm, hetero_ntoa, hetero_config = \
+            _hetero_stress(on_accel)
 
     # ---- rough sustained FLOP/s for the main config -------------------
     # per subint: rFFT (5 N log2 N per channel) + ~n_iter fused moment
@@ -440,8 +460,10 @@ def main():
                                               3),
             "hetero_config": hetero_config + " incl. FITS IO",
             "gflops_approx": round(float(gflops), 1),
+            "backend_fallback": ns.backend_fallback,
         },
     }
+    obs.event("result", **result)
     print(json.dumps(result))
     return 0
 

@@ -32,6 +32,33 @@ def enable_compile_cache(jax):
         stage("compilation cache unavailable: %s" % e)
 
 
+def resolve_devices(jax):
+    """Default device list with a CPU fallback when the accelerator
+    backend cannot initialize.
+
+    ``jax.devices()`` raises RuntimeError when the configured platform
+    (the axon TPU tunnel here) fails backend setup — which killed whole
+    bench rounds with rc=1 (BENCH_r05.json) even though every stage
+    runs fine on the CPU smoke config.  On failure the platform is
+    re-pinned to cpu and the bench proceeds, *recording* the fallback:
+    returns (devices, backend_fallback) so callers can carry
+    ``"backend_fallback": true`` in their JSON instead of crashing —
+    a degraded-but-evidenced run beats no run.
+    """
+    try:
+        return jax.devices(), False
+    except RuntimeError as e:
+        stage("default backend unavailable (%s); falling back to CPU"
+              % str(e).splitlines()[0])
+        try:
+            # re-pin the platform so subsequent dispatches resolve to
+            # the CPU client instead of re-raising per op
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        return jax.devices("cpu"), True
+
+
 def materialize(x):
     """Host-materialize a result leaf: the timing barrier.
 
@@ -94,8 +121,13 @@ class NorthStar:
         import jax.numpy as jnp
 
         self.jax, self.jnp = jax, jnp
+        self.backend_fallback = False
         if on_accel is None:
-            on_accel = jax.devices()[0].platform not in ("cpu",)
+            devices, self.backend_fallback = resolve_devices(jax)
+            self.platform = devices[0].platform
+            on_accel = self.platform not in ("cpu",)
+        else:
+            self.platform = jax.devices()[0].platform
         self.on_accel = on_accel
         self.nsub, self.nchan, self.nbin, self.scan = shapes(on_accel)
         self.dtype = jnp.float32 if on_accel else jnp.float64

@@ -119,6 +119,18 @@ def main(argv=None):
             print("--checkpoint supersedes -o: TOAs go to %s only."
                   % args.checkpoint, file=sys.stderr)
 
+    from .. import obs
+
+    # one observability run spans the whole invocation (fit AND the
+    # final .tim write), so a PPTPU_OBS_DIR manifest+events pair covers
+    # the complete CLI story; the pipeline's own @obs.scoped_run joins
+    # this run reentrantly instead of opening a second one
+    with obs.run("pptoas"):
+        return _run_pipeline(args)
+
+
+def _run_pipeline(args):
+    from .. import obs
     from ..io.timfile import write_TOAs
     from ..pipelines.toas import GetTOAs
 
@@ -212,21 +224,28 @@ def main(argv=None):
                                quiet=args.quiet)
 
     if args.format == "princeton":
-        gt.write_princeton_TOAs(outfile=args.outfile, one_DM=args.one_DM,
-                                dmerrfile=args.errfile)
+        with obs.span("write", outfile=args.outfile,
+                      format="princeton"):
+            gt.write_princeton_TOAs(outfile=args.outfile,
+                                    one_DM=args.one_DM,
+                                    dmerrfile=args.errfile)
     elif args.one_DM:
         for toa in gt.TOA_list:
             ifile = gt.order.index(toa.archive)
             toa.DM = gt.DeltaDM_means[ifile] + gt.DM0s[ifile]
             toa.DM_error = gt.DeltaDM_errs[ifile]
             toa.flags["DM_mean"] = True
-        write_TOAs(gt.TOA_list, inf_is_zero=True,
-                   SNR_cutoff=args.snr_cutoff, outfile=args.outfile,
-                   append=True)
+        with obs.span("write", outfile=args.outfile,
+                      n_toas=len(gt.TOA_list)):
+            write_TOAs(gt.TOA_list, inf_is_zero=True,
+                       SNR_cutoff=args.snr_cutoff, outfile=args.outfile,
+                       append=True)
     else:
-        write_TOAs(gt.TOA_list, inf_is_zero=True,
-                   SNR_cutoff=args.snr_cutoff, outfile=args.outfile,
-                   append=True)
+        with obs.span("write", outfile=args.outfile,
+                      n_toas=len(gt.TOA_list)):
+            write_TOAs(gt.TOA_list, inf_is_zero=True,
+                       SNR_cutoff=args.snr_cutoff, outfile=args.outfile,
+                       append=True)
     return 0
 
 

@@ -27,7 +27,11 @@ compiles via ``jax.monitoring`` while the context is open — the precise
 tool for regression tests of the form "the second same-shaped batch
 must not compile anything" (tests/test_retrace_budget.py).  It is
 always active (no env gate): a counter you opened explicitly should
-count.
+count.  The underlying listener is the shared fan-out bridge in
+``obs.monitor`` — one process-wide jax.monitoring registration serves
+both these counters and the structured observability layer
+(docs/OBSERVABILITY.md), so the two can never disagree about what
+compiled.
 
 ``check_finite(value, name)`` / ``check_fit_result(bunch)`` are the
 NaN hooks for fit residuals: host-side checks of concrete outputs
@@ -146,35 +150,6 @@ class TraceCount:
                 % (self.traces, self.compiles))
 
 
-_active_counters = []
-_listener_installed = False
-
-# jax.monitoring has no unregister API — one permanent listener fans out
-# to whatever counters are currently open (none: early return).
-_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
-_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
-
-
-def _install_listener():
-    global _listener_installed
-    if _listener_installed:
-        return
-    import jax.monitoring
-
-    def _on_duration(event, duration=0.0, **kwargs):
-        if not _active_counters:
-            return
-        if event == _TRACE_EVENT:
-            for c in _active_counters:
-                c.traces += 1
-        elif event == _COMPILE_EVENT:
-            for c in _active_counters:
-                c.compiles += 1
-
-    jax.monitoring.register_event_duration_secs_listener(_on_duration)
-    _listener_installed = True
-
-
 @contextlib.contextmanager
 def trace_counter():
     """Count jaxpr traces / backend compiles process-wide while open.
@@ -184,14 +159,26 @@ def trace_counter():
         with trace_counter() as c:
             run_batch(...)
         assert c.compiles == 0   # everything was cache-hit
+
+    Subscribes to the shared jax.monitoring bridge (obs.monitor) for
+    the duration of the context; an active observability run sees the
+    identical event stream.
     """
-    _install_listener()
+    from .obs import monitor
+
     c = TraceCount()
-    _active_counters.append(c)
+
+    def _on_event(event, duration):
+        if event == monitor.TRACE_EVENT:
+            c.traces += 1
+        elif event == monitor.COMPILE_EVENT:
+            c.compiles += 1
+
+    monitor.subscribe(_on_event)
     try:
         yield c
     finally:
-        _active_counters.remove(c)
+        monitor.unsubscribe(_on_event)
 
 
 # -- non-finite checks ------------------------------------------------------

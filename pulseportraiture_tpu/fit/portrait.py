@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..config import (Dconst, F0_fact, as_fft_operand,
                       backend_supports_complex128)
 from ..debug import check_fit_result, retrace_budget
@@ -1327,7 +1328,15 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
         out = jax.tree_util.tree_map(lambda a: a[:B], out)
     # opt-in NaN hook (PPTPU_SANITIZE): fail at the fit that produced a
     # non-finite solution, not pipelines later
-    return check_fit_result(out, where="fit_portrait_full_batch")
+    out = check_fit_result(out, where="fit_portrait_full_batch")
+    # opt-in fit telemetry (PPTPU_OBS_DIR + an open obs.run): per-subint
+    # nfeval / chi2 / return-code convergence stats, logged HOST-side
+    # after the jit boundary — the solver plumbed them out as auxiliary
+    # result fields precisely so no telemetry runs inside traced code
+    return obs.fit_telemetry(
+        out, where="fit_portrait_full_batch", fit_flags=list(flags_t),
+        batch_padded=int(data_ports.shape[0]),
+        scan_size=scan_size, cast=cast_t)
 
 
 def get_scales_full(params, data_port, model_port, P, freqs, nu_DM, nu_GM,

@@ -1,0 +1,31 @@
+"""Structured observability for the TOA pipelines (docs/OBSERVABILITY.md).
+
+Gated on ``PPTPU_OBS_DIR``: when unset (the default) every entry point
+is a cheap no-op; when set, pipelines write a per-run directory holding
+``events.jsonl`` (spans, compiles, fit telemetry) and ``manifest.json``
+(platform, shapes, config, git SHA).  ``tools/obs_report.py``
+summarizes a run into the tables PERF.md used to maintain by hand.
+
+Layout:
+
+* :mod:`.core`     — runs, spans, events, counters, fit telemetry
+* :mod:`.monitor`  — the single jax.monitoring fan-out bridge (shared
+  with the PPTPU_SANITIZE trace counters in ``debug.py``)
+* :mod:`.manifest` — run-manifest assembly (git SHA, device, env)
+* :mod:`.trace`    — opt-in jax.profiler capture (``PPTPU_TRACE_DIR``)
+
+Never call any of this inside ``jax.jit`` — telemetry is host-side by
+contract (jaxlint J002 enforces it statically; ``fit_telemetry``
+additionally passes tracers through untouched at runtime).
+"""
+
+from . import monitor  # noqa: F401
+from .core import (Recorder, configure, counter, current, enabled,
+                   event, fit_telemetry, gauge, obs_dir, phases, run,
+                   scoped_run, span)
+from .trace import trace_capture, trace_dir
+
+__all__ = ["Recorder", "configure", "counter", "current", "enabled",
+           "event", "fit_telemetry", "gauge", "obs_dir", "phases",
+           "run", "scoped_run", "span", "trace_capture", "trace_dir",
+           "monitor"]

@@ -1,0 +1,536 @@
+"""Structured observability core: runs, spans, events, fit telemetry.
+
+One :class:`Recorder` per run writes two files under
+``$PPTPU_OBS_DIR/<run-id>/``:
+
+* ``events.jsonl`` — an append-only stream of timestamped JSON events
+  (spans, one-off events, compile/trace notifications from the
+  jax.monitoring bridge, per-batch fit telemetry);
+* ``manifest.json`` — the run's static context (shapes, config,
+  platform, git SHA; see :mod:`.manifest`), rewritten at close with
+  the aggregated counters, wall time, and jit cache sizes merged in.
+
+Design rules (the contract the tests enforce):
+
+* **Disabled = free.**  With ``PPTPU_OBS_DIR`` unset (the default),
+  every entry point short-circuits on ``_active is None`` — no files,
+  no imports of jax, no measurable overhead on the tier-1 lane.
+* **Host-side only.**  Nothing here may run inside traced code:
+  :func:`fit_telemetry` returns immediately when it sees a tracer, and
+  jaxlint J002 statically rejects ``obs.*`` calls inside ``jax.jit``
+  (docs/LINTING.md).  The device→host transfer fit telemetry performs
+  on *concrete* results is the feature's documented cost, exactly like
+  the PPTPU_SANITIZE NaN hooks.
+* **Explicit device boundaries.**  A span that times device work must
+  mark its result with ``sp.block(value)`` so ``block_until_ready``
+  runs before the duration is taken — otherwise async dispatch
+  attributes the device time to whichever span happens to synchronize
+  later.
+* **Never fatal.**  Telemetry IO failures degrade to dropped events,
+  not pipeline crashes.
+"""
+
+import contextlib
+import functools
+import json
+import os
+import threading
+import time
+
+from . import monitor
+from .manifest import build_manifest
+
+__all__ = ["obs_dir", "enabled", "current", "run", "scoped_run",
+           "configure", "span", "phases", "event", "counter", "gauge",
+           "fit_telemetry", "Recorder"]
+
+_state_lock = threading.Lock()
+_active = None           # the process's active Recorder, or None
+_run_seq = 0             # uniquifies run dirs within one process
+
+_tls = threading.local()  # per-thread span path stack
+
+
+def obs_dir():
+    """$PPTPU_OBS_DIR, or None when observability is disabled."""
+    v = os.environ.get("PPTPU_OBS_DIR", "").strip()
+    return v or None
+
+
+def enabled():
+    """True when a run is active or PPTPU_OBS_DIR would enable one."""
+    return _active is not None or obs_dir() is not None
+
+
+def current():
+    """The active Recorder, or None."""
+    return _active
+
+
+def _span_stack():
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def _json_default(x):
+    # numpy scalars/arrays and other non-JSON leaves degrade to
+    # something readable instead of raising mid-pipeline
+    try:
+        import numpy as np
+
+        if isinstance(x, np.ndarray):
+            return x.tolist()
+        if isinstance(x, np.generic):
+            return x.item()
+    except Exception:
+        pass
+    return repr(x)
+
+
+class Recorder:
+    """JSONL event sink + manifest writer for one run."""
+
+    def __init__(self, name, base_dir, config=None):
+        global _run_seq
+        with _state_lock:
+            _run_seq += 1
+            seq = _run_seq
+        stamp = time.strftime("%Y%m%dT%H%M%S")
+        self.run_id = "%s-%s-p%d-%02d" % (name, stamp, os.getpid(), seq)
+        self.name = name
+        self.dir = os.path.join(base_dir, self.run_id)
+        os.makedirs(self.dir, exist_ok=True)
+        self.events_path = os.path.join(self.dir, "events.jsonl")
+        self.manifest_path = os.path.join(self.dir, "manifest.json")
+        self._lock = threading.Lock()
+        self._fh = open(self.events_path, "a", encoding="utf-8")
+        self._t0 = time.time()
+        self._perf0 = time.perf_counter()
+        self.counters = {}
+        self.gauges = {}
+        self.n_events = 0
+        self.compile_total_s = 0.0
+        self.manifest = build_manifest(name, self.run_id, config=config)
+        self._write_manifest()
+        self._mon_cb = monitor.subscribe(self._on_monitoring)
+        self._closed = False
+
+    # -- event stream ---------------------------------------------------
+
+    def emit(self, kind, **fields):
+        """Append one timestamped JSON event; never raises."""
+        rec = {"t": round(time.time(), 6), "kind": kind}
+        rec.update(fields)
+        try:
+            line = json.dumps(rec, default=_json_default)
+        except Exception:
+            return
+        with self._lock:
+            if self._closed:
+                return
+            try:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+                self.n_events += 1
+            except OSError:
+                pass
+
+    def bump(self, name, inc=1):
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + inc
+
+    def set_gauge(self, name, value):
+        with self._lock:
+            self.gauges[name] = value
+
+    def merge_config(self, config):
+        """Fold extra config into the manifest (reentrant runs)."""
+        self.manifest.setdefault("config", {}).update(config or {})
+        self._write_manifest()
+
+    # -- jax.monitoring bridge ------------------------------------------
+
+    def _on_monitoring(self, evt, duration):
+        if evt == monitor.TRACE_EVENT:
+            self.bump("jaxpr_traces")
+        elif evt == monitor.COMPILE_EVENT:
+            self.bump("backend_compiles")
+            with self._lock:
+                self.compile_total_s += duration
+            stack = _span_stack()
+            self.emit("compile", dur_s=round(duration, 6),
+                      span="/".join(s.name for s in stack) or None)
+
+    # -- manifest -------------------------------------------------------
+
+    def _write_manifest(self):
+        try:
+            tmp = self.manifest_path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(self.manifest, fh, indent=1,
+                          default=_json_default)
+                fh.write("\n")
+            os.replace(tmp, self.manifest_path)
+        except OSError:
+            pass
+
+    def _jit_cache_sizes(self):
+        """Cache sizes of the retrace-budgeted hot jit boundaries —
+        the gauges PPTPU_SANITIZE's budgets bound at runtime."""
+        sizes = {}
+        try:
+            from ..fit import portrait as fp
+
+            for attr in ("_solve", "_batch_impl"):
+                fn = getattr(fp, attr, None)
+                try:
+                    sizes["fit.portrait.%s" % attr] = int(fn._cache_size())
+                except Exception:
+                    pass
+        except Exception:
+            pass
+        return sizes
+
+    def close(self):
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        monitor.unsubscribe(self._mon_cb)
+        self.manifest.update(
+            t_end=time.time(),
+            wall_s=round(time.perf_counter() - self._perf0, 6),
+            counters=dict(self.counters),
+            gauges=dict(self.gauges),
+            n_events=self.n_events,
+            compile_total_s=round(self.compile_total_s, 6),
+            jit_cache_sizes=self._jit_cache_sizes(),
+        )
+        self._write_manifest()
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+
+
+@contextlib.contextmanager
+def run(name, config=None):
+    """Open a run (Recorder) for the dynamic extent of the context.
+
+    Reentrant: when a run is already active (a CLI opened one and a
+    pipeline opens another), the existing recorder is reused — its
+    manifest absorbs the inner ``config`` and the inner context's exit
+    does NOT close it.  A no-op yielding None when PPTPU_OBS_DIR is
+    unset.
+    """
+    global _active
+    with _state_lock:
+        existing = _active
+    if existing is not None:
+        if config:
+            existing.merge_config(config)
+        yield existing
+        return
+    base = obs_dir()
+    if base is None:
+        yield None
+        return
+    try:
+        rec = Recorder(name, base, config=config)
+    except OSError:
+        yield None  # an unwritable obs dir must not kill the pipeline
+        return
+    with _state_lock:
+        _active = rec
+    try:
+        yield rec
+    finally:
+        with _state_lock:
+            _active = None
+        rec.close()
+
+
+def scoped_run(name):
+    """Decorator form of :func:`run` for pipeline entry points.
+
+    ``@obs.scoped_run("pptoas")`` opens (or, reentrantly, joins) a run
+    for the duration of each call; call :func:`configure` inside the
+    body to fold runtime config into the manifest once it is known.
+    """
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with run(name):
+                return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def configure(**config):
+    """Merge fields into the active run's manifest config (no-op when
+    no run is active)."""
+    rec = _active
+    if rec is not None:
+        rec.merge_config(config)
+
+
+class _Span:
+    """Handle yielded by :func:`span`; ``block(x)`` marks the device
+    value whose completion bounds the span."""
+
+    __slots__ = ("name", "_block")
+
+    def __init__(self, name):
+        self.name = name
+        self._block = None
+
+    def block(self, value):
+        """Mark ``value`` for block_until_ready at span exit; returns
+        ``value`` unchanged so it nests in expressions."""
+        self._block = value
+        return value
+
+
+class _NullSpan:
+    __slots__ = ()
+    name = None
+
+    def block(self, value):
+        return value
+
+
+_NULL_SPAN = _NullSpan()
+
+
+@contextlib.contextmanager
+def span(name, **attrs):
+    """Record a nested wall-clock span event.
+
+    Usage::
+
+        with obs.span("solve", archive=path, batch=B) as sp:
+            out = fit_portrait_full_batch(...)
+            sp.block(out.params)     # device boundary: block before t1
+
+    Emits ``{"kind": "span", "name": ..., "path": "a/b/solve",
+    "dur_s": ..., ...attrs}``.  When no run is active this is a no-op
+    yielding a shared null handle.  Must never be called inside traced
+    code (jaxlint J002): under jit the body would be timed at trace
+    time once and never again.
+    """
+    rec = _active
+    if rec is None:
+        yield _NULL_SPAN
+        return
+    sp = _Span(name)
+    stack = _span_stack()
+    stack.append(sp)
+    t0 = time.perf_counter()
+    err = None
+    try:
+        yield sp
+    except BaseException as e:
+        err = type(e).__name__
+        raise
+    finally:
+        if sp._block is not None:
+            try:
+                import jax
+
+                jax.block_until_ready(sp._block)
+            except Exception:
+                pass
+        dur = time.perf_counter() - t0
+        if stack and stack[-1] is sp:
+            stack.pop()
+        path = "/".join(s.name for s in stack + [sp])
+        fields = dict(attrs)
+        if err is not None:
+            fields["error"] = err
+        rec.emit("span", name=name, path=path, dur_s=round(dur, 6),
+                 **fields)
+
+
+class phases:
+    """Sequential phase spans for long pipeline bodies.
+
+    A with-block per phase would force re-indenting hundred-line
+    pipeline sections; this timer instead closes the previous phase
+    whenever the next one is entered::
+
+        ph = obs.phases(archive=path)
+        ph.enter("load");  data = load(...)
+        ph.enter("solve"); out = fit(...); ph.block(out.params)
+        ph.enter("write"); write(...)
+        ph.done()
+
+    Each phase is emitted as a normal span event (same schema and path
+    rules) and participates in the thread's span stack, so compile
+    events are attributed to the phase they occurred in.  ``done()``
+    must run on every exit path of the instrumented region — a missed
+    one drops that phase's event and cleans the stack lazily, it never
+    corrupts later spans.  All methods are no-ops when no run is
+    active at ``enter`` time.
+    """
+
+    def __init__(self, **attrs):
+        self._attrs = attrs
+        self._sp = None
+        self._t0 = 0.0
+        self._extra = {}
+        self._block = None
+
+    def enter(self, name, **attrs):
+        """Close the current phase (if any) and open ``name``."""
+        self._finish()
+        if _active is None:
+            return
+        self._sp = _Span(name)
+        self._extra = dict(attrs)
+        _span_stack().append(self._sp)
+        self._t0 = time.perf_counter()
+
+    def block(self, value):
+        """Device value bounding the CURRENT phase: block_until_ready
+        runs before its duration is taken.  Returns ``value``."""
+        self._block = value
+        return value
+
+    def done(self, **attrs):
+        """Close the current phase, folding ``attrs`` into its event."""
+        self._extra.update(attrs)
+        self._finish()
+
+    def _finish(self):
+        sp, self._sp = self._sp, None
+        if sp is None:
+            self._block = None
+            return
+        if self._block is not None:
+            try:
+                import jax
+
+                jax.block_until_ready(self._block)
+            except Exception:
+                pass
+            self._block = None
+        dur = time.perf_counter() - self._t0
+        stack = _span_stack()
+        if sp in stack:
+            path = "/".join(s.name for s in stack[:stack.index(sp) + 1])
+            stack.remove(sp)
+        else:
+            path = sp.name
+        rec = _active
+        if rec is not None:
+            fields = dict(self._attrs)
+            fields.update(self._extra)
+            rec.emit("span", name=sp.name, path=path,
+                     dur_s=round(dur, 6), **fields)
+        self._extra = {}
+
+
+def event(name, **fields):
+    """One-off JSON event (no duration); no-op when no run is active."""
+    rec = _active
+    if rec is not None:
+        rec.emit("event", name=name, **fields)
+
+
+def counter(name, inc=1):
+    """Bump an aggregate counter (written into the manifest at close)."""
+    rec = _active
+    if rec is not None:
+        rec.bump(name, inc)
+
+
+def gauge(name, value):
+    """Set a gauge (last value wins; manifest at close + JSONL event)."""
+    rec = _active
+    if rec is not None:
+        rec.set_gauge(name, value)
+        rec.emit("gauge", name=name, value=value)
+
+
+# fields of a batched fit result that carry per-subint fit quality
+_FIT_FIELDS = ("nfeval", "chi2", "red_chi2", "return_code")
+
+# solver return codes that mean "converged" (config.RCSTRINGS): 0/1/2;
+# 3 = iteration budget exhausted, 4 = damping blew past mu_max (stuck)
+_CONVERGED_RCS = (0, 1, 2)
+
+
+def fit_telemetry(result, where="fit", **attrs):
+    """Log per-batch fit-quality telemetry from a *concrete* result.
+
+    ``result`` is a fit DataBunch/dict carrying per-subint ``nfeval``,
+    ``chi2``/``red_chi2`` and ``return_code`` (the auxiliary outputs
+    the batched solvers in fit/portrait.py return).  Emits one ``fit``
+    event with summary statistics, the return-code histogram, and the
+    per-subint vectors.  Returns ``result`` unchanged.
+
+    Host-side only: traced inputs pass through untouched (so a caller
+    accidentally inside jit cannot sync or crash — though jaxlint J002
+    flags that caller), and nothing happens when no run is active.
+    The device→host transfer of the small per-subint vectors is the
+    documented cost when enabled.
+    """
+    rec = _active
+    if rec is None:
+        return result
+    try:
+        fields = {k: result[k] for k in _FIT_FIELDS
+                  if isinstance(result, dict) and k in result}
+    except Exception:
+        return result
+    if not fields:
+        return result
+    import jax
+
+    if any(isinstance(v, jax.core.Tracer) for v in fields.values()):
+        return result  # inside traced code: never sync (J002 contract)
+    import numpy as np
+
+    try:
+        host = jax.device_get(fields)
+    except Exception:
+        return result
+    ev = {"where": where}
+    ev.update(attrs)
+    nfev = np.atleast_1d(np.asarray(host.get("nfeval", [])))
+    rc = np.atleast_1d(np.asarray(host.get("return_code", [])))
+    chi2 = np.atleast_1d(np.asarray(
+        host.get("red_chi2", host.get("chi2", []))), )
+    ev["batch"] = int(nfev.size) if nfev.size else int(rc.size)
+    if nfev.size:
+        ev["nfeval"] = {"min": int(nfev.min()),
+                        "median": float(np.median(nfev)),
+                        "max": int(nfev.max())}
+        ev["nfeval_per_subint"] = nfev.astype(int).tolist()
+    if chi2.size:
+        finite = np.isfinite(chi2)
+        ev["chi2"] = {"median": float(np.median(chi2[finite]))
+                      if finite.any() else None,
+                      "max": float(chi2[finite].max())
+                      if finite.any() else None,
+                      "n_nonfinite": int((~finite).sum())}
+        ev["red_chi2_per_subint"] = [round(float(x), 6) for x in chi2]
+    if rc.size:
+        hist = {}
+        for code in rc.astype(int):
+            hist[str(code)] = hist.get(str(code), 0) + 1
+        ev["rc_hist"] = hist
+        converged = np.isin(rc.astype(int), _CONVERGED_RCS)
+        bad = ~converged
+        if chi2.size == rc.size:
+            bad = bad | ~np.isfinite(chi2)
+        ev["n_bad"] = int(bad.sum())
+        ev["bad_isubs"] = np.flatnonzero(bad).tolist()
+    rec.emit("fit", **ev)
+    rec.bump("fit_batches")
+    rec.bump("fit_subints", ev.get("batch", 0))
+    if "n_bad" in ev:
+        rec.bump("fit_bad_subints", ev["n_bad"])
+    return result

@@ -1,0 +1,82 @@
+"""Run-manifest assembly: the who/what/where record of one run.
+
+A manifest is the JSON sidecar of a run's event stream: enough static
+context (device platform, shapes/dtypes/config the caller passes, git
+SHA, argv, relevant PPTPU_* environment) that a committed
+``manifest.json`` + ``events.jsonl`` pair is self-describing evidence
+— the reader never has to reconstruct "what was this run?" from shell
+history, which is exactly how the hand-maintained PERF.md tables used
+to decay.
+
+Everything here is best-effort and exception-free: telemetry must
+never be the thing that kills a pipeline, so unavailable fields are
+recorded as a short error string instead of raised.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+__all__ = ["build_manifest", "git_sha"]
+
+_ENV_KEYS_PREFIX = "PPTPU_"
+_ENV_KEYS_EXTRA = ("JAX_PLATFORMS", "XLA_FLAGS")
+
+
+def git_sha():
+    """HEAD commit of the repo this package lives in, or None."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(
+            ["git", "-C", root, "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=10)
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return None
+
+
+def _device_info():
+    """Platform/device facts without *forcing* a backend to initialize
+    successfully: a dead accelerator tunnel is itself a fact worth
+    recording (cf. bench_common.resolve_devices)."""
+    info = {}
+    try:
+        import jax
+
+        info["jax_version"] = jax.__version__
+        try:
+            devs = jax.devices()
+            info["platform"] = devs[0].platform
+            info["device_count"] = len(devs)
+            info["device_kind"] = getattr(devs[0], "device_kind", None)
+        except RuntimeError as e:  # backend init failure
+            info["platform"] = "unavailable"
+            info["backend_error"] = str(e).splitlines()[0][:500]
+    except Exception as e:  # jax itself unimportable: still record why
+        info["jax_error"] = str(e)[:500]
+    return info
+
+
+def build_manifest(name, run_id, config=None):
+    """The open-time manifest dict for a run (the recorder rewrites it
+    at close with counters/durations merged in)."""
+    env = {k: v for k, v in os.environ.items()
+           if k.startswith(_ENV_KEYS_PREFIX) or k in _ENV_KEYS_EXTRA}
+    m = {
+        "schema": "pptpu-obs-v1",
+        "name": name,
+        "run_id": run_id,
+        "t_start": time.time(),
+        "argv": list(sys.argv),
+        "cwd": os.getcwd(),
+        "pid": os.getpid(),
+        "git_sha": git_sha(),
+        "env": env,
+        "config": dict(config or {}),
+    }
+    m.update(_device_info())
+    return m

@@ -1,0 +1,71 @@
+"""Single jax.monitoring bridge shared by every telemetry consumer.
+
+jax.monitoring has no unregister API, so naive per-consumer
+registration leaks one permanent listener per consumer (the hazard the
+ad-hoc listener in the pre-obs ``debug.py`` worked around privately).
+This module owns ONE permanent listener and fans events out to
+whatever subscribers are currently registered: ``debug.trace_counter``
+subscribes a counter for the duration of its context, an active
+:class:`pulseportraiture_tpu.obs.core.Recorder` subscribes for the
+duration of a run, and both see the same stream.
+
+Subscribers are callables ``cb(event, duration)`` where ``event`` is
+the jax.monitoring event key and ``duration`` its reported seconds
+(0.0 for events without one).  Subscription is thread-safe; callbacks
+run on whatever thread jax emits from and must be cheap and
+exception-free (a raising subscriber is dropped rather than allowed to
+poison the shared listener).
+"""
+
+import threading
+
+__all__ = ["TRACE_EVENT", "COMPILE_EVENT", "subscribe", "unsubscribe"]
+
+# the two duration events the repo's telemetry is built on: one fires
+# per jaxpr trace, one per backend (XLA) compile
+TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_subscribers = []
+_listener_installed = False
+
+
+def _install_listener():
+    global _listener_installed
+    if _listener_installed:
+        return
+    import jax.monitoring
+
+    def _on_duration(event, duration=0.0, **kwargs):
+        if not _subscribers:
+            return
+        with _lock:
+            subs = list(_subscribers)
+        for cb in subs:
+            try:
+                cb(event, float(duration))
+            except Exception:
+                # a broken subscriber must not take down the process's
+                # only listener; drop it
+                with _lock:
+                    if cb in _subscribers:
+                        _subscribers.remove(cb)
+
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    _listener_installed = True
+
+
+def subscribe(cb):
+    """Register ``cb(event, duration)`` on the shared listener."""
+    _install_listener()
+    with _lock:
+        _subscribers.append(cb)
+    return cb
+
+
+def unsubscribe(cb):
+    """Remove a subscriber registered with :func:`subscribe`."""
+    with _lock:
+        if cb in _subscribers:
+            _subscribers.remove(cb)

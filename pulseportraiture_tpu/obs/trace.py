@@ -1,0 +1,57 @@
+"""Opt-in jax.profiler trace capture (PPTPU_TRACE_DIR).
+
+``trace_capture(name)`` wraps a region in a device profiler trace when
+the ``PPTPU_TRACE_DIR`` environment variable names a directory, and is
+a no-op otherwise.  Profiling through a remote-device tunnel is not
+always supported (tools/perf_probe.py records the same caveat), so a
+failing profiler start degrades to "no trace, one event recorded"
+rather than an exception: telemetry must never kill the run it is
+observing.
+"""
+
+import contextlib
+import os
+
+from . import core
+
+__all__ = ["trace_dir", "trace_capture"]
+
+
+def trace_dir():
+    """$PPTPU_TRACE_DIR, or None when profiler capture is disabled."""
+    v = os.environ.get("PPTPU_TRACE_DIR", "").strip()
+    return v or None
+
+
+@contextlib.contextmanager
+def trace_capture(name):
+    """Capture a jax.profiler trace of the region into
+    ``$PPTPU_TRACE_DIR/<name>``; yields the trace path or None.
+
+    Composes with :func:`pulseportraiture_tpu.obs.core.span`: the span
+    carries the wall clock, the profiler trace carries the device
+    timeline, and the emitted ``trace`` event links the two.
+    """
+    base = trace_dir()
+    if base is None:
+        yield None
+        return
+    path = os.path.join(base, name)
+    import jax
+
+    started = False
+    try:
+        jax.profiler.start_trace(path)
+        started = True
+    except Exception as e:
+        core.event("trace_error", region=name, error=str(e)[:500])
+    try:
+        yield path if started else None
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+                core.event("trace", region=name, path=path)
+            except Exception as e:
+                core.event("trace_error", region=name,
+                           error=str(e)[:500])

@@ -16,6 +16,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..config import as_fft_operand
 from ..fit.phase_shift import fit_phase_shift
 from ..fit.portrait import fit_portrait_full_batch
@@ -275,6 +276,7 @@ def _align_fit_accumulate(full, model_b, freqs_b, errs_b, SNRs_b, Ps_b,
                       np.broadcast_to(wcol, (len(tchan), nbin)))
 
 
+@obs.scoped_run("ppalign")
 def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
                    pscrunch=True, SNR_cutoff=0.0, outfile=None, norm=None,
                    rot_phase=0.0, place=None, niter=1, quiet=True,
@@ -307,6 +309,9 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
                            return_arch=True, quiet=True)
     nchan, nbin = model_data.nchan, model_data.nbin
     model_port = (model_data.masks * model_data.subints)[0, 0]
+    obs.configure(pipeline="align_archives", n_datafiles=len(datafiles),
+                  nchan=int(nchan), nbin=int(nbin), niter=int(niter),
+                  fit_dm=bool(fit_dm), outfile=outfile)
 
     skip_these = set()
     aligned_port = np.zeros((npol, nchan, nbin))
@@ -338,19 +343,25 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
                 block, cmaps = _assemble_block(
                     take, model_port, dnchan, nchan, nbin, npol,
                     chunk_max)
-                _align_fit_accumulate(
-                    *block, chan_maps=cmaps, fit_dm=fit_dm,
-                    max_iter=max_iter, nbin=nbin, npol=npol,
-                    aligned_port=aligned_port,
-                    total_weights=total_weights)
+                # the accumulate ends in host numpy ops, so the span's
+                # device boundary is inherent — no explicit block needed
+                with obs.span("solve", iteration=count, nchan=dnchan,
+                              rows=len(take)):
+                    _align_fit_accumulate(
+                        *block, chan_maps=cmaps, fit_dm=fit_dm,
+                        max_iter=max_iter, nbin=nbin, npol=npol,
+                        aligned_port=aligned_port,
+                        total_weights=total_weights)
             pending[dnchan] = rows
 
         for datafile in use_files:
             try:
-                d = load_data(datafile, state=state, dedisperse=False,
-                              tscrunch=tscrunch, pscrunch=pscrunch,
-                              rm_baseline=True, refresh_arch=False,
-                              return_arch=False, quiet=True)
+                with obs.span("load", archive=datafile):
+                    d = load_data(datafile, state=state,
+                                  dedisperse=False, tscrunch=tscrunch,
+                                  pscrunch=pscrunch, rm_baseline=True,
+                                  refresh_arch=False, return_arch=False,
+                                  quiet=True)
             except NotImplementedError as e:
                 print(f"Skipping {datafile}: cannot convert to {state} "
                       f"({e})", file=sys.stderr)
@@ -419,5 +430,6 @@ def align_archives(metafile, initial_guess, fit_dm=True, tscrunch=False,
     arch.data = np.asarray(aligned_port)[None]
     arch.weights = np.where(total_weights.sum(axis=-1) > 0.0, 1.0,
                             0.0)[None, :]
-    arch.unload(outfile, quiet=quiet)
+    with obs.span("write", outfile=outfile):
+        arch.unload(outfile, quiet=quiet)
     return outfile, aligned_port, total_weights

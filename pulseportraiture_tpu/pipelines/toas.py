@@ -17,6 +17,7 @@ import time
 import jax
 import numpy as np
 
+from .. import obs
 from ..config import host_array, host_stats_device, scattering_alpha
 from ..fit.phase_shift import fit_phase_shift
 from ..fit.portrait import (auto_scan_size, bucket_batch_size,
@@ -286,6 +287,7 @@ class GetTOAs:
         return models_b, same_freqs
 
     # -- the main driver -----------------------------------------------
+    @obs.scoped_run("pptoas")
     def get_TOAs(self, datafile=None, tscrunch=False, nu_refs=None,
                  DM0=None, bary=True, fit_DM=True, fit_GM=False,
                  fit_scat=False, log10_tau=True, scat_guess=None,
@@ -332,6 +334,13 @@ class GetTOAs:
         start = time.time()
 
         datafiles = self.datafiles if datafile is None else [datafile]
+        obs.configure(pipeline="get_TOAs", modelfile=self.modelfile,
+                      model_type=self.model_type,
+                      n_datafiles=len(datafiles),
+                      fit_flags=list(self.fit_flags),
+                      log10_tau=log10_tau, max_iter=max_iter,
+                      bary=bary, tscrunch=tscrunch,
+                      checkpoint=checkpoint)
         done_archives = set()
         if checkpoint is not None and os.path.isfile(checkpoint):
             done_archives = _resume_checkpoint(checkpoint, quiet)
@@ -341,8 +350,14 @@ class GetTOAs:
                     print(f"{datafile} already in checkpoint "
                           f"{checkpoint}; skipping it.")
                 continue
+            # per-archive phase spans (docs/OBSERVABILITY.md): load /
+            # guess / solve / polish / write — no-ops unless a run is
+            # open (PPTPU_OBS_DIR + obs.run, see @obs.scoped_run above)
+            ph = obs.phases(archive=datafile)
+            ph.enter("load")
             data = self._load_archive(datafile, tscrunch, quiet)
             if data is None:
+                ph.done(skipped="load_failed")
                 continue
             d = data
             nsub, nchan, nbin = d.nsub, d.nchan, d.nbin
@@ -372,9 +387,14 @@ class GetTOAs:
                     d, ports, freqs_b, Ps_b, fit_scat,
                     add_instrumental_response, datafile)
                 if models_b is None:
+                    ph.done(skipped="model_mismatch")
                     continue
                 self.ok_idatafiles.append(iarch)
+                obs.event("archive", datafile=datafile, nsub=int(nsub),
+                          nchan=int(nchan), nbin=int(nbin), B=int(B),
+                          dtype=str(ports.dtype))
 
+                ph.enter("guess")
                 # reference frequencies for fit and output
                 nu_means = (freqs_b * wok).sum(-1) / wok.sum(-1)
                 if nu_fit_tuple is None:
@@ -488,50 +508,61 @@ class GetTOAs:
                     flags_used[i] = fl
                     flags_groups.setdefault(fl, []).append(i)
 
+                ph.enter("solve", batch=int(B))
                 results = [None] * B
-                for fl, idxs in flags_groups.items():
-                    sel = np.asarray(idxs)
-                    # long observations (hundreds of subints) run as a
-                    # chunked scan: the compile footprint stays that of a
-                    # 100-subint program (bigger monolithic batches can
-                    # exhaust the compiler) while the whole archive stays
-                    # one device dispatch.  Small batches are padded to a
-                    # power-of-two bucket instead so archives with
-                    # different subint counts share compiled programs — a
-                    # mixed-survey metafile otherwise pays one multi-minute
-                    # remote compile per distinct nsub
-                    scan = auto_scan_size(len(sel))
-                    out = fit_portrait_full_batch(
-                        ports[sel], models_b[sel], init[sel], Ps_b[sel],
-                        freqs_b[sel], errs=errs_b[sel],
-                        weights=weights_b[sel], fit_flags=fl,
-                        nu_fits=nu_fits_b[sel],
-                        nu_outs=None if nu_outs_b is None else tuple(
-                            None if col is None else col[sel]
-                            for col in nu_outs_b),
-                        bounds=bounds_eff, log10_tau=log10_tau,
-                        max_iter=max_iter, scan_size=scan,
-                        pad_to=None if scan is not None
-                        else bucket_batch_size(len(sel)),
-                        polish_iter=polish_iter, coarse_iter=coarse_iter,
-                        coarse_kmax=coarse_kmax)
-                    # ONE host transfer for the whole result tree —
-                    # per-key np.asarray would issue ~24 sequential
-                    # device->host round trips per archive (each
-                    # ~150-400 ms through a remote-dispatch tunnel)
-                    out = jax.device_get(dict(out))
-                    for j, i in enumerate(idxs):
-                        results[i] = {key: np.asarray(val)[j]
-                                      for key, val in out.items()}
+                # opt-in device profile of the fit dispatches
+                # (PPTPU_TRACE_DIR; a no-op context otherwise)
+                with obs.trace_capture("pptoas_arch%03d" % iarch):
+                    for fl, idxs in flags_groups.items():
+                        sel = np.asarray(idxs)
+                        # long observations (hundreds of subints) run as
+                        # a chunked scan: the compile footprint stays
+                        # that of a 100-subint program (bigger monolithic
+                        # batches can exhaust the compiler) while the
+                        # whole archive stays one device dispatch.  Small
+                        # batches are padded to a power-of-two bucket
+                        # instead so archives with different subint
+                        # counts share compiled programs — a mixed-survey
+                        # metafile otherwise pays one multi-minute remote
+                        # compile per distinct nsub
+                        scan = auto_scan_size(len(sel))
+                        out = fit_portrait_full_batch(
+                            ports[sel], models_b[sel], init[sel],
+                            Ps_b[sel], freqs_b[sel], errs=errs_b[sel],
+                            weights=weights_b[sel], fit_flags=fl,
+                            nu_fits=nu_fits_b[sel],
+                            nu_outs=None if nu_outs_b is None else tuple(
+                                None if col is None else col[sel]
+                                for col in nu_outs_b),
+                            bounds=bounds_eff, log10_tau=log10_tau,
+                            max_iter=max_iter, scan_size=scan,
+                            pad_to=None if scan is not None
+                            else bucket_batch_size(len(sel)),
+                            polish_iter=polish_iter,
+                            coarse_iter=coarse_iter,
+                            coarse_kmax=coarse_kmax)
+                        # ONE host transfer for the whole result tree —
+                        # per-key np.asarray would issue ~24 sequential
+                        # device->host round trips per archive (each
+                        # ~150-400 ms through a remote-dispatch tunnel);
+                        # the host read is also the solve phase's device
+                        # boundary, so its span needs no extra block
+                        out = jax.device_get(dict(out))
+                        for j, i in enumerate(idxs):
+                            results[i] = {key: np.asarray(val)[j]
+                                          for key, val in out.items()}
                 fit_duration = time.time() - fit_start
             except jax.errors.JaxRuntimeError as e:
                 del self.ok_idatafiles[n_okid:]
                 self.failed_datafiles.append((datafile, str(e)))
+                obs.counter("device_errors")
+                ph.done(error="JaxRuntimeError")
                 print(f"Device error fitting {datafile}: {e}; "
                       "skipping it.", file=sys.stderr)
                 continue
 
             # -- assemble per-archive outputs ---------------------------
+            ph.enter("polish")
             nu_refs_arr = np.zeros([nsub, 3])
             nu_fits_arr = np.zeros([nsub, 3])
             phis = np.zeros(nsub)
@@ -747,6 +778,7 @@ class GetTOAs:
             self.rcs.append(rcs)
             self.fit_durations.append(fit_duration)
             if checkpoint is not None:
+                ph.enter("write", checkpoint=checkpoint)
                 # block + its pp_done marker go down in ONE append, so a
                 # crash leaves either a complete marked block or an
                 # unmarked partial one that _resume_checkpoint drops
@@ -757,6 +789,8 @@ class GetTOAs:
                 blk.append("C pp_done %s %d" % (datafile, len(blk)))
                 with open(checkpoint, "a") as cf:
                     cf.write("".join(line + "\n" for line in blk))
+            ph.done(fit_duration_s=round(fit_duration, 6),
+                    n_toas=len(ok))
             if not quiet:
                 print("--------------------------")
                 print(datafile)
@@ -771,6 +805,7 @@ class GetTOAs:
                   % (tot, tot / max(ntoa, 1)))
 
     # -- narrowband (per-channel) TOAs ----------------------------------
+    @obs.scoped_run("pptoas")
     def get_narrowband_TOAs(self, datafile=None, tscrunch=False,
                             fit_scat=False, log10_tau=True,
                             scat_guess=None, print_phase=False,
@@ -814,9 +849,16 @@ class GetTOAs:
         start = time.time()
 
         datafiles = self.datafiles if datafile is None else [datafile]
+        obs.configure(pipeline="get_narrowband_TOAs",
+                      modelfile=self.modelfile,
+                      n_datafiles=len(datafiles), fit_scat=fit_scat,
+                      log10_tau=log10_tau, max_iter=max_iter)
         for iarch, datafile in enumerate(datafiles):
+            ph = obs.phases(archive=datafile)
+            ph.enter("load")
             data = self._load_archive(datafile, tscrunch, quiet)
             if data is None:
+                ph.done(skipped="load_failed")
                 continue
             d = data
             nsub, nchan, nbin = d.nsub, d.nchan, d.nbin
@@ -841,8 +883,12 @@ class GetTOAs:
                     d, ports, freqs_b, Ps_b, fit_scat,
                     add_instrumental_response, datafile)
                 if models_b is None:
+                    ph.done(skipped="model_mismatch")
                     continue
                 self.ok_idatafiles.append(iarch)
+                obs.event("archive", datafile=datafile, nsub=int(nsub),
+                          nchan=int(nchan), nbin=int(nbin), B=int(B),
+                          dtype=str(ports.dtype), narrowband=True)
 
                 # flatten live (subint, channel) pairs into one fit batch
                 jj, cc = np.nonzero(wok)                      # [M], [M]
@@ -864,6 +910,7 @@ class GetTOAs:
                 if bounds is not None and bounds[0] is not None \
                         and None not in bounds[0]:
                     phi_bounds = tuple(bounds[0])
+                ph.enter("solve", batch=int(M))
                 if not fit_scat:
                     r = jax.device_get(dict(fit_phase_shift(
                         profs, mods, noise=errsx, bounds=phi_bounds,
@@ -945,11 +992,14 @@ class GetTOAs:
             except jax.errors.JaxRuntimeError as e:
                 del self.ok_idatafiles[n_okid:]
                 self.failed_datafiles.append((datafile, str(e)))
+                obs.counter("device_errors")
+                ph.done(error="JaxRuntimeError")
                 print(f"Device error fitting {datafile}: {e}; "
                       "skipping it.", file=sys.stderr)
                 continue
 
             # -- assemble per-archive [nsub, nchan] outputs -------------
+            ph.enter("polish")
             phis = np.zeros([nsub, nchan])
             phi_errs = np.zeros([nsub, nchan])
             TOAs_arr = np.zeros([nsub, nchan], dtype=object)
@@ -1068,6 +1118,7 @@ class GetTOAs:
             self.nfevals.append(nfevals)
             self.rcs.append(rcs_a)
             self.fit_durations.append(fit_duration)
+            ph.done(fit_duration_s=round(fit_duration, 6), n_toas=M)
             if not quiet:
                 print("--------------------------")
                 print(datafile)
@@ -1154,8 +1205,10 @@ class GetTOAs:
     def write_TOAs(self, outfile=None, nu_ref=None, format="tempo2",
                    SNR_cutoff=0.0, append=True):
         """Write the accumulated TOA_list to a .tim file."""
-        write_TOAs(self.TOA_list, SNR_cutoff=SNR_cutoff, outfile=outfile,
-                   append=append)
+        with obs.span("write", outfile=outfile,
+                      n_toas=len(self.TOA_list)):
+            write_TOAs(self.TOA_list, SNR_cutoff=SNR_cutoff,
+                       outfile=outfile, append=append)
 
     def write_princeton_TOAs(self, outfile=None, one_DM=False,
                              dmerrfile=None):

@@ -1,0 +1,65 @@
+"""J002 fixtures: obs-API misuse inside jit (telemetry is host-side).
+
+The observability layer (pulseportraiture_tpu.obs) is host-side by
+contract: under jit a span would time tracing, and fit telemetry would
+sync a traced value (its runtime tracer guard makes it a silent no-op
+instead — equally useless).  docs/OBSERVABILITY.md.
+"""
+
+import jax
+
+from pulseportraiture_tpu import obs
+from pulseportraiture_tpu.obs import fit_telemetry
+
+
+@jax.jit
+def bad_span_in_jit(x):
+    with obs.span("solve"):  # EXPECT: J002
+        return x * 2.0
+
+
+@jax.jit
+def bad_fit_telemetry_dotted(x):
+    return obs.fit_telemetry({"chi2": x.sum()})  # EXPECT: J002
+
+
+@jax.jit
+def bad_event_in_jit(x):
+    obs.event("step", value=1)  # EXPECT: J002
+    return x + 1.0
+
+
+@jax.jit
+def bad_bare_fit_telemetry(x):
+    # the ``from ..obs import fit_telemetry`` idiom
+    return fit_telemetry(x, where="inner")  # EXPECT: J002
+
+
+@jax.jit
+def bad_counter_in_jit(x):
+    obs.counter("iterations")  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def ok_suppressed(x):
+    obs.event("known")  # jaxlint: disable=J002
+    return x
+
+
+def ok_host_side(x):
+    # outside jit: exactly how the pipelines use the API
+    with obs.span("solve", batch=3) as sp:
+        y = some_jitted_fn(x)
+        sp.block(y)
+    return obs.fit_telemetry(y, where="host")
+
+
+def some_jitted_fn(x):
+    return x
+
+
+@jax.jit
+def ok_unrelated_attr(x, observations):
+    # an array merely NAMED obs-ish must not trip the rule
+    return observations.sum() + x

@@ -1,0 +1,76 @@
+"""bench_common backend-fallback tests.
+
+BENCH_r05.json: a dead TPU tunnel made ``jax.devices()`` raise inside
+``NorthStar.__init__`` and the whole bench round exited rc=1 before
+measuring anything.  ``resolve_devices`` must degrade to the CPU
+backend and *report* the fallback instead.
+"""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+import bench_common  # noqa: E402
+
+
+class _FakeDevice:
+    platform = "cpu"
+
+
+class _FakeConfig:
+    def __init__(self):
+        self.updates = []
+
+    def update(self, key, value):
+        self.updates.append((key, value))
+
+
+class _FakeJaxDead:
+    """Default backend raises like the axon tunnel outage."""
+
+    def __init__(self):
+        self.config = _FakeConfig()
+
+    def devices(self, backend=None):
+        if backend == "cpu":
+            return [_FakeDevice()]
+        raise RuntimeError(
+            "Unable to initialize backend 'axon': UNAVAILABLE: TPU "
+            "backend setup/compile error (Unavailable).")
+
+
+class _FakeJaxAlive:
+    class _Dev:
+        platform = "tpu"
+
+    def devices(self, backend=None):
+        return [self._Dev()]
+
+
+def test_resolve_devices_falls_back_to_cpu():
+    fake = _FakeJaxDead()
+    devices, fallback = bench_common.resolve_devices(fake)
+    assert fallback is True
+    assert devices[0].platform == "cpu"
+    # the platform was re-pinned so later dispatches resolve to CPU
+    assert ("jax_platforms", "cpu") in fake.config.updates
+
+
+def test_resolve_devices_healthy_backend_untouched():
+    devices, fallback = bench_common.resolve_devices(_FakeJaxAlive())
+    assert fallback is False
+    assert devices[0].platform == "tpu"
+
+
+def test_northstar_on_real_cpu_backend():
+    """On the test environment's healthy CPU backend NorthStar resolves
+    without fallback and records its platform."""
+    import jax
+
+    ns = bench_common.NorthStar(jax)
+    assert ns.platform == "cpu"
+    assert ns.backend_fallback is False
+    assert ns.on_accel is False

@@ -1,0 +1,360 @@
+"""Observability-layer tests (pulseportraiture_tpu.obs).
+
+Covers the contracts docs/OBSERVABILITY.md declares: disabled = no-op,
+span nesting + event schema, JSONL round-trip, manifest open/close,
+reentrant runs, the jax.monitoring bridge (shared with
+debug.trace_counter), per-batch fit telemetry, and — the load-bearing
+one — jit purity: no obs call may sync or side-effect inside traced
+code (the static half of that guarantee is jaxlint J002's obs rule,
+tests/test_jaxlint.py::j002_obs.py).
+"""
+
+import json
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pulseportraiture_tpu import debug, obs
+from pulseportraiture_tpu.fit import portrait as fp
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+
+def _events(run_dir):
+    with open(os.path.join(run_dir, "events.jsonl"),
+              encoding="utf-8") as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+def _manifest(run_dir):
+    with open(os.path.join(run_dir, "manifest.json"),
+              encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+# -- disabled path -----------------------------------------------------
+
+def test_disabled_is_total_noop(tmp_path, monkeypatch):
+    monkeypatch.delenv("PPTPU_OBS_DIR", raising=False)
+    assert not obs.enabled()
+    with obs.run("nothing") as rec:
+        assert rec is None
+        with obs.span("s", k=1) as sp:
+            assert sp.block("value") == "value"
+        obs.event("e")
+        obs.counter("c")
+        obs.gauge("g", 1.0)
+        obs.configure(x=1)
+        ph = obs.phases()
+        ph.enter("load")
+        ph.done()
+        out = {"nfeval": np.ones(3)}
+        assert obs.fit_telemetry(out) is out
+    assert list(tmp_path.iterdir()) == []  # nothing written anywhere
+
+
+# -- spans + events ----------------------------------------------------
+
+def test_span_nesting_and_schema(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    with obs.run("spans") as rec:
+        with obs.span("outer", archive="a.fits"):
+            with obs.span("inner", tag=7):
+                pass
+        run_dir = rec.dir
+    ev = [e for e in _events(run_dir) if e["kind"] == "span"]
+    assert [e["name"] for e in ev] == ["inner", "outer"]  # close order
+    inner, outer = ev
+    assert inner["path"] == "outer/inner" and outer["path"] == "outer"
+    assert inner["tag"] == 7 and outer["archive"] == "a.fits"
+    for e in ev:
+        assert e["dur_s"] >= 0.0 and "t" in e
+    assert outer["dur_s"] >= inner["dur_s"]
+
+
+def test_span_block_returns_value_and_survives_nonarrays(tmp_path,
+                                                         monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    with obs.run("blocks"):
+        with obs.span("solve") as sp:
+            y = sp.block(jnp.arange(3.0) * 2)
+        with obs.span("host") as sp:
+            assert sp.block({"not": "an array"}) == {"not": "an array"}
+    np.testing.assert_allclose(np.asarray(y), [0.0, 2.0, 4.0])
+
+
+def test_event_jsonl_roundtrip_including_numpy(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    with obs.run("events") as rec:
+        obs.event("payload", arr=np.arange(3), scalar=np.float64(1.5),
+                  text="μs", nested={"k": [1, 2]})
+        run_dir = rec.dir
+    (e,) = [x for x in _events(run_dir) if x["kind"] == "event"]
+    assert e["name"] == "payload"
+    assert e["arr"] == [0, 1, 2] and e["scalar"] == 1.5
+    assert e["text"] == "μs" and e["nested"] == {"k": [1, 2]}
+
+
+def test_phases_sequential_timer(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    with obs.run("phases") as rec:
+        ph = obs.phases(archive="x.fits")
+        ph.enter("load")
+        ph.enter("solve", batch=5)
+        ph.block(jnp.ones(2))
+        ph.done(n_toas=5)
+        run_dir = rec.dir
+    ev = [e for e in _events(run_dir) if e["kind"] == "span"]
+    assert [e["name"] for e in ev] == ["load", "solve"]
+    assert all(e["archive"] == "x.fits" for e in ev)
+    assert ev[1]["batch"] == 5 and ev[1]["n_toas"] == 5
+    # a phase span inside a with-span nests in the path
+    with obs.run("phases2") as rec:
+        with obs.span("outer"):
+            ph = obs.phases()
+            ph.enter("solve")
+            ph.done()
+        run_dir = rec.dir
+    ev = [e for e in _events(run_dir) if e["name"] == "solve"]
+    assert ev[0]["path"] == "outer/solve"
+
+
+# -- runs + manifests --------------------------------------------------
+
+def test_manifest_open_and_close(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    with obs.run("mani", config={"nsub": 3}) as rec:
+        open_man = _manifest(rec.dir)  # written eagerly at open
+        assert open_man["schema"] == "pptpu-obs-v1"
+        assert open_man["config"] == {"nsub": 3}
+        assert open_man["name"] == "mani"
+        assert "wall_s" not in open_man
+        obs.counter("widgets", 2)
+        obs.gauge("level", 0.5)
+        run_dir = rec.dir
+    man = _manifest(run_dir)
+    assert man["wall_s"] > 0 and man["t_end"] >= man["t_start"]
+    assert man["counters"]["widgets"] == 2
+    assert man["gauges"]["level"] == 0.5
+    assert "jit_cache_sizes" in man
+    assert man["platform"] == "cpu"  # conftest pins the cpu backend
+
+
+def test_run_reentrant_shares_one_recorder(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    with obs.run("outer", config={"a": 1}) as outer:
+        with obs.run("inner", config={"b": 2}) as inner:
+            assert inner is outer  # joined, not a second run
+            obs.configure(c=3)
+        # inner exit must NOT close the shared recorder
+        obs.event("still-open")
+        run_dir = outer.dir
+    assert len(list(tmp_path.iterdir())) == 1  # exactly one run dir
+    man = _manifest(run_dir)
+    assert man["config"] == {"a": 1, "b": 2, "c": 3}
+    assert any(e.get("name") == "still-open" for e in _events(run_dir))
+
+
+# -- jax.monitoring bridge ---------------------------------------------
+
+def test_monitoring_bridge_shared_with_trace_counter(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+
+    @jax.jit
+    def fresh(x):
+        return jnp.tanh(x) * 3.0
+
+    with obs.run("compiles") as rec:
+        with debug.trace_counter() as c:
+            fresh(jnp.ones(23)).block_until_ready()  # unique shape
+        run_dir = rec.dir
+        rec_compiles = rec.counters.get("backend_compiles", 0)
+    assert c.compiles > 0
+    # the recorder saw at least the compiles the counter saw (it was
+    # subscribed for the whole run, the counter only for its context)
+    assert rec_compiles >= c.compiles
+    comp_ev = [e for e in _events(run_dir) if e["kind"] == "compile"]
+    assert len(comp_ev) == rec_compiles
+    assert all(e["dur_s"] >= 0.0 for e in comp_ev)
+    man = _manifest(run_dir)
+    assert man["counters"]["backend_compiles"] == rec_compiles
+    assert man["compile_total_s"] >= 0.0
+
+
+def test_compile_events_attributed_to_open_span(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+
+    @jax.jit
+    def fresh2(x):
+        return jnp.sin(x) + 2.0
+
+    with obs.run("attrib") as rec:
+        with obs.span("solve"):
+            fresh2(jnp.ones(29)).block_until_ready()
+        run_dir = rec.dir
+    spans = {e.get("span") for e in _events(run_dir)
+             if e["kind"] == "compile"}
+    assert "solve" in spans
+
+
+# -- fit telemetry -----------------------------------------------------
+
+def _tiny_batch(seed, B=3, nchan=4, nbin=64):
+    rng = np.random.default_rng(seed)
+    phases = (np.arange(nbin) + 0.5) / nbin
+    prof = np.exp(-0.5 * ((phases - 0.5) / 0.02) ** 2)
+    model = np.broadcast_to(prof, (nchan, nbin)).copy()
+    data = model[None] * rng.uniform(0.9, 1.1, (B, nchan, 1)) \
+        + rng.normal(0.0, 0.01, (B, nchan, nbin))
+    return model, data
+
+
+def test_fit_telemetry_from_batched_solver(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    model, data = _tiny_batch(1)
+    with obs.run("fits") as rec:
+        out = fp.fit_portrait_full_batch(
+            data, model, None, 0.004, np.linspace(1300.0, 1700.0, 4),
+            errs=np.full((3, 4), 0.01), max_iter=25)
+        jax.block_until_ready(out.params)
+        run_dir = rec.dir
+    fit_ev = [e for e in _events(run_dir) if e["kind"] == "fit"]
+    assert len(fit_ev) == 1
+    (e,) = fit_ev
+    assert e["where"] == "fit_portrait_full_batch"
+    assert e["batch"] == 3
+    assert e["fit_flags"] == [1, 1, 0, 0, 0]
+    assert e["nfeval"]["min"] >= 1
+    assert len(e["nfeval_per_subint"]) == 3
+    assert len(e["red_chi2_per_subint"]) == 3
+    assert sum(e["rc_hist"].values()) == 3
+    assert e["n_bad"] == 0 and e["bad_isubs"] == []
+    man = _manifest(run_dir)
+    assert man["counters"]["fit_subints"] == 3
+    assert man["counters"]["fit_batches"] == 1
+
+
+def test_fit_telemetry_flags_nonconverged(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    rc = np.array([1, 3, 1, 4])
+    bunch = {"nfeval": np.array([4, 30, 5, 12]),
+             "red_chi2": np.array([1.0, 2.0, np.nan, 1.1]),
+             "return_code": rc}
+    with obs.run("bad") as rec:
+        obs.fit_telemetry(bunch, where="synthetic")
+        run_dir = rec.dir
+    (e,) = [x for x in _events(run_dir) if x["kind"] == "fit"]
+    # rc 3 (max iter), rc 4 (stuck), and the NaN-chi2 subint are bad
+    assert e["n_bad"] == 3
+    assert e["bad_isubs"] == [1, 2, 3]
+    assert e["chi2"]["n_nonfinite"] == 1
+    assert e["rc_hist"] == {"1": 2, "3": 1, "4": 1}
+
+
+# -- jit purity --------------------------------------------------------
+
+def test_no_obs_call_syncs_inside_traced_code(tmp_path, monkeypatch):
+    """The runtime half of the J002 contract: obs.fit_telemetry on a
+    traced value must pass it through without syncing, emitting, or
+    perturbing compilation — even with a run open."""
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    with obs.run("purity") as rec:
+
+        @jax.jit
+        def traced(x):
+            # deliberate misuse (statically flagged by jaxlint J002;
+            # tests/ is outside the linted tree)
+            obs.fit_telemetry({"nfeval": x, "chi2": x.sum(),
+                               "return_code": x.astype(int)},
+                              where="inner")
+            return x * 2.0
+
+        y1 = traced(jnp.arange(31.0))
+        n_fit_events = sum(1 for e in _events(rec.dir)
+                           if e["kind"] == "fit")
+        assert n_fit_events == 0  # tracer guard: nothing emitted
+        # build the second input OUTSIDE the counter window (eager ops
+        # compile too; only the jitted call is under test)
+        x2 = jax.block_until_ready(jnp.arange(31.0) + 1.0)
+        with debug.trace_counter() as c:
+            y2 = traced(x2)
+        assert c.traces == 0 and c.compiles == 0  # pure cache hit
+    np.testing.assert_allclose(np.asarray(y1), np.arange(31.0) * 2)
+    np.testing.assert_allclose(np.asarray(y2), (np.arange(31.0) + 1) * 2)
+
+
+# -- profiler hook -----------------------------------------------------
+
+def test_trace_capture_disabled_is_noop(monkeypatch):
+    monkeypatch.delenv("PPTPU_TRACE_DIR", raising=False)
+    with obs.trace_capture("x") as path:
+        assert path is None
+
+
+def test_trace_capture_enabled_records_outcome(tmp_path, monkeypatch):
+    """With PPTPU_TRACE_DIR set, capture either succeeds (trace event +
+    files under the dir) or degrades to a trace_error event — it must
+    never raise (remote tunnels may not support profiling)."""
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path / "obs"))
+    monkeypatch.setenv("PPTPU_TRACE_DIR", str(tmp_path / "prof"))
+    os.makedirs(str(tmp_path / "prof"), exist_ok=True)
+    with obs.run("prof") as rec:
+        with obs.trace_capture("region") as path:
+            jnp.sum(jnp.ones(8)).block_until_ready()
+        run_dir = rec.dir
+    ev = [e for e in _events(run_dir)
+          if e["kind"] == "event" and e["name"] in ("trace",
+                                                    "trace_error")]
+    assert len(ev) == 1
+    if ev[0]["name"] == "trace":
+        assert path is not None and os.path.isdir(path)
+
+
+# -- report ------------------------------------------------------------
+
+def test_obs_report_summarizes_run(tmp_path, monkeypatch):
+    from tools import obs_report
+
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    model, data = _tiny_batch(2)
+    with obs.run("report") as rec:
+        ph = obs.phases(archive="r.fits")
+        ph.enter("load")
+        ph.enter("solve")
+        out = fp.fit_portrait_full_batch(
+            data, model, None, 0.004, np.linspace(1300.0, 1700.0, 4),
+            errs=np.full((3, 4), 0.01), max_iter=25)
+        ph.block(out.params)
+        ph.enter("polish")
+        ph.enter("write")
+        ph.done()
+        run_dir = rec.dir
+    text = obs_report.summarize(run_dir)
+    for phase in ("load", "solve", "polish", "write"):
+        assert "| %s " % phase in text
+    assert "fit telemetry" in text
+    assert "subints: 3" in text
+    assert "rc" in text
+    # find_run_dir resolves the newest run from the obs base dir
+    assert obs_report.find_run_dir(str(tmp_path)) == run_dir
+
+
+def test_obs_report_cli_main(tmp_path, monkeypatch, capsys):
+    from tools import obs_report
+
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    with obs.run("cli") as rec:
+        with obs.span("solve"):
+            pass
+        run_dir = rec.dir
+    assert obs_report.main([run_dir]) == 0
+    assert "## phases" in capsys.readouterr().out
+    assert obs_report.main([str(tmp_path / "nonexistent")]) == 1

@@ -4,7 +4,9 @@
 #   1. jaxlint  — repo-native JAX/TPU static analysis (J001-J005)
 #   2. ruff     — generic python lint (skipped when not installed;
 #                 configuration lives in pyproject.toml [tool.ruff])
-#   3. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
+#   3. obs smoke — tiny synthetic pptoas run must emit a valid
+#                 manifest + event stream (docs/OBSERVABILITY.md)
+#   4. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
 #
 # Exit status is non-zero when any stage fails.
 set -u
@@ -21,6 +23,17 @@ if command -v ruff >/dev/null 2>&1; then
     ruff check . || fail=1
 else
     echo "ruff not installed — skipped (pip install ruff to enable)"
+fi
+
+echo
+echo "== obs smoke (manifest + events, docs/OBSERVABILITY.md) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu PPTPU_OBS_DIR="" \
+    python -m tools.obs_smoke >/tmp/_obs_smoke.log 2>&1
+if [ $? -ne 0 ]; then
+    tail -40 /tmp/_obs_smoke.log
+    fail=1
+else
+    tail -1 /tmp/_obs_smoke.log
 fi
 
 echo

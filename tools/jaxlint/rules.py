@@ -33,6 +33,17 @@ _HOST_SYNC_CALLS = {"float", "int", "bool", "complex"}
 _HOST_SYNC_NP = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
 _HOST_SYNC_METHODS = {"item", "tolist"}
 
+# observability API (pulseportraiture_tpu.obs): host-side by contract.
+# Inside jit a span would time TRACING (the body runs once, at trace
+# time) and fit telemetry would sync or silently no-op — both are
+# misuse, flagged regardless of argument tracedness.  Matched as
+# ``obs.<name>`` (the repo's import idiom) or the bare re-exported
+# telemetry entry points.
+_OBS_API_NAMES = {"span", "phases", "event", "counter", "gauge",
+                  "fit_telemetry", "configure", "run", "scoped_run",
+                  "trace_capture"}
+_OBS_BARE_CALLS = {"fit_telemetry", "trace_capture"}
+
 _JNP_PREFIXES = ("jnp.", "jax.numpy.")
 
 
@@ -320,6 +331,17 @@ class RuleVisitor(ast.NodeVisitor):
                           ".%s() on a traced value inside a jitted "
                           "function — host sync breaks tracing"
                           % node.func.attr)
+            elif fname is not None and (
+                    (fname.startswith("obs.")
+                     and fname.split(".", 1)[1] in _OBS_API_NAMES)
+                    or fname in _OBS_BARE_CALLS):
+                self._add("J002", node,
+                          "obs API call inside a jitted function — "
+                          "telemetry is host-side by contract: under "
+                          "jit a span times tracing (the body runs "
+                          "once, at trace time) and fit telemetry "
+                          "would sync a traced value; move it after "
+                          "the jit boundary (docs/OBSERVABILITY.md)")
             elif fname is not None and "." in fname:
                 head, attr = fname.rsplit(".", 1)
                 if attr in _HOST_SYNC_METHODS and \

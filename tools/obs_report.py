@@ -1,0 +1,233 @@
+"""Summarize an observability run (manifest.json + events.jsonl).
+
+Turns the JSONL event stream a ``PPTPU_OBS_DIR`` run writes
+(docs/OBSERVABILITY.md) into the per-phase timing and per-subint
+convergence tables PERF.md used to maintain by hand:
+
+    python -m tools.obs_report <run-dir>        # one run
+    python -m tools.obs_report <obs-dir>        # newest run inside
+    python -m tools.obs_report                  # $PPTPU_OBS_DIR newest
+
+Sections: run header (platform, git SHA, wall), the phase-span table
+(load / compile / solve / polish / write, plus whatever else the run
+emitted — "compile" is synthesized from the jax.monitoring compile
+events, attributed to the span they fired inside), fit-quality
+telemetry aggregated over every batched solve (nfeval, reduced chi2,
+return-code histogram, non-converged subints), and the counters/gauges
+from the closed manifest.
+"""
+
+import json
+import os
+import sys
+
+# canonical pipeline phase order; anything else sorts after, by name
+_PHASE_ORDER = ["load", "compile", "guess", "solve", "polish", "write"]
+
+
+def find_run_dir(path=None):
+    """Resolve a run directory: an explicit run dir, the newest run
+    inside an obs dir, or the newest run inside $PPTPU_OBS_DIR."""
+    if path is None:
+        path = os.environ.get("PPTPU_OBS_DIR", "").strip()
+        if not path:
+            raise FileNotFoundError(
+                "no run dir given and PPTPU_OBS_DIR is unset")
+    if os.path.isfile(os.path.join(path, "events.jsonl")) or \
+            os.path.isfile(os.path.join(path, "manifest.json")):
+        return path
+    runs = [os.path.join(path, d) for d in os.listdir(path)
+            if os.path.isfile(os.path.join(path, d, "manifest.json"))]
+    if not runs:
+        raise FileNotFoundError("no obs runs under %s" % path)
+    return max(runs, key=os.path.getmtime)
+
+
+def load_run(run_dir):
+    """(manifest dict, list of event dicts) for one run directory."""
+    manifest = {}
+    mpath = os.path.join(run_dir, "manifest.json")
+    if os.path.isfile(mpath):
+        with open(mpath, encoding="utf-8") as fh:
+            manifest = json.load(fh)
+    events = []
+    epath = os.path.join(run_dir, "events.jsonl")
+    if os.path.isfile(epath):
+        with open(epath, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass  # a torn tail line from a crashed run
+    return manifest, events
+
+
+def _fmt_s(x):
+    return "%.3f" % x
+
+
+def _table(headers, rows):
+    """Minimal markdown table."""
+    out = ["| " + " | ".join(headers) + " |",
+           "|" + "|".join("---" for _ in headers) + "|"]
+    for row in rows:
+        out.append("| " + " | ".join(str(c) for c in row) + " |")
+    return "\n".join(out)
+
+
+def _phase_key(name):
+    try:
+        return (0, _PHASE_ORDER.index(name))
+    except ValueError:
+        return (1, name)
+
+
+def summarize_spans(events):
+    """Aggregate span events by phase name; compile events synthesize
+    their own phase row (duration reported by jax.monitoring)."""
+    agg = {}
+    for e in events:
+        if e.get("kind") == "span":
+            name = e.get("name", "?")
+        elif e.get("kind") == "compile":
+            name = "compile"
+        else:
+            continue
+        a = agg.setdefault(name, {"count": 0, "total": 0.0, "max": 0.0})
+        dur = float(e.get("dur_s", 0.0))
+        a["count"] += 1
+        a["total"] += dur
+        a["max"] = max(a["max"], dur)
+    rows = []
+    for name in sorted(agg, key=_phase_key):
+        a = agg[name]
+        rows.append([name, a["count"], _fmt_s(a["total"]),
+                     _fmt_s(a["total"] / a["count"]), _fmt_s(a["max"])])
+    return _table(["phase", "n", "total_s", "mean_s", "max_s"], rows) \
+        if rows else "(no span events)"
+
+
+def summarize_compiles(events):
+    """Compile seconds attributed to the span they fired inside."""
+    per_span = {}
+    for e in events:
+        if e.get("kind") != "compile":
+            continue
+        key = e.get("span") or "(outside any span)"
+        c = per_span.setdefault(key, {"count": 0, "total": 0.0})
+        c["count"] += 1
+        c["total"] += float(e.get("dur_s", 0.0))
+    if not per_span:
+        return None
+    rows = [[k, v["count"], _fmt_s(v["total"])]
+            for k, v in sorted(per_span.items(),
+                               key=lambda kv: -kv[1]["total"])]
+    return _table(["span", "compiles", "total_s"], rows)
+
+
+def summarize_fits(events):
+    """Per-subint convergence stats aggregated over every fit event."""
+    fits = [e for e in events if e.get("kind") == "fit"]
+    if not fits:
+        return None
+    nfev, chi2, rc_hist = [], [], {}
+    n_bad = n_sub = 0
+    for e in fits:
+        nfev.extend(e.get("nfeval_per_subint", []))
+        chi2.extend(c for c in e.get("red_chi2_per_subint", [])
+                    if c is not None)
+        for k, v in (e.get("rc_hist") or {}).items():
+            rc_hist[k] = rc_hist.get(k, 0) + v
+        n_bad += int(e.get("n_bad", 0))
+        n_sub += int(e.get("batch", 0))
+    lines = ["fit batches: %d   subints: %d   non-converged: %d"
+             % (len(fits), n_sub, n_bad)]
+    if nfev:
+        s = sorted(nfev)
+        lines.append("nfeval: min %d / median %d / p90 %d / max %d"
+                     % (s[0], s[len(s) // 2],
+                        s[min(len(s) - 1, int(0.9 * len(s)))], s[-1]))
+    fin = sorted(c for c in chi2
+                 if isinstance(c, (int, float)) and c == c
+                 and abs(c) != float("inf"))
+    if fin:
+        lines.append("red_chi2: median %.4f / max %.4f"
+                     % (fin[len(fin) // 2], fin[-1]))
+    if rc_hist:
+        lines.append("return codes: " + "  ".join(
+            "rc%s×%d" % (k, v) for k, v in sorted(rc_hist.items())))
+    bad = [(e.get("where"), e.get("bad_isubs"))
+           for e in fits if e.get("n_bad")]
+    for where, isubs in bad[:10]:
+        lines.append("  bad subints (%s): %s" % (where, isubs))
+    return "\n".join(lines)
+
+
+def summarize(run_dir):
+    """Full human-readable report for one run directory."""
+    manifest, events = load_run(run_dir)
+    out = []
+    out.append("# obs report: %s" % manifest.get("run_id",
+                                                 os.path.basename(
+                                                     run_dir.rstrip("/"))))
+    head = []
+    for key in ("name", "platform", "device_count", "jax_version",
+                "git_sha", "wall_s", "compile_total_s"):
+        if manifest.get(key) is not None:
+            head.append("%s: %s" % (key, manifest[key]))
+    if manifest.get("backend_error"):
+        head.append("backend_error: %s" % manifest["backend_error"])
+    out.append("  ".join(head))
+    cfg = manifest.get("config") or {}
+    if cfg:
+        out.append("config: " + json.dumps(cfg, sort_keys=True))
+    out.append("")
+    out.append("## phases")
+    out.append(summarize_spans(events))
+    comp = summarize_compiles(events)
+    if comp:
+        out.append("")
+        out.append("## compile attribution")
+        out.append(comp)
+    fits = summarize_fits(events)
+    if fits:
+        out.append("")
+        out.append("## fit telemetry (per-subint convergence)")
+        out.append(fits)
+    counters = manifest.get("counters") or {}
+    gauges = manifest.get("gauges") or {}
+    caches = manifest.get("jit_cache_sizes") or {}
+    if counters or gauges or caches:
+        out.append("")
+        out.append("## counters")
+        for k, v in sorted(counters.items()):
+            out.append("- %s: %s" % (k, v))
+        for k, v in sorted(gauges.items()):
+            out.append("- %s (gauge): %s" % (k, v))
+        for k, v in sorted(caches.items()):
+            out.append("- %s (jit cache size): %s" % (k, v))
+    n_traces = sum(1 for e in events if e.get("kind") == "event"
+                   and e.get("name") == "trace")
+    if n_traces:
+        out.append("")
+        out.append("profiler traces captured: %d (PPTPU_TRACE_DIR)"
+                   % n_traces)
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    try:
+        run_dir = find_run_dir(argv[0] if argv else None)
+    except (FileNotFoundError, OSError) as e:
+        print("obs_report: %s" % e, file=sys.stderr)
+        return 1
+    sys.stdout.write(summarize(run_dir))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,101 @@
+"""Obs smoke gate: a tiny synthetic pptoas run must produce a valid
+manifest + event stream (wired into tools/check.sh).
+
+Generates a small fake archive + gmodel, runs the real GetTOAs
+pipeline under an observability run, and asserts the contract the
+acceptance criteria name: a manifest.json with the schema/context
+fields, an events.jsonl containing the per-phase spans
+(load/guess/solve/polish/write) and per-subint fit telemetry, and a
+tools/obs_report.py summary that renders them.  Uses PPTPU_OBS_DIR
+when set, else a temp dir it cleans up.
+
+Run:  env JAX_PLATFORMS=cpu python -m tools.obs_smoke
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as np
+
+REQUIRED_SPANS = {"load", "guess", "solve", "polish", "write"}
+
+
+def main():
+    cleanup = []
+    base = os.environ.get("PPTPU_OBS_DIR", "").strip()
+    if not base:
+        base = tempfile.mkdtemp(prefix="pptpu_obs_smoke_")
+        os.environ["PPTPU_OBS_DIR"] = base
+        cleanup.append(base)
+    workdir = tempfile.mkdtemp(prefix="pptpu_obs_smoke_data_")
+    cleanup.append(workdir)
+    try:
+        from pulseportraiture_tpu import obs
+        from pulseportraiture_tpu.io.archive import make_fake_pulsar
+        from pulseportraiture_tpu.io.gmodel import write_model
+        from pulseportraiture_tpu.pipelines.toas import GetTOAs
+
+        gm = os.path.join(workdir, "smoke.gmodel")
+        write_model(gm, "smoke", "000", 1500.0,
+                    np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5]),
+                    np.ones(8, int), -4.0, 0, quiet=True)
+        par = os.path.join(workdir, "smoke.par")
+        with open(par, "w") as f:
+            f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                    "PEPOCH 56000.0\nDM 30.0\n")
+        fits = os.path.join(workdir, "smoke.fits")
+        make_fake_pulsar(gm, par, fits, nsub=2, nchan=8, nbin=64,
+                         nu0=1500.0, bw=800.0, tsub=60.0, phase=0.05,
+                         dDM=5e-4, noise_stds=0.01, dedispersed=False,
+                         seed=11, quiet=True)
+
+        with obs.run("obs-smoke") as rec:
+            assert rec is not None, "PPTPU_OBS_DIR set but no recorder"
+            gt = GetTOAs([fits], gm, quiet=True)
+            gt.get_TOAs(bary=False, quiet=True)
+            gt.write_TOAs(outfile=os.path.join(workdir, "smoke.tim"))
+            run_dir = rec.dir
+        assert gt.TOA_list, "smoke pipeline produced no TOAs"
+
+        manifest_path = os.path.join(run_dir, "manifest.json")
+        events_path = os.path.join(run_dir, "events.jsonl")
+        assert os.path.isfile(manifest_path), "manifest.json not written"
+        assert os.path.isfile(events_path), "events.jsonl not written"
+        with open(manifest_path, encoding="utf-8") as fh:
+            manifest = json.load(fh)
+        assert manifest.get("schema") == "pptpu-obs-v1", manifest
+        assert manifest.get("wall_s", 0) > 0, "manifest never closed"
+        assert "config" in manifest and \
+            manifest["config"].get("pipeline") == "get_TOAs", \
+            "pipeline config missing from manifest"
+        with open(events_path, encoding="utf-8") as fh:
+            events = [json.loads(line) for line in fh if line.strip()]
+        span_names = {e.get("name") for e in events
+                      if e.get("kind") == "span"}
+        missing = REQUIRED_SPANS - span_names
+        assert not missing, "missing phase spans: %s (got %s)" % (
+            sorted(missing), sorted(span_names))
+        fit_events = [e for e in events if e.get("kind") == "fit"]
+        assert fit_events, "no fit telemetry events"
+        assert all("rc_hist" in e and "nfeval" in e
+                   for e in fit_events), fit_events
+
+        from tools.obs_report import summarize
+
+        text = summarize(run_dir)
+        for phase in sorted(REQUIRED_SPANS):
+            assert phase in text, "obs_report summary lacks %r" % phase
+        assert "fit telemetry" in text
+        sys.stdout.write(text)
+        print("obs smoke OK: %s" % run_dir)
+        return 0
+    finally:
+        for d in cleanup:
+            shutil.rmtree(d, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

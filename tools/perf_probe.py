@@ -157,14 +157,15 @@ def main():
     from bench_common import NorthStar, enable_compile_cache
 
     enable_compile_cache(jax)
-    ns = NorthStar(jax)
-    platform = jax.devices()[0].platform
+    ns = NorthStar(jax)  # CPU fallback on backend-init failure
+    platform = ns.platform
 
     data_all = ns.main_data()
     _stage("main data on device")
     trace_dir = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), ".jax_profile")
     results = {"platform": platform,
+               "backend_fallback": ns.backend_fallback,
                "config": {"nsub": ns.nsub, "nchan": ns.nchan,
                           "nbin": ns.nbin, "scan": ns.scan,
                           "kmax": int(ns.kmax)},
