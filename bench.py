@@ -195,8 +195,41 @@ def _hetero_stress(on_accel):
         shutil.rmtree(hdir, ignore_errors=True)
 
 
-@obs.scoped_run("bench")
 def main():
+    """Open the bench obs run and print the BENCH line from it.
+
+    The one-line JSON the driver captures is not assembled twice: the
+    bench body emits its result as the obs run's ``result`` event, and
+    the printed line is that event READ BACK from the run directory
+    (tools.obs_report.result_payload) — the driver's BENCH_r*.json and
+    ``python -m tools.obs_report`` summarize the same bytes and can
+    never disagree (ROADMAP bench/obs unification).  With PPTPU_OBS_DIR
+    unset the run lands in a temp dir that is discarded after the
+    read-back.
+    """
+    import shutil
+    import tempfile
+
+    from tools.obs_report import result_payload
+
+    base = obs.obs_dir()
+    tmp = None
+    if base is None:
+        tmp = tempfile.mkdtemp(prefix="pp_bench_obs_")
+        base = tmp
+    try:
+        with obs.run("bench", base_dir=base) as rec:
+            result = _bench()
+            run_dir = rec.dir if rec is not None else None
+        payload = result_payload(run_dir) if run_dir else None
+        print(json.dumps(payload if payload is not None else result))
+        return 0
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _bench():
     import jax
     import jax.numpy as jnp
 
@@ -463,9 +496,8 @@ def main():
             "backend_fallback": ns.backend_fallback,
         },
     }
-    obs.event("result", **result)
-    print(json.dumps(result))
-    return 0
+    obs.event("result", payload=result)
+    return result
 
 
 if __name__ == "__main__":

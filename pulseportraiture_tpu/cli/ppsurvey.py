@@ -1,0 +1,181 @@
+"""ppsurvey command-line tool: survey-scale TOA measurement.
+
+Front-end for the survey runner (docs/RUNNER.md): plan a metafile into
+shape buckets, run/resume the bucketed fits with fault isolation, and
+report state + the merged observability run.
+
+    python -m pulseportraiture_tpu.cli.ppsurvey plan   -d archives.meta \\
+        -m model.gmodel -w workdir
+    python -m pulseportraiture_tpu.cli.ppsurvey run    -w workdir
+    python -m pulseportraiture_tpu.cli.ppsurvey resume -w workdir
+    python -m pulseportraiture_tpu.cli.ppsurvey status -w workdir
+    python -m pulseportraiture_tpu.cli.ppsurvey report -w workdir
+
+``run`` and ``resume`` are the same operation (the ledger makes every
+run a resume); both names exist so scripts read honestly.  On a
+multi-process (pod-slice) job every process runs the same command; the
+plan is partitioned deterministically and process 0 merges the obs
+shards.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="ppsurvey",
+        description="Shape-bucketed survey runner for wideband TOA "
+                    "measurement (docs/RUNNER.md).")
+    sub = p.add_subparsers(dest="command")
+
+    pl = sub.add_parser("plan", help="Scan archives into shape buckets.")
+    pl.add_argument("-d", "--datafiles", required=True, metavar="meta",
+                    help="Metafile of archive paths (or one archive).")
+    pl.add_argument("-m", "--modelfile", required=True, metavar="model",
+                    help="Model file the survey fits with.")
+    pl.add_argument("-w", "--workdir", required=True,
+                    help="Survey working directory (created).")
+
+    for name, help_text in (
+            ("run", "Execute the planned survey (resumable)."),
+            ("resume", "Alias of run: continue a killed survey.")):
+        r = sub.add_parser(name, help=help_text)
+        r.add_argument("-w", "--workdir", required=True)
+        r.add_argument("--process", type=int, default=None,
+                       help="Simulated process index (default: ask the "
+                            "jax runtime).")
+        r.add_argument("--processes", type=int, default=None,
+                       help="Simulated process count.")
+        r.add_argument("--max_attempts", type=int, default=3,
+                       help="Retries before an archive is quarantined.")
+        r.add_argument("--backoff", type=float, default=1.0,
+                       help="Base retry backoff [s] (doubles per "
+                            "attempt).")
+        r.add_argument("--max_archives", type=int, default=None,
+                       help="Stop after this many fit attempts "
+                            "(incremental runs).")
+        r.add_argument("--mesh", action="store_true", dest="use_mesh",
+                       help="Shard each bucket batch over the local "
+                            "device mesh.")
+        r.add_argument("--no_merge", action="store_false", dest="merge",
+                       help="Skip the process-0 obs-shard merge.")
+        r.add_argument("--tscrunch", "-T", action="store_true")
+        r.add_argument("--fit_scat", action="store_true")
+        r.add_argument("--no_bary", dest="bary", action="store_false")
+        r.add_argument("--quiet", action="store_true")
+
+    st = sub.add_parser("status", help="Aggregate ledger state.")
+    st.add_argument("-w", "--workdir", required=True)
+
+    rp = sub.add_parser("report",
+                        help="Merge obs shards + print the obs report "
+                             "and quarantine list.")
+    rp.add_argument("-w", "--workdir", required=True)
+    return p
+
+
+def _plan_path(workdir):
+    return os.path.join(workdir, "plan.json")
+
+
+def _cmd_plan(args):
+    from ..runner.plan import plan_survey
+
+    os.makedirs(args.workdir, exist_ok=True)
+    plan = plan_survey(args.datafiles, modelfile=args.modelfile,
+                       quiet=False)
+    plan.save(_plan_path(args.workdir))
+    print(json.dumps({
+        "plan": _plan_path(args.workdir),
+        "n_archives": plan.n_archives,
+        "n_buckets": len(plan.buckets),
+        "buckets": {"%dx%d" % b.key: len(b.archives)
+                    for b in plan.buckets},
+        "unreadable": len(plan.unreadable)}))
+    return 0
+
+
+def _cmd_run(args):
+    from ..runner.execute import run_survey
+
+    plan = _plan_path(args.workdir)
+    if not os.path.isfile(plan):
+        print(f"ppsurvey: no plan at {plan} — run 'ppsurvey plan' "
+              "first.", file=sys.stderr)
+        return 1
+    summary = run_survey(
+        plan, args.workdir, process_index=args.process,
+        process_count=args.processes, max_attempts=args.max_attempts,
+        backoff_s=args.backoff, use_mesh=args.use_mesh,
+        merge=args.merge, max_archives=args.max_archives,
+        quiet=args.quiet, tscrunch=args.tscrunch, bary=args.bary,
+        fit_scat=args.fit_scat)
+    print(json.dumps({"counts": summary["counts"],
+                      "quarantined": summary["quarantined"],
+                      "checkpoint": summary["checkpoint"]}))
+    return 0 if not summary["counts"].get("failed") else 1
+
+
+def _cmd_status(args):
+    from ..runner.execute import survey_status
+
+    try:
+        status = survey_status(args.workdir)
+    except FileNotFoundError as e:
+        print(f"ppsurvey: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps({"counts": status["counts"],
+                      "quarantined": [
+                          {"archive": a, "reason": r}
+                          for a, r in status["quarantined"]]},
+                     indent=1))
+    return 0
+
+
+def _cmd_report(args):
+    from ..obs.merge import merge_obs_shards
+    from ..runner.execute import survey_status
+
+    shards = os.path.join(args.workdir, "obs_shards")
+    merged = os.path.join(args.workdir, "obs_merged")
+    try:
+        merge_obs_shards(shards, merged)
+    except FileNotFoundError as e:
+        print(f"ppsurvey: {e}", file=sys.stderr)
+        return 1
+    try:
+        from tools.obs_report import summarize
+    except ImportError:  # repo tools not on sys.path: raw pointer
+        print(f"merged obs run: {merged} (render with "
+              "python -m tools.obs_report from the repo root)")
+    else:
+        sys.stdout.write(summarize(merged))
+    try:
+        status = survey_status(args.workdir)
+    except FileNotFoundError:
+        return 0
+    print("\n## survey state")
+    for k, v in sorted(status["counts"].items()):
+        print(f"- {k}: {v}")
+    if status["quarantined"]:
+        print("\n## quarantined archives")
+        for archive, reason in status["quarantined"]:
+            print(f"- {archive}: {reason}")
+    return 0
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.command is None:
+        build_parser().print_help()
+        return 1
+    return {"plan": _cmd_plan, "run": _cmd_run, "resume": _cmd_run,
+            "status": _cmd_status, "report": _cmd_report}[args.command](
+                args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

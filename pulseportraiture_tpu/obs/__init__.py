@@ -8,11 +8,15 @@ summarizes a run into the tables PERF.md used to maintain by hand.
 
 Layout:
 
-* :mod:`.core`     — runs, spans, events, counters, fit telemetry
+* :mod:`.core`     — runs, spans, events, counters, fit telemetry,
+  size-based sink rotation (``PPTPU_OBS_MAX_BYTES``)
 * :mod:`.monitor`  — the single jax.monitoring fan-out bridge (shared
   with the PPTPU_SANITIZE trace counters in ``debug.py``)
 * :mod:`.manifest` — run-manifest assembly (git SHA, device, env)
 * :mod:`.trace`    — opt-in jax.profiler capture (``PPTPU_TRACE_DIR``)
+* :mod:`.merge`    — multihost shard merge: per-process
+  ``events.<proc>.jsonl`` + ``manifest.<proc>.json`` shards into one
+  run (span paths prefixed by process, counters summed)
 
 Never call any of this inside ``jax.jit`` — telemetry is host-side by
 contract (jaxlint J002 enforces it statically; ``fit_telemetry``
@@ -21,11 +25,14 @@ additionally passes tracers through untouched at runtime).
 
 from . import monitor  # noqa: F401
 from .core import (Recorder, configure, counter, current, enabled,
-                   event, fit_telemetry, gauge, obs_dir, phases, run,
-                   scoped_run, span)
+                   event, fit_telemetry, gauge, list_event_files,
+                   obs_dir, obs_max_bytes, phases, run, scoped_run,
+                   span)
+from .merge import merge_obs_shards
 from .trace import trace_capture, trace_dir
 
 __all__ = ["Recorder", "configure", "counter", "current", "enabled",
-           "event", "fit_telemetry", "gauge", "obs_dir", "phases",
+           "event", "fit_telemetry", "gauge", "list_event_files",
+           "merge_obs_shards", "obs_dir", "obs_max_bytes", "phases",
            "run", "scoped_run", "span", "trace_capture", "trace_dir",
            "monitor"]

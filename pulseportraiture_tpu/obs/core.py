@@ -42,7 +42,8 @@ from .manifest import build_manifest
 
 __all__ = ["obs_dir", "enabled", "current", "run", "scoped_run",
            "configure", "span", "phases", "event", "counter", "gauge",
-           "fit_telemetry", "Recorder"]
+           "fit_telemetry", "Recorder", "list_event_files",
+           "obs_max_bytes"]
 
 _state_lock = threading.Lock()
 _active = None           # the process's active Recorder, or None
@@ -55,6 +56,39 @@ def obs_dir():
     """$PPTPU_OBS_DIR, or None when observability is disabled."""
     v = os.environ.get("PPTPU_OBS_DIR", "").strip()
     return v or None
+
+
+def obs_max_bytes():
+    """$PPTPU_OBS_MAX_BYTES: events.jsonl rotation threshold in bytes
+    (0 / unset / unparsable = no rotation)."""
+    v = os.environ.get("PPTPU_OBS_MAX_BYTES", "").strip()
+    try:
+        return max(0, int(v)) if v else 0
+    except ValueError:
+        return 0
+
+
+def list_event_files(run_dir):
+    """Every event file of a run, oldest first: the rotated set
+    (``events.jsonl.1``, ``events.jsonl.2``, ...) then the live
+    ``events.jsonl``.  Readers (tools/obs_report.py, obs/merge.py) use
+    this so survey-scale rotated runs read back as one stream."""
+    out = []
+    try:
+        names = os.listdir(run_dir)
+    except OSError:
+        return out
+    rotated = []
+    for name in names:
+        if name.startswith("events.jsonl."):
+            suffix = name.rsplit(".", 1)[-1]
+            if suffix.isdigit():
+                rotated.append((int(suffix), name))
+    out = [os.path.join(run_dir, name) for _, name in sorted(rotated)]
+    live = os.path.join(run_dir, "events.jsonl")
+    if os.path.isfile(live):
+        out.append(live)
+    return out
 
 
 def enabled():
@@ -106,6 +140,15 @@ class Recorder:
         self.manifest_path = os.path.join(self.dir, "manifest.json")
         self._lock = threading.Lock()
         self._fh = open(self.events_path, "a", encoding="utf-8")
+        # size-based sink rotation (PPTPU_OBS_MAX_BYTES): survey-scale
+        # runs emit one fit event per archive batch and must not grow
+        # one unbounded file
+        self._max_bytes = obs_max_bytes()
+        try:
+            self._bytes = os.path.getsize(self.events_path)
+        except OSError:
+            self._bytes = 0
+        self._rot_seq = 0
         self._t0 = time.time()
         self._perf0 = time.perf_counter()
         self.counters = {}
@@ -131,11 +174,33 @@ class Recorder:
             if self._closed:
                 return
             try:
+                if self._max_bytes and self._bytes and \
+                        self._bytes + len(line) + 1 > self._max_bytes:
+                    self._rotate()
                 self._fh.write(line + "\n")
                 self._fh.flush()
                 self.n_events += 1
+                self._bytes += len(line) + 1
             except OSError:
                 pass
+
+    def _rotate(self):
+        """Move the live events file aside as ``events.jsonl.<n>`` and
+        start a fresh one (caller holds the lock).  ``.1`` is the
+        oldest; ``list_event_files`` reads the set back in order.
+        Failures degrade to continuing on the current file."""
+        self._rot_seq += 1
+        try:
+            self._fh.close()
+            os.replace(self.events_path,
+                       "%s.%d" % (self.events_path, self._rot_seq))
+        except OSError:
+            pass
+        self._fh = open(self.events_path, "a", encoding="utf-8")
+        try:
+            self._bytes = os.path.getsize(self.events_path)
+        except OSError:
+            self._bytes = 0
 
     def bump(self, name, inc=1):
         with self._lock:
@@ -216,14 +281,17 @@ class Recorder:
 
 
 @contextlib.contextmanager
-def run(name, config=None):
+def run(name, config=None, base_dir=None):
     """Open a run (Recorder) for the dynamic extent of the context.
 
     Reentrant: when a run is already active (a CLI opened one and a
     pipeline opens another), the existing recorder is reused — its
     manifest absorbs the inner ``config`` and the inner context's exit
     does NOT close it.  A no-op yielding None when PPTPU_OBS_DIR is
-    unset.
+    unset — unless ``base_dir`` is given, which opens the run there
+    regardless of the environment (callers whose *output* is the obs
+    run: the survey runner's per-process shards, bench's result
+    read-back).
     """
     global _active
     with _state_lock:
@@ -233,7 +301,7 @@ def run(name, config=None):
             existing.merge_config(config)
         yield existing
         return
-    base = obs_dir()
+    base = base_dir or obs_dir()
     if base is None:
         yield None
         return

@@ -1,0 +1,180 @@
+"""Multihost obs-shard merge: per-process runs into one report.
+
+A multi-process job (a pod-slice sweep, the survey runner) gives every
+process its own recorder — per-process run directories whose contents
+are copied into a shared shard directory as::
+
+    <shards>/events.<proc>.jsonl[.N]   # rotated sets kept
+    <shards>/manifest.<proc>.json
+
+Process 0 then merges the shards into ONE run directory that
+``tools/obs_report.py`` reads like any other (the ROADMAP multihost
+metric-aggregation item):
+
+* events are concatenated in timestamp order, each tagged with
+  ``proc``; span/compile paths are prefixed ``p<proc>/`` so the phase
+  table distinguishes hosts while aggregating names;
+* fit telemetry passes through untouched — the report's per-subint
+  convergence stats sum over every shard's fit events;
+* manifest counters/gauges are summed (numeric) or kept per-process,
+  ``wall_s`` is the max (processes run concurrently), configs merged.
+"""
+
+import json
+import os
+import re
+
+from .core import list_event_files
+
+__all__ = ["write_shard", "merge_obs_shards", "list_shards"]
+
+_SHARD_RE = re.compile(r"^events\.(\d+)\.jsonl(?:\.(\d+))?$")
+
+
+def write_shard(run_dir, shards_dir, proc):
+    """Copy a closed per-process run into the shared shard layout.
+
+    Rotated event files keep their rotation index; the manifest is
+    copied as ``manifest.<proc>.json``.  Returns the list of files
+    written.
+    """
+    os.makedirs(shards_dir, exist_ok=True)
+    written = []
+    for src in list_event_files(run_dir):
+        base = os.path.basename(src)          # events.jsonl[.N]
+        suffix = base[len("events.jsonl"):]   # "" or ".N"
+        dst = os.path.join(shards_dir,
+                           "events.%d.jsonl%s" % (proc, suffix))
+        with open(src, "rb") as sf, open(dst, "wb") as df:
+            df.write(sf.read())
+        written.append(dst)
+    man_src = os.path.join(run_dir, "manifest.json")
+    if os.path.isfile(man_src):
+        dst = os.path.join(shards_dir, "manifest.%d.json" % proc)
+        with open(man_src, "rb") as sf, open(dst, "wb") as df:
+            df.write(sf.read())
+        written.append(dst)
+    return written
+
+
+def list_shards(shards_dir):
+    """{proc: [event files oldest-first]} found under ``shards_dir``."""
+    shards = {}
+    try:
+        names = os.listdir(shards_dir)
+    except OSError:
+        return shards
+    for name in names:
+        m = _SHARD_RE.match(name)
+        if not m:
+            continue
+        proc = int(m.group(1))
+        rot = int(m.group(2)) if m.group(2) else None
+        shards.setdefault(proc, []).append((rot, name))
+    out = {}
+    for proc, files in shards.items():
+        # rotated files (oldest = .1) before the live (unsuffixed) file
+        rotated = sorted((r, n) for r, n in files if r is not None)
+        live = [n for r, n in files if r is None]
+        out[proc] = [os.path.join(shards_dir, n)
+                     for _, n in rotated] + \
+                    [os.path.join(shards_dir, n) for n in live]
+    return out
+
+
+def _read_events(path):
+    events = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass  # torn tail line from a crashed shard
+    except OSError:
+        pass
+    return events
+
+
+def merge_obs_shards(shards_dir, out_dir):
+    """Merge every ``events.<proc>.jsonl`` shard set (+ manifests)
+    under ``shards_dir`` into one obs run at ``out_dir``.
+
+    Returns ``out_dir``; raises FileNotFoundError when no shards
+    exist.  Idempotent: re-merging overwrites the previous merge.
+    """
+    shards = list_shards(shards_dir)
+    if not shards:
+        raise FileNotFoundError(f"no obs shards under {shards_dir}")
+    os.makedirs(out_dir, exist_ok=True)
+
+    merged = []
+    for proc in sorted(shards):
+        for path in shards[proc]:
+            for ev in _read_events(path):
+                ev["proc"] = proc
+                if ev.get("kind") in ("span", "compile"):
+                    for field in ("path", "span"):
+                        if ev.get(field):
+                            ev[field] = "p%d/%s" % (proc, ev[field])
+                merged.append(ev)
+    merged.sort(key=lambda e: e.get("t", 0.0))
+    with open(os.path.join(out_dir, "events.jsonl"), "w",
+              encoding="utf-8") as fh:
+        for ev in merged:
+            fh.write(json.dumps(ev) + "\n")
+
+    manifests = {}
+    for proc in sorted(shards):
+        mpath = os.path.join(shards_dir, "manifest.%d.json" % proc)
+        if os.path.isfile(mpath):
+            try:
+                with open(mpath, encoding="utf-8") as fh:
+                    manifests[proc] = json.load(fh)
+            except (OSError, json.JSONDecodeError):
+                pass
+
+    counters = {}
+    gauges = {}
+    config = {}
+    wall = 0.0
+    compile_total = 0.0
+    for proc in sorted(manifests):
+        m = manifests[proc]
+        for k, v in (m.get("counters") or {}).items():
+            if isinstance(v, (int, float)):
+                counters[k] = counters.get(k, 0) + v
+        for k, v in (m.get("gauges") or {}).items():
+            gauges["p%d/%s" % (proc, k)] = v
+        config.update(m.get("config") or {})
+        wall = max(wall, float(m.get("wall_s", 0.0) or 0.0))
+        compile_total += float(m.get("compile_total_s", 0.0) or 0.0)
+    base = manifests.get(min(manifests), {}) if manifests else {}
+    out_manifest = {
+        "schema": "pptpu-obs-v1",
+        "name": str(base.get("name", "merged")) + "-merged",
+        "run_id": os.path.basename(os.path.normpath(out_dir)),
+        "merged_from": sorted(shards),
+        "n_processes": len(shards),
+        "platform": base.get("platform"),
+        "device_count": base.get("device_count"),
+        "jax_version": base.get("jax_version"),
+        "git_sha": base.get("git_sha"),
+        "t_start": min((m.get("t_start", 0.0) for m in
+                        manifests.values()), default=0.0),
+        "config": config,
+        "counters": counters,
+        "gauges": gauges,
+        "wall_s": wall,
+        "compile_total_s": round(compile_total, 6),
+        "n_events": len(merged),
+    }
+    tmp = os.path.join(out_dir, "manifest.json.tmp")
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(out_manifest, fh, indent=1)
+        fh.write("\n")
+    os.replace(tmp, os.path.join(out_dir, "manifest.json"))
+    return out_dir

@@ -26,7 +26,8 @@ from ..fit.portrait import fit_portrait_full_batch
 from .mesh import make_mesh
 
 __all__ = ["initialize", "global_mesh", "distributed_sweep_fit",
-           "process_count", "process_index"]
+           "process_count", "process_index", "partition_indices",
+           "barrier"]
 
 
 def initialize(coordinator_address=None, num_processes=None,
@@ -62,6 +63,40 @@ def process_count():
 
 def process_index():
     return jax.process_index()
+
+
+def partition_indices(n, process_id=None, num_processes=None):
+    """This process's work-item indices under deterministic round-robin
+    partitioning of ``n`` items across processes.
+
+    Every process derives the same global assignment from the same
+    item order with no communication — the DCN-free way to split an
+    embarrassingly parallel survey (the runner partitions its plan's
+    bucket-major archive order this way, runner/execute.py).  Explicit
+    ``process_id``/``num_processes`` support simulated multi-process
+    runs in one process; the defaults ask the jax runtime.
+    """
+    if num_processes is None:
+        num_processes = jax.process_count()
+    if process_id is None:
+        process_id = jax.process_index()
+    num_processes = max(1, int(num_processes))
+    process_id = int(process_id)
+    if not 0 <= process_id < num_processes:
+        raise ValueError(f"process_id {process_id} outside "
+                         f"[0, {num_processes})")
+    return list(range(process_id, int(n), num_processes))
+
+
+def barrier(name="pptpu_barrier"):
+    """Block until every process reaches this point (no-op when
+    single-process).  The runner uses it before process 0 merges the
+    per-process obs shards, so no shard is read mid-write."""
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
 
 
 def global_mesh(n_chan=1, n_bin=1, devices=None):

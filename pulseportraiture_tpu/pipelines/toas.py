@@ -33,7 +33,7 @@ from ..ops.scattering import scattering_portrait_FT, scattering_times
 from ..ops.stats import weighted_mean
 from ..utils.databunch import DataBunch
 
-__all__ = ["GetTOAs"]
+__all__ = ["GetTOAs", "drop_checkpoint_blocks"]
 
 
 def _resume_checkpoint(checkpoint, quiet=True):
@@ -139,6 +139,43 @@ def _resume_markerless_checkpoint(checkpoint, lines, quiet=True):
     return done
 
 
+def drop_checkpoint_blocks(checkpoint, archives):
+    """Remove the TOA blocks (and their ``pp_done`` markers) of the
+    given archives from a checkpoint .tim file, atomically.
+
+    The survey runner's ledger/checkpoint reconciliation uses this:
+    when the runner ledger says an archive is *pending* but the
+    checkpoint already carries its block (a crash landed between the
+    two appends, or the ledger was reset), the block is dropped so the
+    archive REFITS — never silently skipped with half-trusted TOAs,
+    never duplicated.  Archives are matched by ``os.path.realpath``
+    like :func:`_resume_checkpoint`.  Returns the number of dropped
+    blocks.
+    """
+    targets = {os.path.realpath(a) for a in archives}
+    if not targets or not os.path.isfile(checkpoint):
+        return 0
+    with open(checkpoint) as cf:
+        lines = cf.readlines()
+    kept, dropped = [], 0
+    for ln in lines:
+        tok = ln.split()
+        if len(tok) >= 4 and tok[0] == "C" and tok[1] == "pp_done":
+            if os.path.realpath(tok[2]) in targets:
+                dropped += 1
+                continue
+        elif tok and tok[0] not in ("FORMAT", "C", "#") and \
+                os.path.realpath(tok[0]) in targets:
+            continue
+        kept.append(ln)
+    if dropped or len(kept) != len(lines):
+        tmp = checkpoint + ".tmp"
+        with open(tmp, "w") as tf:
+            tf.writelines(kept)
+        os.replace(tmp, checkpoint)
+    return dropped
+
+
 def _detect_model_type(modelfile):
     """'FITS' | 'spline' | 'gmodel' for a model file path."""
     kind = file_is_type(modelfile)
@@ -175,6 +212,11 @@ class GetTOAs:
         # load failures stay silent-but-skipped as before; device/
         # tunnel failures are recorded here
         self.failed_datafiles = []
+        # batched-fit entry override (None = module-level
+        # fit_portrait_full_batch, resolved at call time so tests can
+        # monkeypatch the module attribute); the survey runner installs
+        # a mesh-sharded fitter here (runner/execute.py)
+        self.fit_batch = None
         # per-archive result lists (names per the reference)
         for attr in ["order", "obs", "doppler_fs", "nu0s", "nu_fits",
                      "nu_refs", "ok_idatafiles", "ok_isubs", "epochs",
@@ -526,7 +568,8 @@ class GetTOAs:
                         # metafile otherwise pays one multi-minute remote
                         # compile per distinct nsub
                         scan = auto_scan_size(len(sel))
-                        out = fit_portrait_full_batch(
+                        fit = self.fit_batch or fit_portrait_full_batch
+                        out = fit(
                             ports[sel], models_b[sel], init[sel],
                             Ps_b[sel], freqs_b[sel], errs=errs_b[sel],
                             weights=weights_b[sel], fit_flags=fl,
@@ -957,7 +1000,8 @@ class GetTOAs:
                                       (None, None), tuple(bounds[1]),
                                       (-10.0, 10.0)]
                     nb_scan = auto_scan_size(len(profs), profiles=True)
-                    out = fit_portrait_full_batch(
+                    fit = self.fit_batch or fit_portrait_full_batch
+                    out = fit(
                         profs[:, None, :], mods[:, None, :], init, Psx,
                         nusx[:, None], errs=errsx[:, None],
                         fit_flags=(1, 0, 0, 1, 0),

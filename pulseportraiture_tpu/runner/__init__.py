@@ -1,0 +1,27 @@
+"""Survey runner: shape-bucketed batch scheduling with fault isolation.
+
+The paper's workload is embarrassingly parallel — every archive's
+subintegrations are fit independently — but a survey of thousands of
+heterogeneous archives needs an execution *plan*: group archives into
+shape buckets so the whole survey compiles O(#buckets) programs
+(:mod:`.plan`), track per-archive state in a crash-safe on-disk ledger
+so one poison archive cannot kill a week-long run (:mod:`.queue`), and
+drive the bucketed batches across processes with per-host obs shards
+merged into one report (:mod:`.execute`).  The CLI front-end is
+``python -m pulseportraiture_tpu.cli.ppsurvey``; the full contract
+lives in docs/RUNNER.md.
+
+Everything in this package is host-side orchestration (file IO, ledger
+writes, process partitioning) and must never be reachable inside a jit
+trace — jaxlint J002 enforces this statically, exactly as it does for
+the obs API.
+"""
+
+from .plan import (ArchiveInfo, ShapeBucket, SurveyPlan, canonical_shape,
+                   pad_databunch, plan_survey, scan_archive_header)
+from .queue import WorkQueue
+from .execute import run_survey
+
+__all__ = ["ArchiveInfo", "ShapeBucket", "SurveyPlan", "canonical_shape",
+           "pad_databunch", "plan_survey", "scan_archive_header",
+           "WorkQueue", "run_survey"]

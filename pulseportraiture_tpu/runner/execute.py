@@ -1,0 +1,451 @@
+"""Survey execution: bucketed batches, fault isolation, obs shards.
+
+``run_survey`` drives a :class:`~.plan.SurveyPlan` to completion for
+ONE process of a (possibly multi-process) job:
+
+* the plan's bucket-major archive order is round-robin partitioned
+  across processes (``parallel.multihost.partition_indices``) with no
+  communication — the batch axis is embarrassingly parallel, so DCN
+  never carries anything;
+* archives are fit bucket by bucket through the normal ``GetTOAs``
+  pipeline, each archive padded to its bucket's canonical shape at
+  load time (:func:`~.plan.pad_databunch`) so the whole survey
+  compiles O(#buckets) program sets instead of O(#shapes);
+* per-archive state lives in this process's ledger shard
+  (:class:`~.queue.WorkQueue`): transient failures retry with backoff,
+  poison archives are quarantined with a reason, and a killed run
+  resumes exactly where it stopped — reconciled against the ``.tim``
+  checkpoint so a disagreement between the two refits rather than
+  silently skipping (``_reconcile``);
+* each process records its own obs run and publishes it as a shard
+  (``obs_shards/events.<proc>.jsonl``); process 0 merges the shards
+  into one report (``obs/merge.py``) after a barrier on real
+  multihost runs.
+
+With more than one local device, each bucket's batched fit is sharded
+over a ('subint', 'chan') mesh via :func:`make_mesh_fitter`
+(``use_mesh=True``) — the same GSPMD path as
+``parallel.sharded_fit.sharded_fit_portrait_batch``, adapted to the
+pipeline's per-archive fit configuration.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+from .. import obs
+from ..obs.merge import merge_obs_shards, write_shard
+from ..pipelines.toas import (GetTOAs, _resume_checkpoint,
+                              drop_checkpoint_blocks)
+from .plan import SurveyPlan, pad_databunch
+from .queue import DONE, QUARANTINED, WorkQueue
+
+__all__ = ["run_survey", "make_mesh_fitter", "survey_status"]
+
+
+class _BucketedGetTOAs(GetTOAs):
+    """GetTOAs whose loaded archives are padded to one canonical
+    (nchan, nbin) bucket shape, so every archive of the bucket reuses
+    the same compiled programs."""
+
+    def __init__(self, datafiles, modelfile, bucket_shape, quiet=True):
+        super().__init__(datafiles, modelfile, quiet=quiet)
+        self._bucket_shape = tuple(bucket_shape)
+
+    def _load_archive(self, datafile, tscrunch, quiet):
+        data = super()._load_archive(datafile, tscrunch, quiet)
+        if data is None:
+            return None
+        try:
+            return pad_databunch(data, *self._bucket_shape)
+        except ValueError as e:
+            # header lied about the shape (bucket smaller than the
+            # decoded data): treated like any unloadable archive
+            if not quiet:
+                print(f"Cannot pad {datafile} to bucket "
+                      f"{self._bucket_shape}: {e}; skipping it.")
+            return None
+
+
+def make_mesh_fitter(mesh):
+    """A ``fit_portrait_full_batch`` drop-in that shards each bucket
+    batch over ``mesh`` ('subint' data-parallel, 'chan' model-parallel,
+    GSPMD-partitioned like parallel/sharded_fit.py).
+
+    The batch is padded to a multiple of the mesh's subint axis with
+    copies of its last subint (live weights — all-dead rows would NaN
+    the weighted reductions) and the padding is sliced off the
+    outputs.  ``scan_size``/``pad_to`` are dropped: a GSPMD-sharded
+    batch axis must not be reshaped into scan chunks
+    (fit/portrait.py's auto_scan_size contract).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..fit.portrait import fit_portrait_full_batch
+    from ..parallel.mesh import batch_sharding
+    from ..utils.databunch import DataBunch
+
+    n_sub = mesh.shape["subint"]
+    sh3 = batch_sharding(mesh)
+    sh2 = NamedSharding(mesh, P("subint", "chan"))
+    sh1 = NamedSharding(mesh, P("subint"))
+    sh1x = NamedSharding(mesh, P("subint", None))
+
+    def fitter(data, models, init, Ps, freqs, errs=None, weights=None,
+               nu_fits=None, nu_outs=None, **kw):
+        kw.pop("scan_size", None)
+        kw.pop("pad_to", None)
+        data = np.asarray(data)
+        B = data.shape[0]
+        Bp = -(-B // n_sub) * n_sub
+
+        def padrow(x):
+            x = np.asarray(x)
+            if Bp == B:
+                return x
+            return np.concatenate(
+                [x, np.repeat(x[-1:], Bp - B, axis=0)], axis=0)
+
+        models = np.broadcast_to(np.asarray(models), data.shape)
+        if weights is None:
+            weights = np.ones(data.shape[:-1])
+        else:
+            weights = np.broadcast_to(np.asarray(weights),
+                                      data.shape[:-1])
+        put = jax.device_put
+        args = [put(padrow(data), sh3), put(padrow(models), sh3),
+                put(padrow(np.broadcast_to(
+                    np.asarray(init, np.float64), (B, 5))), sh1x),
+                put(padrow(np.broadcast_to(np.asarray(Ps), (B,))), sh1),
+                put(padrow(np.broadcast_to(np.asarray(freqs),
+                                           data.shape[:-1])), sh2)]
+        if errs is not None:
+            errs = put(padrow(np.broadcast_to(np.asarray(errs),
+                                              data.shape[:-1])), sh2)
+        weights = put(padrow(weights), sh2)
+        if nu_fits is not None and not isinstance(nu_fits, tuple):
+            nu_fits = put(padrow(np.asarray(nu_fits)), sh1x)
+        if nu_outs is not None and isinstance(nu_outs, tuple):
+            nu_outs = tuple(
+                None if col is None else put(padrow(np.asarray(col)),
+                                             sh1)
+                for col in nu_outs)
+        with mesh:
+            out = fit_portrait_full_batch(
+                *args, errs=errs, weights=weights, nu_fits=nu_fits,
+                nu_outs=nu_outs, **kw)
+        if Bp == B:
+            return out
+        return DataBunch(**{
+            k: (v[:B] if getattr(v, "ndim", 0) >= 1
+                and v.shape[0] == Bp else v)
+            for k, v in out.items()})
+
+    return fitter
+
+
+def _resolve_process(process_index, process_count):
+    """(pid, nproc, simulated): explicit args win (simulated
+    multi-process in one interpreter); defaults ask the jax runtime."""
+    if process_index is None and process_count is None:
+        from ..parallel import multihost
+
+        return multihost.process_index(), multihost.process_count(), \
+            False
+    return int(process_index or 0), int(process_count or 1), True
+
+
+def _paths(workdir, pid):
+    return {
+        "ledger": os.path.join(workdir, "ledger.%d.jsonl" % pid),
+        "checkpoint": os.path.join(workdir, "toas.%d.tim" % pid),
+        "obs": os.path.join(workdir, "obs"),
+        "shards": os.path.join(workdir, "obs_shards"),
+        "merged": os.path.join(workdir, "obs_merged"),
+        "survey": os.path.join(workdir, "survey.%d.json" % pid),
+        "survey_merged": os.path.join(workdir, "survey.json"),
+    }
+
+
+def _reconcile(queue, checkpoint, assigned, quiet=True):
+    """Make the ledger and the .tim checkpoint agree before fitting.
+
+    Disagreements REFIT rather than silently skip (docs/RUNNER.md):
+
+    * ledger ``done`` but no complete checkpoint block -> the TOAs are
+      lost (crash between fit and append) -> reset to pending;
+    * checkpoint block present but ledger not ``done`` -> the block is
+      half-trusted (crash between the two appends) -> drop the block,
+      the archive refits and re-appends.
+    """
+    done_ckpt = _resume_checkpoint(checkpoint, quiet) \
+        if os.path.isfile(checkpoint) else set()
+    to_drop = []
+    for info in assigned:
+        key = queue.key_for(info.path)
+        state = queue.state(info.path)
+        in_ckpt = key in done_ckpt
+        if state == DONE and not in_ckpt:
+            queue.reset(info.path, "checkpoint_missing_block")
+            obs.event("runner_reconcile", archive=info.path,
+                      action="refit", cause="checkpoint_missing_block")
+        elif state not in (DONE, QUARANTINED) and in_ckpt:
+            to_drop.append(info.path)
+            obs.event("runner_reconcile", archive=info.path,
+                      action="refit", cause="ledger_not_done")
+    if to_drop:
+        drop_checkpoint_blocks(checkpoint, to_drop)
+        if not quiet:
+            print(f"reconcile: dropped {len(to_drop)} checkpoint "
+                  "block(s) the ledger does not confirm; refitting.")
+
+
+def _fit_one(gt, queue, info, checkpoint, padded, get_toas_kw, quiet):
+    """Fit one archive with full fault isolation; returns its final
+    state.  Only BaseExceptions (kill signals) propagate."""
+    queue.claim(info.path)
+    n_fail0 = len(gt.failed_datafiles)
+    n_ord0 = len(gt.order)
+    kw = dict(get_toas_kw)
+    if padded:
+        flags = dict(kw.get("addtnl_toa_flags") or {})
+        flags.setdefault("pp_grid", "%dx%d" % gt._bucket_shape)
+        kw["addtnl_toa_flags"] = flags
+    try:
+        gt.get_TOAs(datafile=info.path, checkpoint=checkpoint,
+                    quiet=quiet, **kw)
+    except Exception as e:  # fault isolation: one archive, not the run
+        rec = queue.fail(info.path,
+                         "%s: %s" % (type(e).__name__, e))
+    else:
+        if len(gt.failed_datafiles) > n_fail0:
+            # transient device/tunnel failure GetTOAs already isolated
+            rec = queue.fail(info.path, gt.failed_datafiles[-1][1])
+        elif len(gt.order) == n_ord0:
+            # loaded-but-unusable (corrupt payload, model mismatch,
+            # no subints): deterministic-looking, but a flaky
+            # filesystem produces the same signature — bounded
+            # retries settle it, then quarantine
+            rec = queue.fail(info.path, "load_failed_or_model_mismatch")
+        else:
+            rec = queue.complete(info.path,
+                                 n_toas=int(len(gt.ok_isubs[-1])))
+    obs.event("runner_archive", archive=info.path,
+              state=rec["state"], attempts=rec.get("attempts", 0),
+              reason=rec.get("reason"))
+    return rec["state"]
+
+
+def _write_survey_manifest(path, pid, nproc, queue, plan, extra=None):
+    doc = {
+        "schema": "pptpu-survey-run-v1",
+        "process": pid,
+        "n_processes": nproc,
+        "t": time.time(),
+        "counts": queue.counts(),
+        "n_buckets": len(plan.buckets),
+        "quarantined": [{"archive": a, "reason": r}
+                        for a, r in queue.quarantined()],
+        "archives": {k: {f: v for f, v in rec.items()
+                         if f in ("state", "attempts", "reason",
+                                  "n_toas")}
+                     for k, rec in queue.entries.items()},
+    }
+    doc.update(extra or {})
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return doc
+
+
+def _merge_survey_manifests(workdir, out_path):
+    """Fold every survey.<proc>.json into one survey.json."""
+    shards = []
+    for name in sorted(os.listdir(workdir)):
+        if name.startswith("survey.") and name.endswith(".json") \
+                and name != os.path.basename(out_path):
+            stem = name[len("survey."):-len(".json")]
+            if stem.isdigit():
+                with open(os.path.join(workdir, name),
+                          encoding="utf-8") as fh:
+                    shards.append(json.load(fh))
+    counts = {}
+    archives = {}
+    quarantined = []
+    for sh in shards:
+        for k, v in sh.get("counts", {}).items():
+            counts[k] = counts.get(k, 0) + v
+        archives.update(sh.get("archives", {}))
+        quarantined.extend(sh.get("quarantined", []))
+    doc = {"schema": "pptpu-survey-run-v1",
+           "n_processes": len(shards),
+           "t": time.time(),
+           "counts": counts,
+           "quarantined": quarantined,
+           "archives": archives}
+    tmp = out_path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1)
+        fh.write("\n")
+    os.replace(tmp, out_path)
+    return doc
+
+
+def run_survey(plan, workdir, modelfile=None, process_index=None,
+               process_count=None, max_attempts=3, backoff_s=0.0,
+               use_mesh=False, mesh=None, merge=True, max_archives=None,
+               quiet=True, **get_toas_kw):
+    """Execute (or resume) one process's share of a survey plan.
+
+    ``plan`` is a SurveyPlan or a path to a saved plan.json.  All
+    state lives under ``workdir``; calling again with the same workdir
+    resumes.  Returns the process's survey-manifest dict (counts,
+    quarantined archives with reasons, per-archive states).
+
+    ``max_archives`` bounds how many fit attempts this call makes
+    (incremental surveys, deterministic kill/resume tests); archives
+    left over stay pending in the ledger.  ``merge`` lets process 0
+    fold the per-process obs shards + survey manifests into
+    ``obs_merged/`` + ``survey.json`` once its own share is written.
+    """
+    if isinstance(plan, str):
+        plan = SurveyPlan.load(plan)
+    modelfile = modelfile or plan.modelfile
+    if modelfile is None:
+        raise ValueError("run_survey needs a modelfile (argument or "
+                         "recorded on the plan)")
+    pid, nproc, simulated = _resolve_process(process_index,
+                                             process_count)
+    os.makedirs(workdir, exist_ok=True)
+    paths = _paths(workdir, pid)
+    queue = WorkQueue(paths["ledger"], max_attempts=max_attempts,
+                      backoff_s=backoff_s)
+
+    from ..parallel.multihost import barrier, partition_indices
+
+    ordered = list(plan.archives())
+    mine = [ordered[i] for i in
+            partition_indices(len(ordered), process_id=pid,
+                              num_processes=nproc)]
+    queue.add([info.path for info, _ in mine])
+    if pid == 0:
+        for path, reason in plan.unreadable:
+            if queue.state(path) != QUARANTINED:
+                queue.quarantine(path, "unreadable at plan time: %s"
+                                 % reason)
+
+    fitter = None
+    if use_mesh:
+        if mesh is None:
+            from ..parallel.mesh import make_mesh
+
+            mesh = make_mesh()
+        fitter = make_mesh_fitter(mesh)
+
+    with obs.run("ppsurvey", base_dir=paths["obs"],
+                 config={"process": pid, "n_processes": nproc,
+                         "n_archives": len(mine),
+                         "n_buckets": len(plan.buckets),
+                         "modelfile": modelfile,
+                         "use_mesh": bool(use_mesh)}) as rec:
+        _reconcile(queue, paths["checkpoint"],
+                   [info for info, _ in mine], quiet)
+        gts = {}
+        n_fit = 0
+        stop = False
+        # retry rounds: each failure bumps the attempt counter, so
+        # max_attempts rounds settle every archive into done or
+        # quarantined (modulo backoff still pending, which the next
+        # resume picks up)
+        for _ in range(queue.max_attempts + 1):
+            ran = 0
+            for info, bucket in mine:
+                if stop or queue.state(info.path) in (DONE, QUARANTINED):
+                    continue
+                if not queue.ready(info.path):
+                    continue
+                gt = gts.get(bucket.key)
+                if gt is None:
+                    gt = _BucketedGetTOAs(
+                        [i.path for i, b in mine
+                         if b.key == bucket.key],
+                        modelfile, bucket.key, quiet=quiet)
+                    gt.fit_batch = fitter
+                    gts[bucket.key] = gt
+                padded = (info.nchan, info.nbin) != bucket.key
+                _fit_one(gt, queue, info, paths["checkpoint"], padded,
+                         get_toas_kw, quiet)
+                ran += 1
+                n_fit += 1
+                if max_archives is not None and n_fit >= max_archives:
+                    stop = True
+            outstanding = queue.outstanding()
+            if stop or not outstanding:
+                break
+            if ran == 0:
+                # everything left is backing off; wait for the
+                # earliest retry (bounded — backoff_s caps at
+                # 2**max_attempts rounds) unless nothing is due ever
+                waits = [entry.get("retry_at", 0.0) - time.time()
+                         for entry in
+                         (queue.entries[k] for k in outstanding)
+                         if entry["state"] == "failed"]
+                if not waits:
+                    break
+                wait = max(0.0, min(waits))
+                if wait > 0:
+                    time.sleep(wait)
+        obs.event("runner_summary", process=pid, **queue.counts())
+        run_dir = rec.dir if rec is not None else None
+
+    if run_dir is not None:
+        write_shard(run_dir, paths["shards"], pid)
+    summary = _write_survey_manifest(
+        paths["survey"], pid, nproc, queue, plan,
+        extra={"checkpoint": paths["checkpoint"],
+               "obs_run": run_dir, "n_fit_attempts": n_fit})
+    queue.close()
+
+    if pid == 0 and merge:
+        if not simulated:
+            barrier("pptpu_runner_merge")
+        try:
+            merge_obs_shards(paths["shards"], paths["merged"])
+            summary["obs_merged"] = paths["merged"]
+        except FileNotFoundError:
+            pass
+        merged = _merge_survey_manifests(workdir,
+                                         paths["survey_merged"])
+        summary["merged_counts"] = merged["counts"]
+    return summary
+
+
+def survey_status(workdir):
+    """Aggregate {counts, quarantined, per-archive states} across every
+    ledger shard under ``workdir`` (the ``ppsurvey status`` payload)."""
+    counts = {}
+    quarantined = []
+    archives = {}
+    found = False
+    for name in sorted(os.listdir(workdir)):
+        if not (name.startswith("ledger.") and name.endswith(".jsonl")):
+            continue
+        found = True
+        q = WorkQueue(os.path.join(workdir, name), readonly=True)
+        try:
+            for k, v in q.counts().items():
+                counts[k] = counts.get(k, 0) + v
+            quarantined.extend(q.quarantined())
+            for k, recq in q.entries.items():
+                archives[k] = recq
+        finally:
+            q.close()
+    if not found:
+        raise FileNotFoundError(f"no ledger shards under {workdir}")
+    return {"counts": counts, "quarantined": quarantined,
+            "archives": archives}

@@ -1,0 +1,205 @@
+"""Persistent survey work queue: a crash-safe JSONL state ledger.
+
+One line is appended per state transition, so the ledger is crash-safe
+by construction (a torn tail line is dropped on replay) and the full
+history of every archive — attempts, failure reasons, timestamps — is
+preserved for the final survey report.  Replaying the file left to
+right reconstructs current state: the **last** record per archive
+wins.
+
+States::
+
+    pending -> running -> done
+                       -> failed (transient; bounded retries with
+                                  exponential backoff) -> pending
+                       -> quarantined (poison: corrupt file, model
+                                       mismatch, retries exhausted)
+
+``running`` entries found at load time are crash leftovers (the fit
+never completed) and are reverted to ``pending``, mirroring how the
+``.tim`` checkpoint drops unterminated archive blocks
+(pipelines/toas.py).  Quarantined archives are terminal: they are
+reported with their reason, never silently retried — one corrupt
+PSRFITS file must not be able to wedge a week-long run in a retry
+loop.
+"""
+
+import json
+import os
+import time
+
+__all__ = ["WorkQueue", "PENDING", "RUNNING", "DONE", "FAILED",
+           "QUARANTINED"]
+
+PENDING = "pending"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+QUARANTINED = "quarantined"
+
+_STATES = (PENDING, RUNNING, DONE, FAILED, QUARANTINED)
+
+
+class WorkQueue:
+    """On-disk per-archive state machine for one survey (one process).
+
+    Archives are keyed by ``os.path.realpath`` so resumed runs match
+    regardless of path spelling, exactly like the checkpoint resume in
+    pipelines/toas.py.  All writes are appends flushed per line.
+    """
+
+    def __init__(self, path, max_attempts=3, backoff_s=1.0,
+                 readonly=False):
+        self.path = path
+        self.max_attempts = int(max_attempts)
+        self.backoff_s = float(backoff_s)
+        self.readonly = bool(readonly)
+        self.entries = {}      # realpath -> latest record (dict)
+        self._order = []       # insertion order of first sighting
+        if os.path.isfile(path):
+            self._replay()
+        if self.readonly:
+            # inspection only (ppsurvey status): no appends, and no
+            # crash recovery — a live run may own the file
+            self._fh = None
+            return
+        self._fh = open(path, "a", encoding="utf-8")
+        self._recover()
+
+    # -- persistence ----------------------------------------------------
+
+    def _replay(self):
+        with open(self.path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail line from a crash
+                key = rec.get("archive")
+                if key is None or rec.get("state") not in _STATES:
+                    continue
+                if key not in self.entries:
+                    self._order.append(key)
+                self.entries[key] = rec
+
+    def _append(self, key, state, **fields):
+        if self._fh is None:
+            raise RuntimeError("WorkQueue opened readonly")
+        rec = {"t": round(time.time(), 6), "archive": key,
+               "state": state}
+        prev = self.entries.get(key)
+        rec["attempts"] = int(fields.pop("attempts",
+                                         (prev or {}).get("attempts", 0)))
+        rec.update(fields)
+        if key not in self.entries:
+            self._order.append(key)
+        self.entries[key] = rec
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+        return rec
+
+    def _recover(self):
+        """Crash recovery: running -> pending (the fit never finished)."""
+        for key, rec in list(self.entries.items()):
+            if rec["state"] == RUNNING:
+                self._append(key, PENDING, reason="recovered_from_crash")
+
+    def close(self):
+        if self._fh is None:
+            return
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+
+    # -- transitions ----------------------------------------------------
+
+    @staticmethod
+    def key_for(path):
+        return os.path.realpath(path)
+
+    def add(self, paths):
+        """Register archives as pending; known archives keep their
+        state (idempotent across resumes)."""
+        for path in paths:
+            key = self.key_for(path)
+            if key not in self.entries:
+                self._append(key, PENDING, path=path)
+
+    def claim(self, path):
+        return self._append(self.key_for(path), RUNNING)
+
+    def complete(self, path, **info):
+        return self._append(self.key_for(path), DONE, **info)
+
+    def fail(self, path, reason):
+        """Transient failure: retry with exponential backoff until
+        ``max_attempts``, then quarantine with the chain recorded."""
+        key = self.key_for(path)
+        attempts = self.entries.get(key, {}).get("attempts", 0) + 1
+        if attempts >= self.max_attempts:
+            return self._append(
+                key, QUARANTINED, attempts=attempts,
+                reason=f"retries exhausted ({attempts}): {reason}")
+        retry_at = time.time() + self.backoff_s * 2 ** (attempts - 1)
+        return self._append(key, FAILED, attempts=attempts,
+                            reason=str(reason),
+                            retry_at=round(retry_at, 6))
+
+    def quarantine(self, path, reason):
+        """Poison archive: terminal, with the reason on record."""
+        return self._append(self.key_for(path), QUARANTINED,
+                            reason=str(reason))
+
+    def reset(self, path, reason):
+        """Force an archive back to pending (ledger/checkpoint
+        reconciliation — see execute.py)."""
+        return self._append(self.key_for(path), PENDING,
+                            reason=str(reason))
+
+    # -- queries --------------------------------------------------------
+
+    def state(self, path):
+        rec = self.entries.get(self.key_for(path))
+        return rec["state"] if rec else None
+
+    def record(self, path):
+        return self.entries.get(self.key_for(path))
+
+    def ready(self, path, now=None):
+        """True when the archive should be (re)fit now: pending, or
+        failed with its backoff elapsed."""
+        rec = self.entries.get(self.key_for(path))
+        if rec is None:
+            return False
+        if rec["state"] == PENDING:
+            return True
+        if rec["state"] == FAILED:
+            now = time.time() if now is None else now
+            return now >= rec.get("retry_at", 0.0)
+        return False
+
+    def outstanding(self):
+        """Archives not yet done or quarantined (pending, failed
+        awaiting backoff, or running), in first-seen order."""
+        return [k for k in self._order
+                if self.entries[k]["state"] in (PENDING, RUNNING, FAILED)]
+
+    def done(self):
+        return {k for k in self._order
+                if self.entries[k]["state"] == DONE}
+
+    def quarantined(self):
+        """[(archive, reason)] for every quarantined archive."""
+        return [(k, self.entries[k].get("reason", ""))
+                for k in self._order
+                if self.entries[k]["state"] == QUARANTINED]
+
+    def counts(self):
+        out = {s: 0 for s in _STATES}
+        for rec in self.entries.values():
+            out[rec["state"]] += 1
+        return out

@@ -1,0 +1,69 @@
+"""J002 fixtures: survey-runner API misuse inside jit.
+
+The runner (pulseportraiture_tpu.runner) is host-side orchestration by
+contract — header scans, JSONL ledger appends, checkpoint rewrites and
+process partitioning are file IO with no meaning inside a trace; under
+jit each call would fire once at trace time and never again.  This
+corpus proves no runner host-side entry point is reachable inside a
+jit trace without the linter firing.  docs/RUNNER.md.
+"""
+
+import jax
+
+from pulseportraiture_tpu import runner
+from pulseportraiture_tpu.runner import plan_survey, run_survey
+
+
+@jax.jit
+def bad_plan_in_jit(x):
+    plan = runner.plan_survey(["a.fits"])  # EXPECT: J002
+    return x * plan.n_archives
+
+
+@jax.jit
+def bad_run_in_jit(x):
+    runner.run_survey("plan.json", "/tmp/wd")  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_bare_plan(x):
+    # the ``from ..runner import plan_survey`` idiom
+    plan_survey(["a.fits"])  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_bare_run(x):
+    run_survey("plan.json", "/tmp/wd")  # EXPECT: J002
+    return x + 1.0
+
+
+@jax.jit
+def bad_header_scan(x):
+    runner.scan_archive_header("a.fits")  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_queue_in_jit(x):
+    q = runner.WorkQueue("/tmp/ledger.jsonl")  # EXPECT: J002
+    return x + len(q.entries)
+
+
+@jax.jit
+def ok_suppressed(x):
+    runner.canonical_shape(3, 5)  # jaxlint: disable=J002
+    return x
+
+
+def ok_host_side(paths):
+    # outside jit: exactly how the CLI drives the runner
+    plan = plan_survey(paths)
+    return run_survey(plan, "/tmp/wd", modelfile="m.gmodel")
+
+
+@jax.jit
+def ok_unrelated_attr(x, runner_state):
+    # an array merely NAMED runner-ish must not trip the rule
+    return runner_state.sum() + x

@@ -318,6 +318,119 @@ def test_trace_capture_enabled_records_outcome(tmp_path, monkeypatch):
         assert path is not None and os.path.isdir(path)
 
 
+# -- sink rotation + explicit base_dir ---------------------------------
+
+def test_event_sink_rotation(tmp_path, monkeypatch):
+    """PPTPU_OBS_MAX_BYTES caps the live events file: overflow rotates
+    to events.jsonl.1, .2, ... and readers see one ordered stream."""
+    from tools import obs_report
+
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    monkeypatch.setenv("PPTPU_OBS_MAX_BYTES", "2000")
+    assert obs.obs_max_bytes() == 2000
+    with obs.run("rot") as rec:
+        for i in range(100):
+            obs.event("filler", i=i, pad="x" * 60)
+        run_dir = rec.dir
+    files = obs.list_event_files(run_dir)
+    assert len(files) > 2  # actually rotated
+    assert files[-1].endswith("events.jsonl")
+    assert [os.path.basename(f) for f in files[:-1]] == \
+        ["events.jsonl.%d" % (i + 1) for i in range(len(files) - 1)]
+    # every rotated file respects the cap (one event of slack)
+    for f in files[:-1]:
+        assert os.path.getsize(f) <= 2000 + 120
+    # the stream reads back complete and ordered across the set
+    idx = [e["i"] for e in obs_report.load_events(run_dir)
+           if e.get("name") == "filler"]
+    assert idx == list(range(100))
+    man = _manifest(run_dir)
+    assert man["n_events"] == 100  # counted across rotations
+
+
+def test_obs_max_bytes_unset_or_bad_means_no_rotation(tmp_path,
+                                                      monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    monkeypatch.setenv("PPTPU_OBS_MAX_BYTES", "not-a-number")
+    assert obs.obs_max_bytes() == 0
+    with obs.run("norot") as rec:
+        for i in range(50):
+            obs.event("filler", i=i, pad="x" * 60)
+        run_dir = rec.dir
+    assert len(obs.list_event_files(run_dir)) == 1
+
+
+def test_run_base_dir_opens_without_env(tmp_path, monkeypatch):
+    """obs.run(base_dir=...) records even with PPTPU_OBS_DIR unset —
+    the survey runner's and bench's explicit-output mode."""
+    monkeypatch.delenv("PPTPU_OBS_DIR", raising=False)
+    with obs.run("explicit", base_dir=str(tmp_path)) as rec:
+        assert rec is not None
+        obs.event("probe")
+        run_dir = rec.dir
+    assert run_dir.startswith(str(tmp_path))
+    assert any(e.get("name") == "probe" for e in _events(run_dir))
+    # ...and stays reentrant under an active run
+    with obs.run("outer", base_dir=str(tmp_path)) as outer:
+        with obs.run("inner", base_dir=str(tmp_path / "other")) as rec2:
+            assert rec2 is outer
+
+
+def test_result_payload_roundtrip(tmp_path, monkeypatch):
+    """bench/obs unification: the printed BENCH line is the run's
+    result event read back from disk (survives rotation)."""
+    from tools.obs_report import result_payload, summarize
+
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    monkeypatch.setenv("PPTPU_OBS_MAX_BYTES", "1500")
+    payload = {"metric": "fits/sec", "value": 12.5, "unit": "TOAs/sec",
+               "vs_baseline": 0.75, "extra": {"duration_sec": 8.0}}
+    with obs.run("bench-like") as rec:
+        for i in range(40):
+            obs.event("filler", i=i, pad="y" * 60)
+        obs.event("result", payload=payload)
+        run_dir = rec.dir
+    assert result_payload(run_dir) == payload
+    assert "## result" in summarize(run_dir)
+
+
+def test_merge_obs_shards_units(tmp_path, monkeypatch):
+    """Shard merge: p<proc>/ span prefixes, summed counters, ordered
+    events — including a rotated shard set."""
+    from pulseportraiture_tpu.obs.merge import (list_shards,
+                                                merge_obs_shards,
+                                                write_shard)
+
+    monkeypatch.delenv("PPTPU_OBS_DIR", raising=False)
+    monkeypatch.setenv("PPTPU_OBS_MAX_BYTES", "900")
+    shards = str(tmp_path / "shards")
+    for proc in (0, 1):
+        with obs.run("worker", base_dir=str(tmp_path / f"r{proc}"),
+                     config={"proc": proc}) as rec:
+            with obs.span("solve", batch=proc):
+                pass
+            for i in range(20):
+                obs.event("filler", i=i, pad="z" * 50)
+            obs.counter("fit_batches", 3)
+            run_dir = rec.dir
+        write_shard(run_dir, shards, proc)
+    assert set(list_shards(shards)) == {0, 1}
+    assert len(list_shards(shards)[0]) > 1  # rotation preserved
+
+    merged = str(tmp_path / "merged")
+    merge_obs_shards(shards, merged)
+    events = _events(merged)
+    spans = [e for e in events if e["kind"] == "span"]
+    assert {s["path"] for s in spans} == {"p0/solve", "p1/solve"}
+    assert all("proc" in e for e in events)
+    ts = [e["t"] for e in events]
+    assert ts == sorted(ts)
+    man = _manifest(merged)
+    assert man["n_processes"] == 2
+    assert man["counters"]["fit_batches"] == 6
+    assert man["config"]["proc"] in (0, 1)
+
+
 # -- report ------------------------------------------------------------
 
 def test_obs_report_summarizes_run(tmp_path, monkeypatch):

@@ -1,0 +1,432 @@
+"""Survey-runner execution tests (the ISSUE 3 acceptance scenarios).
+
+A synthetic 12-archive survey with 3 distinct shapes must compile at
+most one program set per shape bucket, survive a mid-run kill + resume
+without refitting done archives, quarantine poison archives with a
+recorded reason, and — simulated as 2 processes — produce one merged
+obs report from per-process shards.  Plus the checkpoint/ledger
+reconciliation contract: any disagreement refits, never silently
+skips.
+"""
+
+import json
+import os
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.fit import portrait as fp
+from pulseportraiture_tpu.io.archive import make_fake_pulsar
+from pulseportraiture_tpu.io.gmodel import write_model
+from pulseportraiture_tpu.runner.execute import (make_mesh_fitter,
+                                                 run_survey,
+                                                 survey_status)
+from pulseportraiture_tpu.runner.plan import plan_survey
+from pulseportraiture_tpu.runner.queue import WorkQueue
+
+MODEL_PARAMS = np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5])
+# 3 distinct shapes -> 2 canonical buckets: (8,64) and (16,128)
+SHAPES = [(8, 64), (6, 64), (12, 96)]
+
+
+def _ledger_states(workdir, proc=0):
+    with open(os.path.join(workdir, "ledger.%d.jsonl" % proc)) as fh:
+        return [json.loads(ln) for ln in fh if ln.strip()]
+
+
+def _toa_lines(ckpt):
+    return [ln for ln in open(ckpt)
+            if ln.split() and ln.split()[0] not in ("FORMAT", "C", "#")]
+
+
+@pytest.fixture(scope="module")
+def survey(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("runner_exec")
+    gm = str(tmp / "e.gmodel")
+    write_model(gm, "e", "000", 1500.0, MODEL_PARAMS, np.ones(8, int),
+                -4.0, 0, quiet=True)
+    par = str(tmp / "e.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    rng = np.random.default_rng(33)
+    files, phases = [], []
+    for i in range(12):
+        nchan, nbin = SHAPES[i % 3]
+        phase = float(rng.uniform(-0.2, 0.2))
+        out = str(tmp / f"e{i:02d}.fits")
+        # nsub alternates 2/3: both land in the same power-of-two batch
+        # bucket (fit/portrait.bucket_batch_size), so differing subint
+        # counts must not multiply programs either
+        make_fake_pulsar(gm, par, out, nsub=2 + (i % 2), nchan=nchan,
+                         nbin=nbin, nu0=1500.0, bw=400.0, tsub=60.0,
+                         phase=phase, dDM=float(rng.normal(0, 1e-3)),
+                         noise_stds=0.01, dedispersed=False,
+                         seed=200 + i, quiet=True)
+        files.append(out)
+        phases.append(phase)
+    plan = plan_survey(files, modelfile=gm)
+    return SimpleNamespace(tmp=tmp, gm=gm, par=par, files=files,
+                           phases=phases, plan=plan)
+
+
+def test_survey_compiles_one_program_set_per_bucket(survey, tmp_path):
+    """The acceptance scenario: 12 archives, 3 shapes, 2 buckets —
+    at most one batched-fit program per bucket, all TOAs produced."""
+    plan = survey.plan
+    assert len(plan.buckets) == 2
+    n_solver0 = fp._batch_impl._cache_size()
+    summary = run_survey(plan, str(tmp_path / "wd"), process_index=0,
+                         process_count=1, bary=False)
+    assert summary["counts"]["done"] == 12
+    assert summary["counts"]["quarantined"] == 0
+    # the jit-cache growth of the hot fit boundary is bounded by the
+    # bucket count — THE shape-bucketing claim (without padding this
+    # survey would compile 3 shapes x 2 nsubs = 6 programs)
+    n_new = fp._batch_impl._cache_size() - n_solver0
+    assert 1 <= n_new <= len(plan.buckets), n_new
+    # every subint produced a checkpointed TOA
+    n_toas = sum(2 + (i % 2) for i in range(12))
+    assert len(_toa_lines(summary["checkpoint"])) == n_toas
+    # survey manifest carries the full per-archive record
+    man = json.load(open(os.path.join(str(tmp_path / "wd"),
+                                      "survey.json")))
+    assert man["counts"]["done"] == 12
+    assert len(man["archives"]) == 12
+
+
+def test_padded_fit_matches_native(survey):
+    """Bucket padding (zero-weight channels + bandlimited nbin
+    resample) must not move the fitted phases/DMs beyond noise."""
+    from pulseportraiture_tpu.pipelines.toas import GetTOAs
+    from pulseportraiture_tpu.runner.execute import _BucketedGetTOAs
+
+    arch = survey.files[2]  # shape (12, 96) -> bucket (16, 128)
+    native = GetTOAs([arch], survey.gm, quiet=True)
+    native.get_TOAs(bary=False, quiet=True)
+    padded = _BucketedGetTOAs([arch], survey.gm, (16, 128), quiet=True)
+    padded.get_TOAs(bary=False, quiet=True)
+    assert len(padded.TOA_list) == len(native.TOA_list) == 2
+    p_nat, p_pad = np.asarray(native.phis[0]), np.asarray(padded.phis[0])
+    err = np.asarray(native.phi_errs[0])
+    dphi = np.abs(((p_pad - p_nat) + 0.5) % 1.0 - 0.5)
+    assert np.all(dphi < 5 * err), (dphi, err)
+    np.testing.assert_allclose(padded.DMs[0], native.DMs[0], atol=5e-4)
+    # red chi2 stays calibrated through the noise rescale
+    assert 0.3 < np.median(np.asarray(padded.red_chi2s[0])) < 3.0
+
+
+def test_incremental_run_resumes_without_refit(survey, tmp_path):
+    """max_archives bounds one call; the next call finishes the rest
+    and must NOT refit the already-done archives (ledger has exactly
+    one done record each)."""
+    wd = str(tmp_path / "wd")
+    plan = plan_survey(survey.files[:4], modelfile=survey.gm)
+    s1 = run_survey(plan, wd, process_index=0, process_count=1,
+                    bary=False, max_archives=1, merge=False)
+    assert s1["counts"]["done"] == 1 and s1["counts"]["pending"] == 3
+    s2 = run_survey(plan, wd, process_index=0, process_count=1,
+                    bary=False)
+    assert s2["counts"]["done"] == 4
+    states = _ledger_states(wd)
+    done_by_arch = {}
+    for rec in states:
+        if rec["state"] == "done":
+            done_by_arch[rec["archive"]] = \
+                done_by_arch.get(rec["archive"], 0) + 1
+    assert len(done_by_arch) == 4
+    assert all(n == 1 for n in done_by_arch.values()), done_by_arch
+    # checkpoint: one block per archive, no duplicates
+    assert len(_toa_lines(s2["checkpoint"])) == \
+        sum(2 + (i % 2) for i in range(4))
+
+
+def test_kill_mid_run_then_resume(survey, tmp_path, monkeypatch):
+    """A hard kill (KeyboardInterrupt mid-fit) leaves a running ledger
+    entry; the resume recovers it to pending and refits ONLY the
+    unfinished archives, with no checkpoint duplicates."""
+    from pulseportraiture_tpu.pipelines import toas as toas_mod
+
+    wd = str(tmp_path / "wd")
+    plan = plan_survey(survey.files[:3], modelfile=survey.gm)
+    real_fit = toas_mod.fit_portrait_full_batch
+    calls = {"n": 0}
+
+    def killed_fit(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise KeyboardInterrupt  # SIGINT lands mid-survey
+        return real_fit(*a, **k)
+
+    monkeypatch.setattr(toas_mod, "fit_portrait_full_batch", killed_fit)
+    with pytest.raises(KeyboardInterrupt):
+        run_survey(plan, wd, process_index=0, process_count=1,
+                   bary=False, merge=False)
+    monkeypatch.setattr(toas_mod, "fit_portrait_full_batch", real_fit)
+    # the killed archive is stranded 'running' in the ledger
+    states = {rec["archive"]: rec["state"]
+              for rec in _ledger_states(wd)}
+    assert "running" in states.values()
+
+    s2 = run_survey(plan, wd, process_index=0, process_count=1,
+                    bary=False)
+    assert s2["counts"]["done"] == 3
+    assert s2["counts"]["running"] == 0
+    # recovery happened through the recorded transition
+    reasons = [rec.get("reason") for rec in _ledger_states(wd)]
+    assert "recovered_from_crash" in reasons
+    # no duplicated TOA blocks: exactly nsub lines per archive
+    lines = _toa_lines(s2["checkpoint"])
+    per_arch = {}
+    for ln in lines:
+        per_arch[ln.split()[0]] = per_arch.get(ln.split()[0], 0) + 1
+    assert per_arch == {survey.files[i]: 2 + (i % 2) for i in range(3)}
+    # the done-before-the-kill archive was not refit
+    done_counts = {}
+    for rec in _ledger_states(wd):
+        if rec["state"] == "done":
+            done_counts[rec["archive"]] = \
+                done_counts.get(rec["archive"], 0) + 1
+    assert done_counts[WorkQueue.key_for(survey.files[0])] == 1
+
+
+def test_transient_device_error_retries_then_succeeds(survey, tmp_path,
+                                                      monkeypatch):
+    """A dead-tunnel JaxRuntimeError on one archive must retry in the
+    same run (backoff 0) and succeed — the attempt chain on record."""
+    import jax
+
+    from pulseportraiture_tpu.pipelines import toas as toas_mod
+
+    wd = str(tmp_path / "wd")
+    plan = plan_survey(survey.files[:2], modelfile=survey.gm)
+    real_fit = toas_mod.fit_portrait_full_batch
+    calls = {"n": 0}
+
+    def flaky_fit(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise jax.errors.JaxRuntimeError(
+                "UNAVAILABLE: remote_compile: Connection refused")
+        return real_fit(*a, **k)
+
+    monkeypatch.setattr(toas_mod, "fit_portrait_full_batch", flaky_fit)
+    summary = run_survey(plan, wd, process_index=0, process_count=1,
+                         bary=False, backoff_s=0.0, merge=False)
+    assert summary["counts"]["done"] == 2
+    assert summary["counts"]["failed"] == 0
+    rec = summary["archives"][WorkQueue.key_for(survey.files[0])]
+    assert rec["state"] == "done" and rec["attempts"] == 1
+    # the failure is on the ledger record with its reason
+    fails = [r for r in _ledger_states(wd) if r["state"] == "failed"]
+    assert len(fails) == 1
+    assert "Connection refused" in fails[0]["reason"]
+
+
+def test_corrupt_payload_quarantined_with_reason(survey, tmp_path):
+    """An archive whose headers scan clean but whose DATA payload is
+    truncated fails at load time: bounded retries, then quarantine with
+    the reason in the ledger AND the survey manifest."""
+    import shutil
+
+    wd = str(tmp_path / "wd")
+    bad = str(tmp_path / "bad_payload.fits")
+    shutil.copy(survey.files[0], bad)
+    with open(bad, "r+b") as f:
+        f.truncate(os.path.getsize(bad) - 2880)
+    plan = plan_survey([survey.files[1], bad], modelfile=survey.gm)
+    assert plan.n_archives == 2  # headers scan clean
+    summary = run_survey(plan, wd, process_index=0, process_count=1,
+                         bary=False, max_attempts=2, backoff_s=0.0)
+    assert summary["counts"]["done"] == 1
+    assert summary["counts"]["quarantined"] == 1
+    (q,) = summary["quarantined"]
+    assert q["archive"] == WorkQueue.key_for(bad)
+    assert "retries exhausted (2)" in q["reason"]
+    # merged survey manifest records it too
+    man = json.load(open(os.path.join(wd, "survey.json")))
+    assert man["quarantined"] == summary["quarantined"]
+
+
+def test_ledger_done_checkpoint_missing_refits(survey, tmp_path):
+    """Satellite: ledger says done, checkpoint lost the block -> the
+    TOAs are gone, so the archive must REFIT (not silently skip)."""
+    wd = str(tmp_path / "wd")
+    plan = plan_survey(survey.files[:1], modelfile=survey.gm)
+    s1 = run_survey(plan, wd, process_index=0, process_count=1,
+                    bary=False, merge=False)
+    assert s1["counts"]["done"] == 1
+    with open(s1["checkpoint"], "w"):
+        pass  # checkpoint wiped (disk mishap / manual edit)
+    s2 = run_survey(plan, wd, process_index=0, process_count=1,
+                    bary=False, merge=False)
+    assert s2["counts"]["done"] == 1
+    assert len(_toa_lines(s2["checkpoint"])) == 2  # re-appended
+    reasons = [rec.get("reason") for rec in _ledger_states(wd)]
+    assert "checkpoint_missing_block" in reasons
+    done = [rec for rec in _ledger_states(wd) if rec["state"] == "done"]
+    assert len(done) == 2  # original + the refit
+
+
+def test_checkpoint_present_ledger_pending_refits(survey, tmp_path):
+    """Satellite: checkpoint carries the block but the ledger does not
+    confirm it -> the block is half-trusted and must be dropped and
+    refit, with no duplicate TOAs."""
+    wd = str(tmp_path / "wd")
+    plan = plan_survey(survey.files[:2], modelfile=survey.gm)
+    s1 = run_survey(plan, wd, process_index=0, process_count=1,
+                    bary=False, merge=False)
+    assert s1["counts"]["done"] == 2
+    # ledger loses confidence in archive 0 (e.g. restored from backup)
+    q = WorkQueue(os.path.join(wd, "ledger.0.jsonl"))
+    q.reset(survey.files[0], "test_rollback")
+    q.close()
+    s2 = run_survey(plan, wd, process_index=0, process_count=1,
+                    bary=False, merge=False)
+    assert s2["counts"]["done"] == 2
+    lines = _toa_lines(s2["checkpoint"])
+    per_arch = {}
+    for ln in lines:
+        per_arch[ln.split()[0]] = per_arch.get(ln.split()[0], 0) + 1
+    # exactly one block each: dropped + refit, never duplicated
+    assert per_arch == {survey.files[0]: 2, survey.files[1]: 3}
+    done_counts = {}
+    for rec in _ledger_states(wd):
+        if rec["state"] == "done":
+            done_counts[rec["archive"]] = \
+                done_counts.get(rec["archive"], 0) + 1
+    assert done_counts[WorkQueue.key_for(survey.files[0])] == 2
+    assert done_counts[WorkQueue.key_for(survey.files[1])] == 1
+
+
+def test_two_process_run_merges_one_obs_report(survey, tmp_path):
+    """The acceptance scenario: a simulated 2-process run writes one
+    obs shard per process and process 0 merges them into a single run
+    + survey manifest."""
+    from tools.obs_report import summarize
+
+    wd = str(tmp_path / "wd")
+    s1 = run_survey(survey.plan, wd, process_index=1, process_count=2,
+                    bary=False, merge=False)
+    assert s1["counts"]["done"] == 6  # round-robin half
+    s0 = run_survey(survey.plan, wd, process_index=0, process_count=2,
+                    bary=False, merge=True)
+    assert s0["counts"]["done"] == 6
+    assert s0["merged_counts"]["done"] == 12
+
+    merged = s0["obs_merged"]
+    man = json.load(open(os.path.join(merged, "manifest.json")))
+    assert man["n_processes"] == 2
+    assert man["counters"]["fit_batches"] == 12  # summed across shards
+    events = [json.loads(ln)
+              for ln in open(os.path.join(merged, "events.jsonl"))]
+    span_paths = {e["path"] for e in events if e.get("kind") == "span"}
+    assert any(p.startswith("p0/") for p in span_paths)
+    assert any(p.startswith("p1/") for p in span_paths)
+    # events are globally time-ordered
+    ts = [e["t"] for e in events]
+    assert ts == sorted(ts)
+    # and the standard report renders the merged run
+    text = summarize(merged)
+    assert "| load " in text and "| solve " in text
+    assert "fit telemetry" in text and "subints: " in text
+
+    # aggregate status spans both ledger shards
+    status = survey_status(wd)
+    assert status["counts"]["done"] == 12
+
+
+def test_mesh_fitter_matches_unsharded():
+    """make_mesh_fitter (GSPMD bucket sharding) reproduces the
+    unsharded fit including the non-divisible-batch padding path."""
+    from pulseportraiture_tpu.ops.fourier import (get_bin_centers,
+                                                  rotate_data)
+    from pulseportraiture_tpu.ops.profiles import gen_gaussian_portrait
+    from pulseportraiture_tpu.parallel.mesh import make_mesh
+
+    B, nchan, nbin = 3, 16, 128  # B=3 pads to the 4-wide subint axis
+    freqs = np.linspace(1300.0, 1700.0, nchan)
+    model = np.asarray(gen_gaussian_portrait(
+        "000", np.array([0.0, 0.0, 0.35, -0.05, 0.05, 0.1, 1.0, -1.2]),
+        -4.0, np.asarray(get_bin_centers(nbin)), freqs, 1500.0))
+    rng = np.random.default_rng(7)
+    P0 = 0.005
+    phis = rng.uniform(-0.1, 0.1, B)
+    data = np.stack([
+        np.asarray(rotate_data(model, -phis[i], 0.0, P0, freqs,
+                               freqs.mean()))
+        for i in range(B)]) + rng.normal(0, 0.005, (B, nchan, nbin))
+    init = np.zeros((B, 5))
+    init[:, 0] = phis
+    errs = np.full((B, nchan), 0.005)
+
+    ref = fp.fit_portrait_full_batch(
+        data, model[None], init, P0, freqs, errs=errs,
+        fit_flags=(1, 1, 0, 0, 0), log10_tau=False)
+    fitter = make_mesh_fitter(make_mesh(n_subint=4, n_chan=2))
+    out = fitter(data, model[None], init, P0, freqs, errs=errs,
+                 fit_flags=(1, 1, 0, 0, 0), log10_tau=False,
+                 scan_size=64, pad_to=8)  # both must be ignored
+    assert np.asarray(out.phi).shape == (B,)
+    np.testing.assert_allclose(np.asarray(out.phi),
+                               np.asarray(ref.phi), atol=1e-8)
+    np.testing.assert_allclose(np.asarray(out.DM),
+                               np.asarray(ref.DM), atol=1e-8)
+    np.testing.assert_allclose(np.asarray(out.snr),
+                               np.asarray(ref.snr), rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_survey_with_mesh_sharding(survey, tmp_path):
+    """run_survey(use_mesh=True) wires make_mesh_fitter through the
+    GetTOAs.fit_batch hook and reproduces the unsharded survey."""
+    from pulseportraiture_tpu.parallel.mesh import make_mesh
+
+    plan = plan_survey(survey.files[:2], modelfile=survey.gm)
+    wd_ref = str(tmp_path / "ref")
+    ref = run_survey(plan, wd_ref, process_index=0, process_count=1,
+                     bary=False, merge=False)
+    wd_mesh = str(tmp_path / "mesh")
+    out = run_survey(plan, wd_mesh, process_index=0, process_count=1,
+                     bary=False, merge=False, use_mesh=True,
+                     mesh=make_mesh(n_subint=4, n_chan=2))
+    assert out["counts"]["done"] == ref["counts"]["done"] == 2
+
+    def toa_cols(ckpt):
+        # (archive, freq, mjd) triplets parsed from the .tim lines
+        return [(t[0], float(t[1]), float(t[2]))
+                for t in (ln.split() for ln in _toa_lines(ckpt))]
+
+    got, want = toa_cols(out["checkpoint"]), toa_cols(ref["checkpoint"])
+    assert len(got) == len(want)
+    for (a1, f1, m1), (a2, f2, m2) in zip(got, want):
+        assert a1 == a2
+        assert f1 == pytest.approx(f2, abs=1e-6)
+        assert m1 == pytest.approx(m2, abs=1e-11)  # ~us on an MJD
+
+
+def test_ppsurvey_cli_roundtrip(survey, tmp_path, capsys):
+    """plan -> run -> status -> report through the CLI entry point."""
+    from pulseportraiture_tpu.cli.ppsurvey import main
+
+    wd = str(tmp_path / "wd")
+    meta = str(tmp_path / "cli.meta")
+    with open(meta, "w") as f:
+        f.write("\n".join(survey.files[:2]) + "\n")
+    assert main(["plan", "-d", meta, "-m", survey.gm, "-w", wd]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["n_archives"] == 2
+
+    assert main(["run", "-w", wd, "--process", "0", "--processes", "1",
+                 "--no_bary", "--quiet", "--backoff", "0"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["counts"]["done"] == 2
+
+    assert main(["status", "-w", wd]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["counts"]["done"] == 2
+
+    assert main(["report", "-w", wd]) == 0
+    text = capsys.readouterr().out
+    assert "## phases" in text and "## survey state" in text

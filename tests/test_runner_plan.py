@@ -1,0 +1,184 @@
+"""Survey planner tests: header-only scans, shape buckets, padding.
+
+docs/RUNNER.md contract: shapes come from FITS headers alone (no DATA
+decode), archives group into canonical power-of-two buckets, and
+padding an archive to its bucket changes neither its live channels nor
+its phases (zero-weight nchan pad, bandlimited nbin resample).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.io.archive import load_data, make_fake_pulsar
+from pulseportraiture_tpu.io.gmodel import write_model
+from pulseportraiture_tpu.runner.plan import (MIN_NBIN, MIN_NCHAN,
+                                              SurveyPlan, canonical_shape,
+                                              pad_databunch, plan_survey,
+                                              scan_archive_header)
+
+MODEL_PARAMS = np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5])
+
+
+@pytest.fixture(scope="module")
+def source(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("runner_plan")
+    gm = str(tmp / "p.gmodel")
+    write_model(gm, "p", "000", 1500.0, MODEL_PARAMS, np.ones(8, int),
+                -4.0, 0, quiet=True)
+    par = str(tmp / "p.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    return tmp, gm, par
+
+
+def test_canonical_shape_pow2_grid():
+    assert canonical_shape(8, 64) == (8, 64)
+    assert canonical_shape(9, 65) == (16, 128)
+    assert canonical_shape(12, 96) == (16, 128)
+    # floors: tiny archives share the smallest bucket
+    assert canonical_shape(2, 16) == (MIN_NCHAN, MIN_NBIN)
+    assert canonical_shape(512, 2048) == (512, 2048)
+
+
+def test_scan_header_matches_load_data(source):
+    tmp, gm, par = source
+    fits = str(tmp / "scan.fits")
+    make_fake_pulsar(gm, par, fits, nsub=3, nchan=12, nbin=96,
+                     nu0=1500.0, bw=400.0, tsub=60.0, noise_stds=0.01,
+                     dedispersed=False, seed=5, quiet=True)
+    info = scan_archive_header(fits)
+    d = load_data(fits, quiet=True)
+    assert (info.nsub, info.npol, info.nchan, info.nbin) == \
+        (d.nsub, d.npol, d.nchan, d.nbin)
+    assert info.source == d.source
+    assert info.bucket == (16, 128)
+
+
+def test_scan_header_reads_headers_only(source, tmp_path):
+    """Corrupting the DATA payload must not break the header scan —
+    the whole point of planning a thousand archives cheaply."""
+    tmp, gm, par = source
+    fits = str(tmp_path / "tail.fits")
+    make_fake_pulsar(gm, par, fits, nsub=2, nchan=8, nbin=64,
+                     nu0=1500.0, bw=400.0, tsub=60.0, noise_stds=0.01,
+                     dedispersed=False, seed=6, quiet=True)
+    size = os.path.getsize(fits)
+    with open(fits, "r+b") as f:
+        f.truncate(size - 2880)  # amputate the tail of the SUBINT data
+    info = scan_archive_header(fits)
+    assert (info.nchan, info.nbin) == (8, 64)
+    # ...but actually loading it fails (test_runner_execute covers the
+    # quarantine path this produces)
+    with pytest.raises((ValueError, RuntimeError, OSError)):
+        load_data(fits, quiet=True)
+
+
+def test_scan_header_rejects_non_archives(tmp_path):
+    garbage = str(tmp_path / "garbage.fits")
+    with open(garbage, "wb") as f:
+        f.write(b"\x00\x01\x02" * 100)
+    with pytest.raises(ValueError, match="not a FITS file"):
+        scan_archive_header(garbage)
+    truncated = str(tmp_path / "trunc.fits")
+    with open(truncated, "wb") as f:
+        f.write(b"SIMPLE  =                    T")
+    with pytest.raises(ValueError, match="truncated"):
+        scan_archive_header(truncated)
+
+
+def test_plan_survey_buckets_and_unreadable(source, tmp_path):
+    tmp, gm, par = source
+    files = []
+    for i, (nchan, nbin) in enumerate([(8, 64), (6, 64), (12, 96)]):
+        fits = str(tmp_path / f"s{i}.fits")
+        make_fake_pulsar(gm, par, fits, nsub=2, nchan=nchan, nbin=nbin,
+                         nu0=1500.0, bw=400.0, tsub=60.0,
+                         noise_stds=0.01, dedispersed=False,
+                         seed=10 + i, quiet=True)
+        files.append(fits)
+    bad = str(tmp_path / "bad.fits")
+    with open(bad, "wb") as f:
+        f.write(b"not fits at all")
+    meta = str(tmp_path / "s.meta")
+    with open(meta, "w") as f:
+        f.write("\n".join(files + [bad]) + "\n")
+
+    plan = plan_survey(meta, modelfile=gm)
+    # (8,64) and (6,64) share the (8,64) bucket; (12,96) pads to (16,128)
+    assert {b.key: len(b.archives) for b in plan.buckets} == \
+        {(8, 64): 2, (16, 128): 1}
+    assert plan.n_archives == 3
+    assert [p for p, _ in plan.unreadable] == [bad]
+    assert "FITS" in plan.unreadable[0][1]
+
+    # round-trips through plan.json with order preserved
+    path = str(tmp_path / "plan.json")
+    plan.save(path)
+    plan2 = SurveyPlan.load(path)
+    assert [i.path for i, _ in plan2.archives()] == \
+        [i.path for i, _ in plan.archives()]
+    assert plan2.modelfile == gm
+    assert plan2.unreadable == plan.unreadable
+
+
+def test_pad_databunch_preserves_live_signal(source, tmp_path):
+    tmp, gm, par = source
+    fits = str(tmp_path / "pad.fits")
+    make_fake_pulsar(gm, par, fits, nsub=2, nchan=6, nbin=96,
+                     nu0=1500.0, bw=300.0, tsub=60.0, noise_stds=0.01,
+                     dedispersed=True, seed=21, quiet=True)
+    native = load_data(fits, quiet=True)
+    padded = pad_databunch(load_data(fits, quiet=True), 8, 128)
+
+    assert padded.subints.shape == (2, 1, 8, 128)
+    assert padded.nchan == 8 and padded.nbin == 128
+    assert padded.nchan_native == 6 and padded.nbin_native == 96
+    # padded channels are dead weight, native ones untouched
+    np.testing.assert_array_equal(padded.weights[:, 6:], 0.0)
+    np.testing.assert_array_equal(padded.weights[:, :6],
+                                  native.weights)
+    np.testing.assert_array_equal(padded.SNRs[:, :, 6:], 0.0)
+    assert padded.masks.shape == (2, 1, 8, 128)
+    np.testing.assert_array_equal(padded.masks[:, :, 6:], 0.0)
+    # frequency grid extends on the native spacing
+    step = native.freqs[0, 1] - native.freqs[0, 0]
+    np.testing.assert_allclose(np.diff(padded.freqs[0]), step)
+    # per-channel bandwidth is preserved through the bw rescale
+    assert padded.bw / padded.nchan == pytest.approx(
+        native.bw / native.nchan)
+    # the nbin resample is bandlimited: harmonic content is identical
+    # up to the bin-center re-alignment ramp (samples live at
+    # (k+0.5)/nbin, so the new grid's centers sit 0.5/96 - 0.5/128
+    # rotations earlier)
+    native_ft = np.fft.rfft(native.subints[0, 0, 0])
+    padded_ft = np.fft.rfft(padded.subints[0, 0, 0])[:native_ft.size]
+    k = np.arange(native_ft.size)
+    ramp = np.exp(-2j * np.pi * k * (0.5 / 96 - 0.5 / 128))
+    # (rfft scale follows nbin; compare amplitude-normalized spectra;
+    # an even-nbin Nyquist bin splits on resample, so drop it)
+    np.testing.assert_allclose(padded_ft[:-1] / 128,
+                               (native_ft * ramp)[:-1] / 96, atol=1e-12)
+    # noise rescaled to keep the harmonic-domain level
+    np.testing.assert_allclose(
+        padded.noise_stds[:, :, :6],
+        native.noise_stds * np.sqrt(96.0 / 128.0))
+    # median-noise padding keeps the channel-median unbiased
+    med = np.median(padded.noise_stds[0, 0, :6])
+    np.testing.assert_allclose(padded.noise_stds[0, 0, 6:], med)
+    # idempotent at canonical shape
+    again = pad_databunch(padded, 8, 128)
+    assert again is padded
+
+
+def test_pad_databunch_refuses_to_shrink(source, tmp_path):
+    tmp, gm, par = source
+    fits = str(tmp_path / "shrink.fits")
+    make_fake_pulsar(gm, par, fits, nsub=1, nchan=8, nbin=64,
+                     nu0=1500.0, bw=400.0, tsub=60.0, noise_stds=0.01,
+                     dedispersed=True, seed=22, quiet=True)
+    d = load_data(fits, quiet=True)
+    with pytest.raises(ValueError, match="shrink"):
+        pad_databunch(d, 4, 64)

@@ -1,0 +1,118 @@
+"""Work-queue ledger tests: state machine, crash safety, retries.
+
+docs/RUNNER.md contract: the JSONL ledger replays to current state
+(last record per archive wins), ``running`` entries recover to
+``pending`` on reopen, transient failures retry with backoff until
+``max_attempts`` then quarantine with the chain recorded, and a torn
+tail line from a kill is dropped — never a crash.
+"""
+
+import json
+import os
+
+from pulseportraiture_tpu.runner.queue import (DONE, FAILED, PENDING,
+                                               QUARANTINED, WorkQueue)
+
+
+def _q(tmp_path, **kw):
+    return WorkQueue(str(tmp_path / "ledger.jsonl"), **kw)
+
+
+def test_lifecycle_and_replay(tmp_path):
+    q = _q(tmp_path)
+    q.add(["a.fits", "b.fits"])
+    assert q.state("a.fits") == PENDING
+    q.claim("a.fits")
+    q.complete("a.fits", n_toas=4)
+    q.quarantine("b.fits", "corrupt header")
+    assert q.counts() == {PENDING: 0, "running": 0, DONE: 1, FAILED: 0,
+                          QUARANTINED: 1}
+    q.close()
+
+    # a fresh instance replays the same state from disk
+    q2 = _q(tmp_path)
+    assert q2.state("a.fits") == DONE
+    assert q2.record("a.fits")["n_toas"] == 4
+    assert q2.quarantined() == [(q2.key_for("b.fits"),
+                                 "corrupt header")]
+    # add() is idempotent: known archives keep their state
+    q2.add(["a.fits", "b.fits"])
+    assert q2.state("a.fits") == DONE
+    q2.close()
+
+
+def test_running_recovers_to_pending(tmp_path):
+    q = _q(tmp_path)
+    q.add(["a.fits"])
+    q.claim("a.fits")
+    q.close()  # killed mid-fit
+
+    q2 = _q(tmp_path)
+    assert q2.state("a.fits") == PENDING
+    assert q2.record("a.fits")["reason"] == "recovered_from_crash"
+    assert q2.outstanding() == [q2.key_for("a.fits")]
+    q2.close()
+
+
+def test_retries_backoff_then_quarantine(tmp_path):
+    q = _q(tmp_path, max_attempts=3, backoff_s=30.0)
+    q.add(["a.fits"])
+    rec = q.fail("a.fits", "tunnel down")
+    assert rec["state"] == FAILED and rec["attempts"] == 1
+    assert not q.ready("a.fits")  # backing off
+    assert q.ready("a.fits", now=rec["retry_at"] + 1)
+    rec2 = q.fail("a.fits", "tunnel down")
+    assert rec2["attempts"] == 2
+    # exponential: second wait is double the first
+    assert rec2["retry_at"] - rec["retry_at"] > 25.0
+    rec3 = q.fail("a.fits", "tunnel down")
+    assert rec3["state"] == QUARANTINED
+    assert "retries exhausted (3)" in rec3["reason"]
+    assert "tunnel down" in rec3["reason"]
+    assert not q.ready("a.fits", now=1e18)  # terminal
+    assert q.outstanding() == []
+    q.close()
+
+
+def test_torn_tail_line_dropped(tmp_path):
+    q = _q(tmp_path)
+    q.add(["a.fits", "b.fits"])
+    q.complete("a.fits")
+    q.close()
+    path = str(tmp_path / "ledger.jsonl")
+    with open(path, "a") as f:
+        f.write('{"t": 1.0, "archive": "b.fits", "sta')  # kill mid-write
+    q2 = _q(tmp_path)
+    assert q2.state("a.fits") == DONE
+    assert q2.state("b.fits") == PENDING  # torn record ignored
+    q2.close()
+
+
+def test_readonly_does_not_mutate(tmp_path):
+    q = _q(tmp_path)
+    q.add(["a.fits"])
+    q.claim("a.fits")  # leave a live 'running' entry
+    q.close()
+    size = os.path.getsize(str(tmp_path / "ledger.jsonl"))
+    ro = _q(tmp_path, readonly=True)
+    # no crash recovery, no appends: a live run may own the file
+    assert ro.state("a.fits") == "running"
+    assert os.path.getsize(str(tmp_path / "ledger.jsonl")) == size
+    ro.close()
+
+
+def test_ledger_is_full_history(tmp_path):
+    """Every transition is one appended line — the final report can
+    reconstruct the attempt chain."""
+    q = _q(tmp_path, max_attempts=5, backoff_s=0.0)
+    q.add(["a.fits"])
+    q.claim("a.fits")
+    q.fail("a.fits", "x")
+    q.claim("a.fits")
+    q.complete("a.fits")
+    q.close()
+    lines = [json.loads(ln) for ln in
+             open(str(tmp_path / "ledger.jsonl"))]
+    assert [ln["state"] for ln in lines] == \
+        [PENDING, "running", FAILED, "running", DONE]
+    assert lines[-1]["attempts"] == 1  # attempt count carries through

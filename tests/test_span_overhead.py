@@ -1,0 +1,73 @@
+"""Span/telemetry overhead budget (ROADMAP item, tools/span_overhead).
+
+The obs layer's contract: disabled primitives are ~free (the tier-1
+<2% guard), and even enabled they are orders below one archive's fit
+wall at the pipeline's call rate.  The slow-marked test prices the
+budget against a real reference fit; the fast test pins the probe's
+schema so ``python -m tools.span_overhead`` stays a valid one-line
+JSON source.
+"""
+
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.span_overhead import (BUDGET_FRACTION, CALLS_PER_ARCHIVE,
+                                 measure)  # noqa: E402
+
+
+def test_probe_schema_and_sanity():
+    out = measure(n=200)
+    for name in ("span", "phases", "event", "fit_telemetry"):
+        assert out["%s_off_s" % name] > 0.0
+        assert out["%s_on_s" % name] > 0.0
+    assert out["archive_off_s"] == pytest.approx(
+        CALLS_PER_ARCHIVE * out["span_off_s"])
+    # disabled primitives are nanosecond-scale dict lookups; even a
+    # very loaded CI box keeps them under 50 us/call
+    assert out["span_off_s"] < 50e-6
+    assert out["fit_telemetry_off_s"] < 50e-6
+
+
+@pytest.mark.slow
+def test_disabled_overhead_within_budget():
+    """The <2% budget, asserted directly: one archive's obs call rate
+    (5 phase spans + 1 event + 1 fit-telemetry call) with obs OFF must
+    cost under 2% of that archive's batched fit."""
+    import jax
+
+    from pulseportraiture_tpu.fit import portrait as fp
+
+    rng = np.random.default_rng(3)
+    B, nchan, nbin = 4, 16, 256
+    phases = (np.arange(nbin) + 0.5) / nbin
+    prof = np.exp(-0.5 * ((phases - 0.5) / 0.02) ** 2)
+    model = np.broadcast_to(prof, (nchan, nbin)).copy()
+    data = model[None] * rng.uniform(0.9, 1.1, (B, nchan, 1)) \
+        + rng.normal(0.0, 0.01, (B, nchan, nbin))
+    freqs = np.linspace(1300.0, 1700.0, nchan)
+    errs = np.full((B, nchan), 0.01)
+
+    def fit():
+        out = fp.fit_portrait_full_batch(
+            data, model, None, 0.004, freqs, errs=errs, max_iter=25)
+        jax.block_until_ready(out.params)
+
+    fit()  # compile outside the timed region
+    t0 = time.perf_counter()
+    fit()
+    fit_wall = (time.perf_counter() - t0)
+
+    out = measure(n=1000)
+    assert out["archive_off_s"] < BUDGET_FRACTION * fit_wall, \
+        (out["archive_off_s"], fit_wall)
+    # enabled telemetry writes JSON lines; still far below one fit
+    assert out["archive_on_s"] < fit_wall, (out["archive_on_s"],
+                                            fit_wall)

@@ -6,7 +6,10 @@
 #                 configuration lives in pyproject.toml [tool.ruff])
 #   3. obs smoke — tiny synthetic pptoas run must emit a valid
 #                 manifest + event stream (docs/OBSERVABILITY.md)
-#   4. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
+#   4. runner smoke — tiny synthetic survey through the shape-bucketed
+#                 runner: 2 done + 1 quarantined + merged obs run
+#                 (docs/RUNNER.md)
+#   5. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
 #
 # Exit status is non-zero when any stage fails.
 set -u
@@ -34,6 +37,17 @@ if [ $? -ne 0 ]; then
     fail=1
 else
     tail -1 /tmp/_obs_smoke.log
+fi
+
+echo
+echo "== runner smoke (shape-bucketed survey, docs/RUNNER.md) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu PPTPU_OBS_DIR="" \
+    python -m tools.runner_smoke >/tmp/_runner_smoke.log 2>&1
+if [ $? -ne 0 ]; then
+    tail -40 /tmp/_runner_smoke.log
+    fail=1
+else
+    tail -1 /tmp/_runner_smoke.log
 fi
 
 echo

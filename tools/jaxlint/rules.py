@@ -44,6 +44,15 @@ _OBS_API_NAMES = {"span", "phases", "event", "counter", "gauge",
                   "trace_capture"}
 _OBS_BARE_CALLS = {"fit_telemetry", "trace_capture"}
 
+# survey-runner API (pulseportraiture_tpu.runner): host-side
+# orchestration by contract — file IO (header scans, JSONL ledger
+# appends, checkpoint rewrites) and process partitioning have no
+# meaning inside a trace.  Matched as ``runner.<name>`` or the bare
+# imported entry points.
+_RUNNER_API_NAMES = {"plan_survey", "run_survey", "scan_archive_header",
+                     "pad_databunch", "canonical_shape", "survey_status",
+                     "merge_obs_shards", "WorkQueue"}
+
 _JNP_PREFIXES = ("jnp.", "jax.numpy.")
 
 
@@ -342,6 +351,17 @@ class RuleVisitor(ast.NodeVisitor):
                           "once, at trace time) and fit telemetry "
                           "would sync a traced value; move it after "
                           "the jit boundary (docs/OBSERVABILITY.md)")
+            elif fname is not None and (
+                    (fname.startswith("runner.")
+                     and fname.split(".", 1)[1] in _RUNNER_API_NAMES)
+                    or fname in _RUNNER_API_NAMES):
+                self._add("J002", node,
+                          "survey-runner call inside a jitted function "
+                          "— the runner is host-side orchestration "
+                          "(header scans, ledger appends, checkpoint "
+                          "rewrites); under jit it would run once at "
+                          "trace time and its file IO is unreachable "
+                          "from compiled code (docs/RUNNER.md)")
             elif fname is not None and "." in fname:
                 head, attr = fname.rsplit(".", 1)
                 if attr in _HOST_SYNC_METHODS and \

@@ -43,16 +43,13 @@ def find_run_dir(path=None):
     return max(runs, key=os.path.getmtime)
 
 
-def load_run(run_dir):
-    """(manifest dict, list of event dicts) for one run directory."""
-    manifest = {}
-    mpath = os.path.join(run_dir, "manifest.json")
-    if os.path.isfile(mpath):
-        with open(mpath, encoding="utf-8") as fh:
-            manifest = json.load(fh)
+def load_events(run_dir):
+    """All events of a run, oldest first, spanning the rotated set
+    (``events.jsonl.1``, ...) a PPTPU_OBS_MAX_BYTES cap produces."""
+    from pulseportraiture_tpu.obs import list_event_files
+
     events = []
-    epath = os.path.join(run_dir, "events.jsonl")
-    if os.path.isfile(epath):
+    for epath in list_event_files(run_dir):
         with open(epath, encoding="utf-8") as fh:
             for line in fh:
                 line = line.strip()
@@ -62,7 +59,32 @@ def load_run(run_dir):
                     events.append(json.loads(line))
                 except json.JSONDecodeError:
                     pass  # a torn tail line from a crashed run
-    return manifest, events
+    return events
+
+
+def result_payload(run_dir):
+    """The LAST ``result`` event's payload of a run, or None.
+
+    bench.py prints its one-line BENCH JSON from this — the committed
+    driver line and the obs run can never disagree because they are
+    the same bytes (ROADMAP bench/obs unification).
+    """
+    payload = None
+    for e in load_events(run_dir):
+        if e.get("kind") == "event" and e.get("name") == "result" \
+                and isinstance(e.get("payload"), dict):
+            payload = e["payload"]
+    return payload
+
+
+def load_run(run_dir):
+    """(manifest dict, list of event dicts) for one run directory."""
+    manifest = {}
+    mpath = os.path.join(run_dir, "manifest.json")
+    if os.path.isfile(mpath):
+        with open(mpath, encoding="utf-8") as fh:
+            manifest = json.load(fh)
+    return manifest, load_events(run_dir)
 
 
 def _fmt_s(x):
@@ -174,8 +196,8 @@ def summarize(run_dir):
                                                  os.path.basename(
                                                      run_dir.rstrip("/"))))
     head = []
-    for key in ("name", "platform", "device_count", "jax_version",
-                "git_sha", "wall_s", "compile_total_s"):
+    for key in ("name", "platform", "device_count", "n_processes",
+                "jax_version", "git_sha", "wall_s", "compile_total_s"):
         if manifest.get(key) is not None:
             head.append("%s: %s" % (key, manifest[key]))
     if manifest.get("backend_error"):
@@ -209,6 +231,13 @@ def summarize(run_dir):
             out.append("- %s (gauge): %s" % (k, v))
         for k, v in sorted(caches.items()):
             out.append("- %s (jit cache size): %s" % (k, v))
+    results = [e["payload"] for e in events
+               if e.get("kind") == "event" and e.get("name") == "result"
+               and isinstance(e.get("payload"), dict)]
+    if results:
+        out.append("")
+        out.append("## result")
+        out.append(json.dumps(results[-1]))
     n_traces = sum(1 for e in events if e.get("kind") == "event"
                    and e.get("name") == "trace")
     if n_traces:

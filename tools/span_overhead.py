@@ -1,0 +1,99 @@
+"""Obs span/telemetry overhead micro-benchmark (ROADMAP budget item).
+
+The tier-1 contract says observability must be ~free when disabled and
+< 2% of pipeline wall when enabled at the pipeline's call rate (a
+handful of spans + one fit-telemetry call per archive).  That budget
+used to be asserted only indirectly; this probe prices the primitives
+directly:
+
+    python -m tools.span_overhead          # one JSON line
+
+and ``tests/test_span_overhead.py`` (slow-marked) asserts the budget
+against a real reference fit.  ``measure()`` is importable so the test
+and the CLI report the same numbers.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+# per-archive obs call rate of the GetTOAs pipeline: 5 phase spans +
+# 1 archive event + 1 fit-telemetry call (docs/OBSERVABILITY.md)
+CALLS_PER_ARCHIVE = 7
+BUDGET_FRACTION = 0.02
+
+
+def _time_per_call(fn, n):
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return (time.perf_counter() - t0) / n
+
+
+def measure(n=2000):
+    """Per-call costs [s] of one span, one phases-cycle, one event and
+    one fit-telemetry call, with obs disabled and enabled."""
+    from pulseportraiture_tpu import obs
+
+    fit_result = {"nfeval": np.full(8, 12),
+                  "red_chi2": np.ones(8),
+                  "return_code": np.zeros(8, int)}
+
+    def one_span():
+        with obs.span("solve", batch=8):
+            pass
+
+    def one_phases():
+        ph = obs.phases(archive="x.fits")
+        ph.enter("load")
+        ph.enter("solve")
+        ph.done()
+
+    def one_event():
+        obs.event("archive", nsub=8, nchan=64, nbin=256)
+
+    def one_fit_telemetry():
+        obs.fit_telemetry(dict(fit_result), where="probe")
+
+    probes = {"span": one_span, "phases": one_phases,
+              "event": one_event, "fit_telemetry": one_fit_telemetry}
+
+    out = {}
+    saved = os.environ.pop("PPTPU_OBS_DIR", None)
+    try:
+        assert obs.current() is None, \
+            "span_overhead must run outside any obs run"
+        for name, fn in probes.items():
+            out["%s_off_s" % name] = _time_per_call(fn, n)
+        tmp = tempfile.mkdtemp(prefix="pptpu_span_overhead_")
+        try:
+            with obs.run("span-overhead", base_dir=tmp):
+                for name, fn in probes.items():
+                    out["%s_on_s" % name] = _time_per_call(fn, n)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    finally:
+        if saved is not None:
+            os.environ["PPTPU_OBS_DIR"] = saved
+    out["n"] = n
+    out["archive_off_s"] = CALLS_PER_ARCHIVE * out["span_off_s"]
+    out["archive_on_s"] = (
+        5 * out["span_on_s"] + out["event_on_s"]
+        + out["fit_telemetry_on_s"])
+    return out
+
+
+def main():
+    out = measure()
+    print(json.dumps({k: (round(v, 9) if isinstance(v, float) else v)
+                      for k, v in out.items()}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
