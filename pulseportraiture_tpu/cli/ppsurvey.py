@@ -62,6 +62,13 @@ def build_parser():
                             "device mesh.")
         r.add_argument("--no_merge", action="store_false", dest="merge",
                        help="Skip the process-0 obs-shard merge.")
+        r.add_argument("--trace-bucket", action="store_true",
+                       dest="trace_bucket",
+                       help="Capture one jax.profiler trace per shape "
+                            "bucket (into $PPTPU_TRACE_DIR or "
+                            "<workdir>/traces) and ingest it into the "
+                            "obs run's devtime events + device-"
+                            "utilization gauges (docs/RUNNER.md).")
         r.add_argument("--tscrunch", "-T", action="store_true")
         r.add_argument("--fit_scat", action="store_true")
         r.add_argument("--no_bary", dest="bary", action="store_false")
@@ -111,7 +118,8 @@ def _cmd_run(args):
         process_count=args.processes, max_attempts=args.max_attempts,
         backoff_s=args.backoff, use_mesh=args.use_mesh,
         merge=args.merge, max_archives=args.max_archives,
-        quiet=args.quiet, tscrunch=args.tscrunch, bary=args.bary,
+        trace_bucket=args.trace_bucket, quiet=args.quiet,
+        tscrunch=args.tscrunch, bary=args.bary,
         fit_scat=args.fit_scat)
     print(json.dumps({"counts": summary["counts"],
                       "quarantined": summary["quarantined"],

@@ -196,10 +196,13 @@ def _moments(params, cross, abs_m2, inv_err2, freqs, P, nu_DM, nu_GM,
         # per-channel real multipliers (taus_d, taus_2d) times shared
         # harmonic reductions — same math as the complex branch below.
         cp, sp = jnp.cos(ang), jnp.sin(ang)
-        taus = scattering_times(tau, alpha, freqs, nu_tau)
-        x = tpk[None, :] * taus[:, None]
-        den = 1.0 + x * x
-        br, bi = 1.0 / den, -x / den
+        # pp_scatter: device-time attribution scope for the real-pair
+        # scattering kernel (obs/devtime.py; mirrors ops/scattering.py)
+        with jax.named_scope("pp_scatter"):
+            taus = scattering_times(tau, alpha, freqs, nu_tau)
+            x = tpk[None, :] * taus[:, None]
+            den = 1.0 + x * x
+            br, bi = 1.0 / den, -x / den
         # t = cross * conj(B); core = t * phsr
         tr = cross_re * br + cross_im * bi
         ti = cross_im * br - cross_re * bi
@@ -950,81 +953,101 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
         # scattering config measured no added error at the shipped
         # in-bench parity figure, 0.036 ns — PERF.md; bench ships
         # coarse_iter=12, bench_common.COARSE_ITER)
-        sol32 = _solve(jnp.asarray(init_params, dtype=jnp.float64),
-                       cross32, abs_m2_32, inv_err2, freqs, P, nu_fit_DM,
-                       nu_fit_GM, nu_fit_tau, flags, log10_tau, nbin, lo,
-                       hi, max_iter=max_iter if coarse_iter is None
-                       else coarse_iter, scat=scat, coarse=True)
+        # pp_* named scopes mark the device-side stage split for the
+        # obs layer: op names in a profiler capture carry the scope
+        # path, and obs/devtime.py folds them into the phase table's
+        # device column (docs/OBSERVABILITY.md).  The scopes imprint
+        # at trace time, so stages that share a jit cache entry share
+        # the scope of whichever call traced first — coarse/polish
+        # never collide (``coarse`` is a static arg), but a process
+        # mixing hybrid and single-stage fits of identical static
+        # config sees the first caller's label.
+        with jax.named_scope("pp_coarse"):
+            sol32 = _solve(jnp.asarray(init_params, dtype=jnp.float64),
+                           cross32, abs_m2_32, inv_err2, freqs, P,
+                           nu_fit_DM, nu_fit_GM, nu_fit_tau, flags,
+                           log10_tau, nbin, lo, hi,
+                           max_iter=max_iter if coarse_iter is None
+                           else coarse_iter, scat=scat, coarse=True)
         # polish budget: convergence typically takes 2-3 Newton steps
         # from the f32 plateau, but under vmap the while_loop runs to
         # the SLOWEST lane — polish_iter caps the expensive f64 stage
         # (None = the caller's full budget, the conservative default)
-        sol = _solve(sol32["x"], cross, abs_m2, inv_err2, freqs, P,
-                     nu_fit_DM, nu_fit_GM, nu_fit_tau, flags, log10_tau,
-                     nbin, lo, hi,
-                     max_iter=max_iter if polish_iter is None
-                     else polish_iter, scat=scat)
+        with jax.named_scope("pp_polish"):
+            sol = _solve(sol32["x"], cross, abs_m2, inv_err2, freqs, P,
+                         nu_fit_DM, nu_fit_GM, nu_fit_tau, flags,
+                         log10_tau, nbin, lo, hi,
+                         max_iter=max_iter if polish_iter is None
+                         else polish_iter, scat=scat)
         sol["nfev"] = sol32["nfev"] + sol["nfev"]
     else:
-        sol = _solve(jnp.asarray(init_params, dtype=jnp.float64), cross,
-                     abs_m2, inv_err2, freqs, P, nu_fit_DM, nu_fit_GM,
-                     nu_fit_tau, flags, log10_tau, nbin, lo, hi,
-                     max_iter=max_iter, scat=scat)
+        with jax.named_scope("pp_solve"):
+            sol = _solve(jnp.asarray(init_params, dtype=jnp.float64),
+                         cross, abs_m2, inv_err2, freqs, P, nu_fit_DM,
+                         nu_fit_GM, nu_fit_tau, flags, log10_tau, nbin,
+                         lo, hi, max_iter=max_iter, scat=scat)
     params_fit = sol["x"]
     phi_fit, DM_fit, GM_fit, tau_fit, alpha_fit = [params_fit[i]
                                                    for i in range(5)]
 
-    # Output reference frequencies (zero-covariance defaults).
-    nu_out_DM, nu_out_GM, nu_out_tau = nu_outs
-    if not all(nu is not None for nu in nu_outs):
-        nz = get_nu_zeros(params_fit, cross, abs_m2, inv_err2, freqs, P,
-                          nu_fit_DM, nu_fit_GM, nu_fit_tau, flags,
-                          log10_tau, nbin, option=option, scat=scat)
-        if nu_out_DM is None:
-            nu_out_DM = nz[0]
-        if nu_out_GM is None:
-            nu_out_GM = nz[1]
-        if nu_out_tau is None:
-            nu_out_tau = nz[2]
-    if is_toa:  # phi must reference a single frequency if both DM & GM fit
-        if flags[1]:
-            nu_out_GM = nu_out_DM
-        elif flags[2]:
-            nu_out_DM = nu_out_GM
+    # Output reference frequencies (zero-covariance defaults).  The
+    # whole finishing stage — nu-zero transforms, output-frame Hessian,
+    # covariance, scales — is the solution's full-precision refinement,
+    # so its device ops attribute to the ``polish`` stage alongside the
+    # hybrid driver's f64 polish solve (obs/devtime.py SCOPE_PHASES).
+    with jax.named_scope("pp_polish"):
+        nu_out_DM, nu_out_GM, nu_out_tau = nu_outs
+        if not all(nu is not None for nu in nu_outs):
+            nz = get_nu_zeros(params_fit, cross, abs_m2, inv_err2, freqs,
+                              P, nu_fit_DM, nu_fit_GM, nu_fit_tau, flags,
+                              log10_tau, nbin, option=option, scat=scat)
+            if nu_out_DM is None:
+                nu_out_DM = nz[0]
+            if nu_out_GM is None:
+                nu_out_GM = nz[1]
+            if nu_out_tau is None:
+                nu_out_tau = nz[2]
+        if is_toa:  # phi must reference one frequency if both DM & GM fit
+            if flags[1]:
+                nu_out_GM = nu_out_DM
+            elif flags[2]:
+                nu_out_DM = nu_out_GM
 
-    # Transform phi to the output reference frequencies.
-    phi_inf = phi_fit - (Dconst / P) * DM_fit * nu_fit_DM ** -2 \
-        - (Dconst ** 2 / P) * GM_fit * nu_fit_GM ** -4
-    phi_out = phi_inf + (Dconst / P) * DM_fit * nu_out_DM ** -2 \
-        + (Dconst ** 2 / P) * GM_fit * nu_out_GM ** -4
-    phi_out = jnp.where(jnp.abs(phi_out) >= 0.5, phi_out % 1.0, phi_out)
-    phi_out = jnp.where(phi_out >= 0.5, phi_out - 1.0, phi_out)
+        # Transform phi to the output reference frequencies.
+        phi_inf = phi_fit - (Dconst / P) * DM_fit * nu_fit_DM ** -2 \
+            - (Dconst ** 2 / P) * GM_fit * nu_fit_GM ** -4
+        phi_out = phi_inf + (Dconst / P) * DM_fit * nu_out_DM ** -2 \
+            + (Dconst ** 2 / P) * GM_fit * nu_out_GM ** -4
+        phi_out = jnp.where(jnp.abs(phi_out) >= 0.5, phi_out % 1.0,
+                            phi_out)
+        phi_out = jnp.where(phi_out >= 0.5, phi_out - 1.0, phi_out)
 
-    # Transform tau to nu_out_tau.
-    tau_lin = 10 ** tau_fit if log10_tau else tau_fit
-    tau_out_lin = scattering_times(tau_lin, alpha_fit, nu_out_tau,
-                                   nu_fit_tau)
-    tau_out = jnp.log10(tau_out_lin) if log10_tau else tau_out_lin
+        # Transform tau to nu_out_tau.
+        tau_lin = 10 ** tau_fit if log10_tau else tau_fit
+        tau_out_lin = scattering_times(tau_lin, alpha_fit, nu_out_tau,
+                                       nu_fit_tau)
+        tau_out = jnp.log10(tau_out_lin) if log10_tau else tau_out_lin
 
-    params_out = jnp.stack([phi_out, DM_fit, GM_fit, tau_out, alpha_fit])
+        params_out = jnp.stack([phi_out, DM_fit, GM_fit, tau_out,
+                                alpha_fit])
 
-    # Hessian + covariance + scales at the output references.
-    H5, cross_hess, S, C, scales, ok = _hess_with_scales(
-        params_out, cross, abs_m2, inv_err2, freqs, P, nu_out_DM,
-        nu_out_GM, nu_out_tau, flags, log10_tau, nbin, scat=scat)
-    cov_fit, scale_errs = _covariance_with_scales(H5, cross_hess, S,
-                                                  jnp.asarray(ifit), ok)
-    # negative variances (non-PD covariance from a failed fit) surface as
-    # NaN, matching the reference's **0.5 behavior — a loud flag, not a
-    # plausible-looking error
-    all_errs = jnp.sqrt(jnp.diagonal(cov_fit))
-    param_errs = jnp.zeros(5, dtype=params_out.dtype).at[
-        jnp.asarray(ifit)].set(all_errs)
+        # Hessian + covariance + scales at the output references.
+        H5, cross_hess, S, C, scales, ok = _hess_with_scales(
+            params_out, cross, abs_m2, inv_err2, freqs, P, nu_out_DM,
+            nu_out_GM, nu_out_tau, flags, log10_tau, nbin, scat=scat)
+        cov_fit, scale_errs = _covariance_with_scales(
+            H5, cross_hess, S, jnp.asarray(ifit), ok)
+        # negative variances (non-PD covariance from a failed fit)
+        # surface as NaN, matching the reference's **0.5 behavior — a
+        # loud flag, not a plausible-looking error
+        all_errs = jnp.sqrt(jnp.diagonal(cov_fit))
+        param_errs = jnp.zeros(5, dtype=params_out.dtype).at[
+            jnp.asarray(ifit)].set(all_errs)
 
-    channel_snrs = scales * jnp.sqrt(S)
-    snr = jnp.sqrt(jnp.sum(channel_snrs ** 2))
-    chi2 = Sd + sol["f"]
-    red_chi2 = chi2 / dof
+        channel_snrs = scales * jnp.sqrt(S)
+        snr = jnp.sqrt(jnp.sum(channel_snrs ** 2))
+        chi2 = Sd + sol["f"]
+        red_chi2 = chi2 / dof
 
     return check_fit_result(DataBunch(
         params=params_out, param_errs=param_errs,
@@ -1087,9 +1110,10 @@ def _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b, errs_b,
     # scan-body closure) — it is never materialized at [B, nchan, nbin]
     shared_model = model_ports.ndim == 2
     if seed:  # in-graph FFTFIT seeding: phi from band-average profiles
-        init_b = init_b.at[:, 0].set(
-            _seed_phases(data_ports, model_ports, errs_b, weights_b,
-                         cast))
+        with jax.named_scope("pp_seed"):  # guess stage (obs/devtime.py)
+            init_b = init_b.at[:, 0].set(
+                _seed_phases(data_ports, model_ports, errs_b, weights_b,
+                             cast))
 
     def one(d, m, x0, p, fq, er, w, nf, no):
         if cast is not None:

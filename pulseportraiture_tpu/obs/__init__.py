@@ -13,7 +13,13 @@ Layout:
 * :mod:`.monitor`  — the single jax.monitoring fan-out bridge (shared
   with the PPTPU_SANITIZE trace counters in ``debug.py``)
 * :mod:`.manifest` — run-manifest assembly (git SHA, device, env)
-* :mod:`.trace`    — opt-in jax.profiler capture (``PPTPU_TRACE_DIR``)
+* :mod:`.trace`    — opt-in jax.profiler capture (``PPTPU_TRACE_DIR``),
+  reentrancy-safe (a nested capture degrades to a ``trace_skipped``
+  event; the profiler is a process-wide singleton)
+* :mod:`.devtime`  — profiler-capture ingestion: Chrome-trace/xplane
+  parsing, self-time reduction, ``jax.named_scope`` (``pp_*``) stage
+  attribution, the per-region ``devtime`` events the phase table's
+  device column is built from
 * :mod:`.merge`    — multihost shard merge: per-process
   ``events.<proc>.jsonl`` + ``manifest.<proc>.json`` shards into one
   run (span paths prefixed by process, counters summed)
@@ -23,7 +29,7 @@ contract (jaxlint J002 enforces it statically; ``fit_telemetry``
 additionally passes tracers through untouched at runtime).
 """
 
-from . import monitor  # noqa: F401
+from . import devtime, monitor  # noqa: F401
 from .core import (Recorder, configure, counter, current, enabled,
                    event, fit_telemetry, gauge, list_event_files,
                    obs_dir, obs_max_bytes, phases, run, scoped_run,
@@ -31,8 +37,8 @@ from .core import (Recorder, configure, counter, current, enabled,
 from .merge import merge_obs_shards
 from .trace import trace_capture, trace_dir
 
-__all__ = ["Recorder", "configure", "counter", "current", "enabled",
-           "event", "fit_telemetry", "gauge", "list_event_files",
-           "merge_obs_shards", "obs_dir", "obs_max_bytes", "phases",
-           "run", "scoped_run", "span", "trace_capture", "trace_dir",
-           "monitor"]
+__all__ = ["Recorder", "configure", "counter", "current", "devtime",
+           "enabled", "event", "fit_telemetry", "gauge",
+           "list_event_files", "merge_obs_shards", "obs_dir",
+           "obs_max_bytes", "phases", "run", "scoped_run", "span",
+           "trace_capture", "trace_dir", "monitor"]

@@ -1,0 +1,507 @@
+"""Device-time attribution: profiler captures -> per-stage device seconds.
+
+The wall-clock spans of :mod:`.core` stop at the jit boundary: the
+hybrid fit's coarse-vs-polish split (and the scattering kernel inside
+each) lives inside ONE compiled program, so the phase table could only
+show "solve took N s" without saying where the device spent it.  This
+module closes that gap by parsing the artifacts ``jax.profiler`` drops
+under ``$PPTPU_TRACE_DIR/<region>/plugins/profile/<session>/``:
+
+* ``*.xplane.pb`` — the raw profiler protobuf, the PRIMARY source.
+  Its op planes carry every executed op as an XEvent with
+  ``hlo_module``/``hlo_op`` stats and picosecond timing, and its
+  ``/host:metadata`` plane embeds each executed program's HloProto,
+  whose per-instruction ``metadata.op_name`` carries the
+  ``jax.named_scope`` path
+  (``jit(fit)/.../pp_coarse/while/body/dot_general``).  A ~100-line
+  protobuf wire reader extracts exactly that — no
+  tensorflow/tensorboard dependency, and the python-tracer lines
+  (hundreds of thousands of host frames when a compile happens inside
+  the capture) are skipped whole at the line level, which the
+  length-delimited wire format makes free.
+* ``*.trace.json.gz`` — the Chrome-trace event stream, the FALLBACK
+  when no xplane sits next to it.  Same op rows via
+  ``args.hlo_module``/``args.hlo_op``, but jax caps the conversion at
+  ~1e6 events and host frames count against the cap, so a capture
+  containing a compile can silently lose its op rows there (exactly
+  how this parser's xplane-first policy was motivated).
+
+Container rows (``jit_*`` program rows, ``while``-loop rows) CONTAIN
+their children in both formats, so durations are reduced to SELF time
+via per-track interval nesting before they are summed — rows then
+partition device time exactly (the double-count the legacy
+tools/trace_summary.py could only warn about).
+
+Attribution contract: the solver annotates its stages with
+``jax.named_scope`` names starting with ``pp_`` (fit/portrait.py:
+``pp_seed``/``pp_coarse``/``pp_solve``/``pp_polish``;
+ops/scattering.py: ``pp_scatter``).  An op's scope path is the ordered
+list of ``pp_*`` segments in its ``op_name``; its pipeline *phase* is
+the :data:`SCOPE_PHASES` entry of the outermost scope.  Ops without a
+``pp_*`` scope (data prep, padding, transfers) count toward the device
+total as ``unattributed``.  ``device <= wall`` need not hold per phase
+on a multi-threaded backend (device-seconds sum over parallel
+executors); see docs/OBSERVABILITY.md for the full semantics.
+
+Everything here is host-side file parsing — never call it inside
+traced code (jaxlint J002 flags ``obs.devtime.*`` in jit).
+"""
+
+import glob
+import gzip
+import json
+import os
+import re
+
+from . import core
+
+__all__ = ["SCOPE_PREFIX", "SCOPE_PHASES", "find_capture",
+           "parse_chrome_trace", "self_times", "parse_xplane",
+           "parse_xplane_scopes", "scopes_of", "summarize_region",
+           "summarize_trace_dir", "record_devtime"]
+
+# named-scope convention: any scope segment starting with this prefix
+# is an attribution scope (everything else in the op_name path —
+# jit(...)/while/body/transpose machinery — is ignored)
+SCOPE_PREFIX = "pp_"
+
+# outermost scope -> pipeline phase (the span names GetTOAs emits), so
+# the phase table can carry a device column next to the wall column
+SCOPE_PHASES = {
+    "pp_seed": "guess",      # in-graph FFTFIT phase seeding
+    "pp_coarse": "solve",    # hybrid f32 coarse-search stage
+    "pp_solve": "solve",     # single-stage (non-hybrid) solve
+    "pp_polish": "polish",   # f64 polish + covariance/nu-zero finish
+    "pp_scatter": "solve",   # scattering kernel reached outside a stage
+}
+
+
+# -- capture discovery ----------------------------------------------------
+
+def find_capture(region_dir):
+    """(trace_json_gz_path, xplane_pb_path) of the NEWEST profiler
+    session under ``region_dir`` (either may be None).
+
+    ``jax.profiler`` writes each start/stop pair into a fresh
+    ``plugins/profile/<timestamp>/`` session directory; re-capturing a
+    region appends sessions, and the newest is the one the enclosing
+    span just timed.
+    """
+    sessions = {}
+    for path in glob.glob(os.path.join(
+            region_dir, "**", "*.trace.json.gz"), recursive=True):
+        sessions.setdefault(os.path.dirname(path), {})["trace"] = path
+    for path in glob.glob(os.path.join(
+            region_dir, "**", "*.xplane.pb"), recursive=True):
+        sessions.setdefault(os.path.dirname(path), {})["xplane"] = path
+    if not sessions:
+        return None, None
+    newest = max(sessions)  # timestamped dir names sort chronologically
+    return sessions[newest].get("trace"), sessions[newest].get("xplane")
+
+
+# -- Chrome-trace side ----------------------------------------------------
+
+def parse_chrome_trace(path):
+    """Complete (``ph == "X"``) events of a ``*.trace.json[.gz]``.
+
+    Returns dicts with ``pid``/``tid``/``ts``/``dur`` (microseconds)
+    /``name`` plus ``module``/``op`` when the row is an XLA op
+    (``args.hlo_module``/``args.hlo_op``); rows without an ``hlo_op``
+    are host frames or executor scaffolding.
+    """
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    out = []
+    for e in doc.get("traceEvents", []):
+        if e.get("ph") != "X":
+            continue
+        try:
+            ts = float(e.get("ts", 0.0))
+            dur = float(e.get("dur", 0.0))
+        except (TypeError, ValueError):
+            continue
+        args = e.get("args") or {}
+        out.append({"pid": e.get("pid"), "tid": e.get("tid"),
+                    "ts": ts, "dur": dur,
+                    "name": e.get("name", ""),
+                    "module": _strip_program_id(args.get("hlo_module")),
+                    "op": args.get("hlo_op")})
+    return out
+
+
+def self_times(events):
+    """Annotate each event with ``self`` = dur minus nested children.
+
+    Chrome-trace rows nest on a (pid, tid) track: a program row spans
+    its ops, a ``while`` row spans every iteration's body ops.  Summing
+    raw ``dur`` double-counts those containers; self time partitions
+    each track's busy time exactly.  Mutates and returns ``events``.
+    """
+    tracks = {}
+    for e in events:
+        tracks.setdefault((e["pid"], e["tid"]), []).append(e)
+    for track in tracks.values():
+        # parents first at equal start times (longer duration first)
+        track.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack = []  # open events, innermost last
+        for e in track:
+            e["self"] = e["dur"]
+            while stack and e["ts"] >= stack[-1]["ts"] + stack[-1]["dur"]:
+                stack.pop()
+            if stack:
+                stack[-1]["self"] -= e["dur"]
+            stack.append(e)
+    return events
+
+
+def _strip_program_id(name):
+    """'jit_fit(5)' -> 'jit_fit' (the Chrome trace and the xplane
+    metadata plane disagree about the program-id suffix)."""
+    if not name:
+        return name
+    if name.endswith(")") and "(" in name:
+        return name[:name.rindex("(")]
+    return name
+
+
+# -- xplane side: a minimal protobuf wire reader --------------------------
+#
+# Only length-delimited traversal is needed.  Field numbers follow
+# xplane.proto (XSpace.planes=1; XPlane.name=2/lines=3/
+# event_metadata=4/stat_metadata=5; XLine.name=2/timestamp_ns=3/
+# events=4; XEvent.metadata_id=1/offset_ps=2/duration_ps=3/stats=4;
+# XStat.metadata_id=1/str_value=5/bytes_value=6/ref_value=7) and
+# hlo.proto (HloProto.hlo_module=1; module.computations=3;
+# computation.instructions=2; instruction.name=1/metadata=7;
+# OpMetadata.op_name=2).  Unknown fields are skipped by wire type, so
+# schema additions degrade gracefully.
+
+def _fields(buf):
+    """(field_number, wire_type, value) triples of one message."""
+    i, n, out = 0, len(buf), []
+    while i < n:
+        tag, i = _varint(buf, i)
+        fn, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        else:  # groups (3/4): not produced by these schemas
+            raise ValueError("unsupported wire type %d" % wt)
+        out.append((fn, wt, v))
+    return out
+
+
+def _varint(buf, i):
+    x = s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        x |= (b & 0x7F) << s
+        if not b & 0x80:
+            return x, i
+        s += 7
+
+
+def _sub(fields, n):
+    return [v for f, _, v in fields if f == n]
+
+
+def _hlo_op_names(hlo_proto):
+    """{instruction name: metadata.op_name} across every computation of
+    one embedded HloProto (instruction names are module-unique)."""
+    out = {}
+    for module in _sub(_fields(hlo_proto), 1):        # HloProto.hlo_module
+        for comp in _sub(_fields(module), 3):         # .computations
+            for inst in _sub(_fields(comp), 2):       # .instructions
+                inf = _fields(inst)
+                names = _sub(inf, 1)                  # .name
+                metas = _sub(inf, 7)                  # .metadata
+                if not names or not metas:
+                    continue
+                op_names = _sub(_fields(metas[0]), 2)  # OpMetadata.op_name
+                if op_names:
+                    try:
+                        out[names[0].decode()] = op_names[0].decode()
+                    except UnicodeDecodeError:
+                        pass
+    return out
+
+
+def _plane_scopes(pf, out):
+    """Fold a metadata plane's embedded HloProtos into the
+    {(module, instruction): op_name} scope map ``out``."""
+    for entry in _sub(pf, 4):                     # .event_metadata{}
+        for em in _sub(_fields(entry), 2):        # map value
+            emf = _fields(em)
+            mod_names = _sub(emf, 2)              # XEventMetadata.name
+            if not mod_names:
+                continue
+            module = _strip_program_id(mod_names[0].decode())
+            for stat in _sub(emf, 5):             # .stats
+                for blob in _sub(_fields(stat), 6):  # bytes_value
+                    for inst, op_name in _hlo_op_names(blob).items():
+                        out[(module, inst)] = op_name
+
+
+def _plane_op_events(pf, plane_name, out):
+    """Append one op plane's XEvents (those carrying hlo stats) to
+    ``out`` as parse_chrome_trace-shaped dicts (times in us)."""
+    stat_names = {}                               # stat metadata id->name
+    for entry in _sub(pf, 5):                     # .stat_metadata{}
+        for sm in _sub(_fields(entry), 2):
+            smf = _fields(sm)
+            ids, names = _sub(smf, 1), _sub(smf, 2)
+            if ids and names:
+                try:
+                    stat_names[ids[0]] = names[0].decode()
+                except UnicodeDecodeError:
+                    pass
+    hlo_op_ids = {i for i, n in stat_names.items() if n == "hlo_op"}
+    hlo_mod_ids = {i for i, n in stat_names.items()
+                   if n == "hlo_module"}
+    if not hlo_op_ids:
+        return  # no XLA ops on this plane (python tracer, task env)
+    event_names = {}                              # event metadata id->name
+    for entry in _sub(pf, 4):                     # .event_metadata{}
+        for em in _sub(_fields(entry), 2):
+            emf = _fields(em)
+            ids, names = _sub(emf, 1), _sub(emf, 2)
+            if ids and names:
+                try:
+                    event_names[ids[0]] = names[0].decode()
+                except UnicodeDecodeError:
+                    pass
+    for line_buf in _sub(pf, 3):                  # XPlane.lines
+        lf = _fields(line_buf)
+        lnames = _sub(lf, 2)                      # XLine.name
+        lname = ""
+        if lnames and isinstance(lnames[0], bytes):
+            try:
+                lname = lnames[0].decode()
+            except UnicodeDecodeError:
+                pass
+        if lname == "python":
+            continue  # host python tracer: no ops, possibly 1e6 rows
+        ts0_ns = 0
+        for v in _sub(lf, 3):                     # .timestamp_ns
+            if isinstance(v, int):
+                ts0_ns = v
+        line_id = _sub(lf, 1)
+        tid = "%s/%s" % (line_id[0] if line_id else 0, lname)
+        for ev_buf in _sub(lf, 4):                # .events
+            ef = _fields(ev_buf)
+            op = module = None
+            for stat_buf in _sub(ef, 4):          # XEvent.stats
+                sf = _fields(stat_buf)
+                mids = _sub(sf, 1)
+                if not mids:
+                    continue
+                val = None
+                strs = _sub(sf, 5)                # str_value
+                refs = _sub(sf, 7)                # ref_value
+                if strs and isinstance(strs[0], bytes):
+                    try:
+                        val = strs[0].decode()
+                    except UnicodeDecodeError:
+                        val = None
+                elif refs:
+                    val = stat_names.get(refs[0])
+                if val is None:
+                    continue
+                if mids[0] in hlo_op_ids:
+                    op = val
+                elif mids[0] in hlo_mod_ids:
+                    module = val
+            if op is None:
+                continue
+            mid = _sub(ef, 1)                     # .metadata_id
+            name = event_names.get(mid[0], "") if mid else ""
+            off_ps = _sub(ef, 2)                  # .offset_ps
+            dur_ps = _sub(ef, 3)                  # .duration_ps
+            ts_us = ts0_ns / 1e3 + (off_ps[0] / 1e6 if off_ps else 0.0)
+            out.append({"pid": plane_name, "tid": tid, "ts": ts_us,
+                        "dur": (dur_ps[0] / 1e6 if dur_ps else 0.0),
+                        "name": name,
+                        "module": _strip_program_id(module),
+                        "op": op})
+
+
+def parse_xplane(path):
+    """(op_events, scope_map) of one ``*.xplane.pb``.
+
+    ``op_events`` are parse_chrome_trace-shaped dicts for every XEvent
+    carrying an ``hlo_op`` stat — unlike the Chrome-trace conversion
+    these are NOT subject to jax's ~1e6-event cap, so a capture whose
+    JSON drowned in python-tracer frames still attributes fully.
+    ``scope_map`` maps (module, instruction) to the named-scope
+    ``op_name``.  Tolerates a missing/corrupt file by returning empty
+    results.
+    """
+    try:
+        with open(path, "rb") as fh:
+            buf = fh.read()
+    except OSError:
+        return [], {}
+    events = []
+    scopes = {}
+    try:
+        for plane_buf in _sub(_fields(buf), 1):   # XSpace.planes
+            pf = _fields(plane_buf)
+            names = _sub(pf, 2)                   # XPlane.name
+            pname = names[0].decode() if names else ""
+            if pname.endswith(":metadata"):
+                _plane_scopes(pf, scopes)
+            else:
+                _plane_op_events(pf, pname, events)
+    except (ValueError, IndexError, UnicodeDecodeError):
+        pass  # torn/foreign protobuf: degrade to what was parsed
+    return events, scopes
+
+
+def parse_xplane_scopes(path):
+    """{(module, instruction): op_name} — the named-scope source of
+    truth (see :func:`parse_xplane`)."""
+    return parse_xplane(path)[1]
+
+
+# a pp_* scope possibly wrapped in transform decorations the lowering
+# applies per segment: "pp_coarse", "vmap(pp_coarse)", "jit(pp_x)" ...
+_SCOPE_SEG_RE = re.compile(r"\b(%s[A-Za-z0-9_]+)" % SCOPE_PREFIX)
+
+
+def scopes_of(op_name):
+    """Ordered ``pp_*`` scopes of a named-scope path; transform
+    decorations are stripped
+    ('jit(f)/vmap(pp_coarse)/while/body/pp_scatter/mul' ->
+    ['pp_coarse', 'pp_scatter'])."""
+    if not op_name:
+        return []
+    out = []
+    for seg in op_name.split("/"):
+        m = _SCOPE_SEG_RE.search(seg)
+        if m:
+            out.append(m.group(1))
+    return out
+
+
+# -- aggregation ----------------------------------------------------------
+
+def summarize_region(region_dir, top=10):
+    """Aggregate the newest capture under one region directory.
+
+    Returns None when no capture exists, else a JSON-ready dict::
+
+        {"trace": ..., "device_total_s": ..., "unattributed_s": ...,
+         "phases": {"solve": ..., "polish": ...},     # device seconds
+         "scopes": {"pp_coarse": ..., "pp_coarse/pp_scatter": ...},
+         "top_ops": {...}, "n_ops": ...}
+
+    ``phases`` maps the outermost scope through :data:`SCOPE_PHASES`;
+    ``scopes`` keeps the full nested scope path.  All values are
+    self-time sums — rows partition device time, so ``scopes`` +
+    ``unattributed_s`` == ``device_total_s`` (up to rounding).
+    """
+    trace_path, xplane_path = find_capture(region_dir)
+    if trace_path is None and xplane_path is None:
+        return None
+    events, scope_map = [], {}
+    if xplane_path:
+        events, scope_map = parse_xplane(xplane_path)
+    if not events and trace_path:
+        # xplane absent/unreadable: the (event-capped) Chrome trace
+        events = parse_chrome_trace(trace_path)
+    events = self_times(events)
+
+    total_us = 0.0
+    unattr_us = 0.0
+    scopes = {}
+    phases = {}
+    top_ops = {}
+    n_ops = 0
+    for e in events:
+        if not e["op"]:
+            continue  # host frame / executor scaffolding
+        n_ops += 1
+        dt = e["self"]
+        total_us += dt
+        op_name = scope_map.get((e["module"], e["op"]), "")
+        path = scopes_of(op_name)
+        if path:
+            key = "/".join(path)
+            scopes[key] = scopes.get(key, 0.0) + dt
+            phase = SCOPE_PHASES.get(path[0])
+            if phase:
+                phases[phase] = phases.get(phase, 0.0) + dt
+        else:
+            unattr_us += dt
+        top_ops[e["op"]] = top_ops.get(e["op"], 0.0) + dt
+
+    def s(us):
+        return round(us / 1e6, 6)
+
+    top = dict(sorted(top_ops.items(), key=lambda kv: -kv[1])[:top])
+    return {
+        "trace": trace_path or xplane_path,
+        "device_total_s": s(total_us),
+        "unattributed_s": s(unattr_us),
+        "phases": {k: s(v) for k, v in sorted(phases.items())},
+        "scopes": {k: s(v) for k, v in sorted(scopes.items())},
+        "top_ops": {k: s(v) for k, v in top.items()},
+        "n_ops": n_ops,
+    }
+
+
+def summarize_trace_dir(trace_root, top=10):
+    """{region: summary} for every region directory under a
+    ``PPTPU_TRACE_DIR`` root (regions with no capture are skipped)."""
+    out = {}
+    try:
+        names = sorted(os.listdir(trace_root))
+    except OSError:
+        return out
+    for name in names:
+        region_dir = os.path.join(trace_root, name)
+        if not os.path.isdir(region_dir):
+            continue
+        summary = summarize_region(region_dir, top=top)
+        if summary is not None:
+            out[name] = summary
+    return out
+
+
+def record_devtime(region, region_dir):
+    """Ingest a just-closed capture and emit one ``devtime`` event into
+    the active obs run (:mod:`.trace` calls this after ``stop_trace``).
+
+    Never raises and never emits when no run is active or the capture
+    is unreadable — telemetry must not kill the run it observes.  The
+    per-run ``device_seconds_total`` counter sums ``device_total_s``
+    across regions so the runner can gauge device utilization without
+    re-reading its own event stream.
+    """
+    rec = core.current()
+    if rec is None:
+        return None
+    try:
+        summary = summarize_region(region_dir)
+    except Exception as e:  # parsing must never be fatal
+        rec.emit("event", name="devtime_error", region=region,
+                 error=str(e)[:500])
+        return None
+    if summary is None:
+        return None
+    rec.emit("devtime", region=region, **summary)
+    rec.bump("devtime_regions")
+    rec.bump("device_seconds_total", summary["device_total_s"])
+    return summary

@@ -16,6 +16,9 @@ metric-aggregation item):
   table distinguishes hosts while aggregating names;
 * fit telemetry passes through untouched — the report's per-subint
   convergence stats sum over every shard's fit events;
+* ``devtime`` events (ingested profiler captures, obs/devtime.py) get
+  their ``region`` prefixed ``p<proc>/`` like span paths; the phase
+  and scope aggregations still sum across hosts by name;
 * manifest counters/gauges are summed (numeric) or kept per-process,
   ``wall_s`` is the max (processes run concurrently), configs merged.
 """
@@ -120,6 +123,11 @@ def merge_obs_shards(shards_dir, out_dir):
                     for field in ("path", "span"):
                         if ev.get(field):
                             ev[field] = "p%d/%s" % (proc, ev[field])
+                elif ev.get("kind") == "devtime" and ev.get("region"):
+                    # keep per-host capture regions distinguishable;
+                    # the phase/scope aggregations (obs_report's
+                    # device column) still sum across hosts by name
+                    ev["region"] = "p%d/%s" % (proc, ev["region"])
                 merged.append(ev)
     merged.sort(key=lambda e: e.get("t", 0.0))
     with open(os.path.join(out_dir, "events.jsonl"), "w",

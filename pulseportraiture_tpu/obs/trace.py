@@ -1,20 +1,36 @@
 """Opt-in jax.profiler trace capture (PPTPU_TRACE_DIR).
 
 ``trace_capture(name)`` wraps a region in a device profiler trace when
-the ``PPTPU_TRACE_DIR`` environment variable names a directory, and is
-a no-op otherwise.  Profiling through a remote-device tunnel is not
-always supported (tools/perf_probe.py records the same caveat), so a
-failing profiler start degrades to "no trace, one event recorded"
-rather than an exception: telemetry must never kill the run it is
-observing.
+the ``PPTPU_TRACE_DIR`` environment variable names a directory (or an
+explicit ``base_dir`` is given), and is a no-op otherwise.  Profiling
+through a remote-device tunnel is not always supported
+(tools/perf_probe.py records the same caveat), so a failing profiler
+start degrades to "no trace, one event recorded" rather than an
+exception: telemetry must never kill the run it is observing.
+
+The profiler is a PROCESS-WIDE singleton: ``jax.profiler.start_trace``
+raises when a trace is already active.  A nested ``trace_capture``
+(the survey runner's per-bucket capture around ``GetTOAs``'s
+per-archive capture) therefore degrades to a no-op that yields None
+and records one ``trace_skipped`` event naming the owning region —
+the outer capture keeps the device timeline.
+
+On a successful stop the capture is immediately ingested by
+:mod:`.devtime`: one ``devtime`` event (per-stage device seconds,
+named-scope attribution) lands in the active obs run next to the
+``trace`` event that links the span wall clock to the trace path.
 """
 
 import contextlib
 import os
+import threading
 
-from . import core
+from . import core, devtime
 
 __all__ = ["trace_dir", "trace_capture"]
+
+_lock = threading.Lock()
+_active_region = None  # region name owning the process-wide profiler
 
 
 def trace_dir():
@@ -24,19 +40,32 @@ def trace_dir():
 
 
 @contextlib.contextmanager
-def trace_capture(name):
+def trace_capture(name, base_dir=None):
     """Capture a jax.profiler trace of the region into
-    ``$PPTPU_TRACE_DIR/<name>``; yields the trace path or None.
+    ``<base>/<name>`` (``base_dir`` or ``$PPTPU_TRACE_DIR``); yields
+    the trace path or None.
 
     Composes with :func:`pulseportraiture_tpu.obs.core.span`: the span
     carries the wall clock, the profiler trace carries the device
-    timeline, and the emitted ``trace`` event links the two.
+    timeline, the emitted ``trace`` event links the two, and the
+    ``devtime`` event :func:`.devtime.record_devtime` ingests carries
+    the per-stage device-second attribution.
     """
-    base = trace_dir()
+    global _active_region
+    base = base_dir if base_dir is not None else trace_dir()
     if base is None:
         yield None
         return
     path = os.path.join(base, name)
+    with _lock:
+        owner = _active_region
+        if owner is None:
+            _active_region = name
+    if owner is not None:
+        # profiler already running: degrade, don't raise mid-pipeline
+        core.event("trace_skipped", region=name, active_region=owner)
+        yield None
+        return
     import jax
 
     started = False
@@ -52,6 +81,9 @@ def trace_capture(name):
             try:
                 jax.profiler.stop_trace()
                 core.event("trace", region=name, path=path)
+                devtime.record_devtime(name, path)
             except Exception as e:
                 core.event("trace_error", region=name,
                            error=str(e)[:500])
+        with _lock:
+            _active_region = None

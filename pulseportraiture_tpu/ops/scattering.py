@@ -103,13 +103,16 @@ def scattering_portrait_FT(taus, nbin, nharm=None):
     ``nharm`` builds only the lowest harmonics (for callers working on a
     model_kmax-truncated spectrum).
     """
-    taus = as_fft_operand(taus)
-    if nharm is None:
-        nharm = nbin // 2 + 1
-    k = jnp.arange(nharm, dtype=taus.dtype)
-    x = 2.0 * jnp.pi * k * taus[..., None]
-    denom = 1.0 + x * x
-    return jax.lax.complex(1.0 / denom, -x / denom)
+    # pp_scatter: device-time attribution scope (obs/devtime.py) — op
+    # names of the kernel carry it into profiler captures
+    with jax.named_scope("pp_scatter"):
+        taus = as_fft_operand(taus)
+        if nharm is None:
+            nharm = nbin // 2 + 1
+        k = jnp.arange(nharm, dtype=taus.dtype)
+        x = 2.0 * jnp.pi * k * taus[..., None]
+        denom = 1.0 + x * x
+        return jax.lax.complex(1.0 / denom, -x / denom)
 
 
 def scattering_portrait_FT_deriv(taus, taus_deriv, scat_port_FT):
@@ -119,14 +122,16 @@ def scattering_portrait_FT_deriv(taus, taus_deriv, scat_port_FT):
     then the chain rule with taus_deriv.  Math equivalent of
     /root/reference/pptoaslib.py:318-330.
     """
-    nharm = scat_port_FT.shape[-1]
-    k = jnp.arange(nharm, dtype=fft_real_dtype(jnp.asarray(taus).dtype))
-    # -2*pi*i*k as a same-dtype complex array (no weak c128 scalars)
-    mjk = jax.lax.complex(jnp.zeros_like(k), -2.0 * jnp.pi * k)
-    dB_dtaus = mjk * scat_port_FT ** 2
-    dtau, dalpha = taus_deriv
-    return jnp.stack([dB_dtaus * dtau[..., None],
-                      dB_dtaus * dalpha[..., None]])
+    with jax.named_scope("pp_scatter"):
+        nharm = scat_port_FT.shape[-1]
+        k = jnp.arange(nharm,
+                       dtype=fft_real_dtype(jnp.asarray(taus).dtype))
+        # -2*pi*i*k as a same-dtype complex array (no weak c128 scalars)
+        mjk = jax.lax.complex(jnp.zeros_like(k), -2.0 * jnp.pi * k)
+        dB_dtaus = mjk * scat_port_FT ** 2
+        dtau, dalpha = taus_deriv
+        return jnp.stack([dB_dtaus * dtau[..., None],
+                          dB_dtaus * dalpha[..., None]])
 
 
 def scattering_portrait_FT_2deriv(taus, taus_deriv, taus_2deriv,
@@ -138,16 +143,18 @@ def scattering_portrait_FT_2deriv(taus, taus_deriv, taus_2deriv,
     All terms finite at taus=0.  Math equivalent of
     /root/reference/pptoaslib.py:332-356.
     """
-    nharm = scat_port_FT.shape[-1]
-    k = jnp.arange(nharm, dtype=fft_real_dtype(jnp.asarray(taus).dtype))
-    u = jax.lax.complex(jnp.zeros_like(k), -2.0 * jnp.pi * k)
-    B = scat_port_FT
-    dB = u * B ** 2
-    d2B = 2.0 * (u ** 2) * B ** 3
-    dti = taus_deriv[:, None, ..., None]      # [2, 1, ..., nchan, 1]
-    dtj = taus_deriv[None, :, ..., None]      # [1, 2, ..., nchan, 1]
-    d2t = taus_2deriv[..., None]              # [2, 2, ..., nchan, 1]
-    return d2B * dti * dtj + dB * d2t
+    with jax.named_scope("pp_scatter"):
+        nharm = scat_port_FT.shape[-1]
+        k = jnp.arange(nharm,
+                       dtype=fft_real_dtype(jnp.asarray(taus).dtype))
+        u = jax.lax.complex(jnp.zeros_like(k), -2.0 * jnp.pi * k)
+        B = scat_port_FT
+        dB = u * B ** 2
+        d2B = 2.0 * (u ** 2) * B ** 3
+        dti = taus_deriv[:, None, ..., None]   # [2, 1, ..., nchan, 1]
+        dtj = taus_deriv[None, :, ..., None]   # [1, 2, ..., nchan, 1]
+        d2t = taus_2deriv[..., None]           # [2, 2, ..., nchan, 1]
+        return d2B * dti * dtj + dB * d2t
 
 
 def abs_scattering_portrait_FT(scat_port_FT):

@@ -954,84 +954,88 @@ class GetTOAs:
                         and None not in bounds[0]:
                     phi_bounds = tuple(bounds[0])
                 ph.enter("solve", batch=int(M))
-                if not fit_scat:
-                    r = jax.device_get(dict(fit_phase_shift(
-                        profs, mods, noise=errsx, bounds=phi_bounds,
-                        Ns=100)))  # one host transfer for all fields
-                    phis_fit = np.asarray(r["phase"])
-                    phi_errs_fit = np.asarray(r["phase_err"])
-                    scales_fit = np.asarray(r["scale"])
-                    scale_errs_fit = np.asarray(r["scale_err"])
-                    snrs_fit = np.asarray(r["snr"])
-                    red_chi2s_fit = np.asarray(r["red_chi2"])
-                else:
-                    # per-channel tau guess at each channel's frequency
-                    alpha_guess = getattr(self, "alpha", scattering_alpha)
-                    if self.scat_guess is not None:
-                        tg_s, tg_ref, alpha_guess = self.scat_guess
-                        tau_g = (tg_s / Psx) * (nusx / tg_ref) ** alpha_guess
-                    elif hasattr(self, "gparams"):
-                        tau_g = (self.gparams[1] / Psx) * \
-                            (nusx / self.model_nu_ref) ** alpha_guess
+                # opt-in device profile of the narrowband fit dispatches
+                # (PPTPU_TRACE_DIR; a no-op context otherwise) — the
+                # devtime ingestion attributes the capture by pp_* scope
+                with obs.trace_capture("ppnbtoas_arch%03d" % iarch):
+                    if not fit_scat:
+                        r = jax.device_get(dict(fit_phase_shift(
+                            profs, mods, noise=errsx, bounds=phi_bounds,
+                            Ns=100)))  # one host transfer for all fields
+                        phis_fit = np.asarray(r["phase"])
+                        phi_errs_fit = np.asarray(r["phase_err"])
+                        scales_fit = np.asarray(r["scale"])
+                        scale_errs_fit = np.asarray(r["scale_err"])
+                        snrs_fit = np.asarray(r["snr"])
+                        red_chi2s_fit = np.asarray(r["red_chi2"])
                     else:
-                        tau_g = np.zeros(M)
-                    # phase guess vs the scattered model
-                    taus_g = np.asarray(scattering_times(tau_g, alpha_guess,
-                                                         nusx, nusx))
-                    spFT = host_array(scattering_portrait_FT(taus_g, nbin))
-                    mods_scat = np.fft.irfft(spFT * np.fft.rfft(mods, axis=-1),
-                                             nbin, axis=-1)
-                    guess = fit_phase_shift(profs, mods_scat, noise=errsx,
-                                            Ns=100)
-                    if log10_tau:
-                        tau_g = np.log10(np.where(tau_g == 0.0, 1.0 / nbin,
-                                                  tau_g))
-                    init = np.stack([np.asarray(guess.phase),
-                                     np.full(M, d.DM), np.zeros(M), tau_g,
-                                     np.full(M, alpha_guess)], axis=1)
-                    if bounds is None:
-                        tau_lo = np.log10(1.0 / (10 * nbin)) if log10_tau \
-                            else 0.0
-                        bounds_eff = [(None, None), (None, None),
-                                      (None, None), (tau_lo, None),
-                                      (-10.0, 10.0)]
-                    else:
-                        bounds_eff = [tuple(bounds[0]), (None, None),
-                                      (None, None), tuple(bounds[1]),
-                                      (-10.0, 10.0)]
-                    nb_scan = auto_scan_size(len(profs), profiles=True)
-                    fit = self.fit_batch or fit_portrait_full_batch
-                    out = fit(
-                        profs[:, None, :], mods[:, None, :], init, Psx,
-                        nusx[:, None], errs=errsx[:, None],
-                        fit_flags=(1, 0, 0, 1, 0),
-                        nu_fits=np.stack([nusx] * 3, axis=1),
-                        bounds=bounds_eff, log10_tau=log10_tau,
-                        max_iter=max_iter, scan_size=nb_scan,
-                        pad_to=None if nb_scan is not None
-                        else bucket_batch_size(len(profs)),
-                        polish_iter=polish_iter, coarse_iter=coarse_iter,
-                        coarse_kmax=coarse_kmax)
-                    # one host transfer for the whole result tree (see
-                    # the wideband driver)
-                    out = jax.device_get(dict(out))
-                    phis_fit = np.asarray(out["phi"])
-                    phi_errs_fit = np.asarray(out["phi_err"])
-                    taus_fit = np.asarray(out["tau"])
-                    tau_errs_fit = np.asarray(out["tau_err"])
-                    scales_fit = np.asarray(out["scales"])[:, 0]
-                    scale_errs_fit = np.asarray(out["scale_errs"])[:, 0]
-                    snrs_fit = np.asarray(out["snr"])
-                    red_chi2s_fit = np.asarray(out["red_chi2"])
-                    # (phi, tau) covariance block from the 5-param kernel's
-                    # packed [nfit, nfit] matrix (fit order: phi, tau)
-                    cov = np.asarray(out["covariance_matrix"])
-                    covariances[sub_idx, cc, 0, 0] = cov[:, 0, 0]
-                    covariances[sub_idx, cc, 0, 1] = cov[:, 0, 1]
-                    covariances[sub_idx, cc, 1, 0] = cov[:, 1, 0]
-                    covariances[sub_idx, cc, 1, 1] = cov[:, 1, 1]
-                    nfevals[sub_idx, cc] = np.asarray(out["nfeval"])
-                    rcs_a[sub_idx, cc] = np.asarray(out["return_code"])
+                        # per-channel tau guess at each channel's frequency
+                        alpha_guess = getattr(self, "alpha", scattering_alpha)
+                        if self.scat_guess is not None:
+                            tg_s, tg_ref, alpha_guess = self.scat_guess
+                            tau_g = (tg_s / Psx) * (nusx / tg_ref) ** alpha_guess
+                        elif hasattr(self, "gparams"):
+                            tau_g = (self.gparams[1] / Psx) * \
+                                (nusx / self.model_nu_ref) ** alpha_guess
+                        else:
+                            tau_g = np.zeros(M)
+                        # phase guess vs the scattered model
+                        taus_g = np.asarray(scattering_times(tau_g, alpha_guess,
+                                                             nusx, nusx))
+                        spFT = host_array(scattering_portrait_FT(taus_g, nbin))
+                        mods_scat = np.fft.irfft(spFT * np.fft.rfft(mods, axis=-1),
+                                                 nbin, axis=-1)
+                        guess = fit_phase_shift(profs, mods_scat, noise=errsx,
+                                                Ns=100)
+                        if log10_tau:
+                            tau_g = np.log10(np.where(tau_g == 0.0, 1.0 / nbin,
+                                                      tau_g))
+                        init = np.stack([np.asarray(guess.phase),
+                                         np.full(M, d.DM), np.zeros(M), tau_g,
+                                         np.full(M, alpha_guess)], axis=1)
+                        if bounds is None:
+                            tau_lo = np.log10(1.0 / (10 * nbin)) if log10_tau \
+                                else 0.0
+                            bounds_eff = [(None, None), (None, None),
+                                          (None, None), (tau_lo, None),
+                                          (-10.0, 10.0)]
+                        else:
+                            bounds_eff = [tuple(bounds[0]), (None, None),
+                                          (None, None), tuple(bounds[1]),
+                                          (-10.0, 10.0)]
+                        nb_scan = auto_scan_size(len(profs), profiles=True)
+                        fit = self.fit_batch or fit_portrait_full_batch
+                        out = fit(
+                            profs[:, None, :], mods[:, None, :], init, Psx,
+                            nusx[:, None], errs=errsx[:, None],
+                            fit_flags=(1, 0, 0, 1, 0),
+                            nu_fits=np.stack([nusx] * 3, axis=1),
+                            bounds=bounds_eff, log10_tau=log10_tau,
+                            max_iter=max_iter, scan_size=nb_scan,
+                            pad_to=None if nb_scan is not None
+                            else bucket_batch_size(len(profs)),
+                            polish_iter=polish_iter, coarse_iter=coarse_iter,
+                            coarse_kmax=coarse_kmax)
+                        # one host transfer for the whole result tree (see
+                        # the wideband driver)
+                        out = jax.device_get(dict(out))
+                        phis_fit = np.asarray(out["phi"])
+                        phi_errs_fit = np.asarray(out["phi_err"])
+                        taus_fit = np.asarray(out["tau"])
+                        tau_errs_fit = np.asarray(out["tau_err"])
+                        scales_fit = np.asarray(out["scales"])[:, 0]
+                        scale_errs_fit = np.asarray(out["scale_errs"])[:, 0]
+                        snrs_fit = np.asarray(out["snr"])
+                        red_chi2s_fit = np.asarray(out["red_chi2"])
+                        # (phi, tau) covariance block from the 5-param kernel's
+                        # packed [nfit, nfit] matrix (fit order: phi, tau)
+                        cov = np.asarray(out["covariance_matrix"])
+                        covariances[sub_idx, cc, 0, 0] = cov[:, 0, 0]
+                        covariances[sub_idx, cc, 0, 1] = cov[:, 0, 1]
+                        covariances[sub_idx, cc, 1, 0] = cov[:, 1, 0]
+                        covariances[sub_idx, cc, 1, 1] = cov[:, 1, 1]
+                        nfevals[sub_idx, cc] = np.asarray(out["nfeval"])
+                        rcs_a[sub_idx, cc] = np.asarray(out["return_code"])
                 fit_duration = time.time() - fit_start
             except jax.errors.JaxRuntimeError as e:
                 del self.ok_idatafiles[n_okid:]

@@ -29,6 +29,7 @@ over a ('subint', 'chan') mesh via :func:`make_mesh_fitter`
 pipeline's per-archive fit configuration.
 """
 
+import contextlib
 import json
 import os
 import time
@@ -299,7 +300,7 @@ def _merge_survey_manifests(workdir, out_path):
 def run_survey(plan, workdir, modelfile=None, process_index=None,
                process_count=None, max_attempts=3, backoff_s=0.0,
                use_mesh=False, mesh=None, merge=True, max_archives=None,
-               quiet=True, **get_toas_kw):
+               trace_bucket=False, quiet=True, **get_toas_kw):
     """Execute (or resume) one process's share of a survey plan.
 
     ``plan`` is a SurveyPlan or a path to a saved plan.json.  All
@@ -312,6 +313,16 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
     left over stay pending in the ledger.  ``merge`` lets process 0
     fold the per-process obs shards + survey manifests into
     ``obs_merged/`` + ``survey.json`` once its own share is written.
+
+    ``trace_bucket`` (``ppsurvey run --trace-bucket``) captures one
+    jax.profiler trace per shape bucket into ``$PPTPU_TRACE_DIR`` (or
+    ``<workdir>/traces`` when unset); each capture is ingested into a
+    ``devtime`` event (obs/devtime.py) and the run closes with
+    ``device_total_s``/``device_utilization`` gauges, so the merged
+    report answers whether the survey was fit-bound or IO-bound and
+    where the device time went.  ``GetTOAs``'s own per-archive capture
+    degrades to ``trace_skipped`` events inside the bucket capture
+    (the profiler is a process-wide singleton).
     """
     if isinstance(plan, str):
         plan = SurveyPlan.load(plan)
@@ -347,59 +358,95 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
             mesh = make_mesh()
         fitter = make_mesh_fitter(mesh)
 
+    # per-bucket profiler capture (--trace-bucket): region directories
+    # named by bucket shape; a capture spans every consecutive archive
+    # of its bucket (the plan orders bucket-major) and is ingested to
+    # a devtime event at each bucket boundary
+    trace_base = None
+    if trace_bucket:
+        from ..obs.trace import trace_dir
+
+        trace_base = trace_dir() or os.path.join(workdir, "traces")
+
     with obs.run("ppsurvey", base_dir=paths["obs"],
                  config={"process": pid, "n_processes": nproc,
                          "n_archives": len(mine),
                          "n_buckets": len(plan.buckets),
                          "modelfile": modelfile,
-                         "use_mesh": bool(use_mesh)}) as rec:
+                         "use_mesh": bool(use_mesh),
+                         "trace_bucket": bool(trace_bucket)}) as rec:
+        t0 = time.perf_counter()
         _reconcile(queue, paths["checkpoint"],
                    [info for info, _ in mine], quiet)
         gts = {}
         n_fit = 0
         stop = False
+        tracer = contextlib.ExitStack()
+        cur_bucket = None
         # retry rounds: each failure bumps the attempt counter, so
         # max_attempts rounds settle every archive into done or
         # quarantined (modulo backoff still pending, which the next
         # resume picks up)
-        for _ in range(queue.max_attempts + 1):
-            ran = 0
-            for info, bucket in mine:
-                if stop or queue.state(info.path) in (DONE, QUARANTINED):
-                    continue
-                if not queue.ready(info.path):
-                    continue
-                gt = gts.get(bucket.key)
-                if gt is None:
-                    gt = _BucketedGetTOAs(
-                        [i.path for i, b in mine
-                         if b.key == bucket.key],
-                        modelfile, bucket.key, quiet=quiet)
-                    gt.fit_batch = fitter
-                    gts[bucket.key] = gt
-                padded = (info.nchan, info.nbin) != bucket.key
-                _fit_one(gt, queue, info, paths["checkpoint"], padded,
-                         get_toas_kw, quiet)
-                ran += 1
-                n_fit += 1
-                if max_archives is not None and n_fit >= max_archives:
-                    stop = True
-            outstanding = queue.outstanding()
-            if stop or not outstanding:
-                break
-            if ran == 0:
-                # everything left is backing off; wait for the
-                # earliest retry (bounded — backoff_s caps at
-                # 2**max_attempts rounds) unless nothing is due ever
-                waits = [entry.get("retry_at", 0.0) - time.time()
-                         for entry in
-                         (queue.entries[k] for k in outstanding)
-                         if entry["state"] == "failed"]
-                if not waits:
+        try:
+            for _ in range(queue.max_attempts + 1):
+                ran = 0
+                for info, bucket in mine:
+                    if stop or queue.state(info.path) in (DONE,
+                                                          QUARANTINED):
+                        continue
+                    if not queue.ready(info.path):
+                        continue
+                    gt = gts.get(bucket.key)
+                    if gt is None:
+                        gt = _BucketedGetTOAs(
+                            [i.path for i, b in mine
+                             if b.key == bucket.key],
+                            modelfile, bucket.key, quiet=quiet)
+                        gt.fit_batch = fitter
+                        gts[bucket.key] = gt
+                    if trace_base is not None \
+                            and bucket.key != cur_bucket:
+                        tracer.close()  # stop + ingest previous bucket
+                        tracer = contextlib.ExitStack()
+                        tracer.enter_context(obs.trace_capture(
+                            "bucket_%dx%d" % bucket.key,
+                            base_dir=trace_base))
+                        cur_bucket = bucket.key
+                    padded = (info.nchan, info.nbin) != bucket.key
+                    _fit_one(gt, queue, info, paths["checkpoint"],
+                             padded, get_toas_kw, quiet)
+                    ran += 1
+                    n_fit += 1
+                    if max_archives is not None \
+                            and n_fit >= max_archives:
+                        stop = True
+                outstanding = queue.outstanding()
+                if stop or not outstanding:
                     break
-                wait = max(0.0, min(waits))
-                if wait > 0:
-                    time.sleep(wait)
+                if ran == 0:
+                    # everything left is backing off; wait for the
+                    # earliest retry (bounded — backoff_s caps at
+                    # 2**max_attempts rounds) unless nothing is due ever
+                    waits = [entry.get("retry_at", 0.0) - time.time()
+                             for entry in
+                             (queue.entries[k] for k in outstanding)
+                             if entry["state"] == "failed"]
+                    if not waits:
+                        break
+                    wait = max(0.0, min(waits))
+                    if wait > 0:
+                        time.sleep(wait)
+        finally:
+            tracer.close()  # stop + ingest the last bucket capture
+        if rec is not None and trace_base is not None:
+            # was this run fit-bound or IO-bound?  devtime ingestion
+            # sums attributed device seconds into a run counter; the
+            # gauge compares them to this process's survey wall
+            dev_s = float(rec.counters.get("device_seconds_total", 0.0))
+            wall = time.perf_counter() - t0
+            obs.gauge("device_total_s", round(dev_s, 6))
+            obs.gauge("device_utilization",
+                      round(dev_s / wall, 4) if wall > 0 else 0.0)
         obs.event("runner_summary", process=pid, **queue.counts())
         run_dir = rec.dir if rec is not None else None
 

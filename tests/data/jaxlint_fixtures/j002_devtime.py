@@ -1,0 +1,54 @@
+"""J002 fixtures: obs.devtime / jax.named_scope misuse inside jit.
+
+The devtime layer (pulseportraiture_tpu.obs.devtime) is host-side
+file parsing by contract — under jit it would run once at trace time
+and could not see the program it is part of.  jax.named_scope itself
+is LEGITIMATE inside jit (it is how the solver's pp_* stage scopes
+reach profiler captures), but its name must be a host string: deriving
+it from a traced value forces a host sync or bakes the trace-time
+value into every execution.  docs/OBSERVABILITY.md.
+"""
+
+import jax
+
+from pulseportraiture_tpu.obs import devtime
+from pulseportraiture_tpu.obs.devtime import record_devtime
+
+
+@jax.jit
+def bad_devtime_in_jit(x):
+    devtime.summarize_region("/tmp/traces/solve")  # EXPECT: J002
+    return x * 2.0
+
+
+@jax.jit
+def bad_bare_record_devtime(x):
+    record_devtime("solve", "/tmp/traces/solve")  # EXPECT: J002
+    return x + 1.0
+
+
+@jax.jit
+def bad_dotted_obs_devtime(x):
+    from pulseportraiture_tpu import obs
+
+    obs.devtime.parse_chrome_trace("/tmp/t.json.gz")  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_named_scope_traced_name(x):
+    with jax.named_scope("mu_%s" % x.sum()):  # EXPECT: J002
+        return x * 2.0
+
+
+@jax.jit
+def ok_named_scope_static(x):
+    # the legitimate pattern: a STATIC stage label (fit/portrait.py's
+    # pp_coarse / pp_polish / pp_solve scopes)
+    with jax.named_scope("pp_coarse"):
+        return x * 2.0
+
+
+def ok_host_side_ingestion(run, region_dir):
+    # outside jit: exactly how obs.trace ingests a closed capture
+    return devtime.record_devtime(run, region_dir)

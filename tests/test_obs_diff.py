@@ -1,0 +1,217 @@
+"""tools/obs_diff.py: run-to-run regression diffing.
+
+Builds synthetic obs run directories (events.jsonl + manifest.json)
+so thresholds are exercised deterministically — no fits, no jitter.
+The acceptance-criteria case: a run whose phase time is artificially
+inflated past the threshold must exit nonzero; a self-diff must not.
+"""
+
+import json
+import os
+
+import pytest
+
+from tools.obs_diff import (bench_payload, diff_payloads, diff_runs,
+                            main, run_summary)
+
+
+def make_run(tmp_path, name, phases=None, device_phases=None,
+             wall_s=10.0, compile_s=1.0, fit=None, counters=None):
+    run = tmp_path / name
+    run.mkdir(parents=True)
+    events = []
+    t = 1.0
+    for phase, dur in (phases or {}).items():
+        events.append({"t": t, "kind": "span", "name": phase,
+                       "path": phase, "dur_s": dur})
+        t += 1.0
+    if device_phases:
+        events.append({"t": t, "kind": "devtime", "region": "arch000",
+                       "device_total_s": sum(device_phases.values()),
+                       "unattributed_s": 0.0,
+                       "phases": device_phases,
+                       "scopes": {"pp_solve":
+                                  device_phases.get("solve", 0.0)},
+                       "top_ops": {}, "n_ops": 4})
+    if fit:
+        events.append(dict({"t": t + 1.0, "kind": "fit",
+                            "where": "batch"}, **fit))
+    with open(run / "events.jsonl", "w") as fh:
+        for e in events:
+            fh.write(json.dumps(e) + "\n")
+    manifest = {"schema": "pptpu-obs-v1", "run_id": name,
+                "wall_s": wall_s, "compile_total_s": compile_s,
+                "counters": counters or {}}
+    with open(run / "manifest.json", "w") as fh:
+        json.dump(manifest, fh)
+    return str(run)
+
+
+BASE = {"load": 0.5, "solve": 4.0, "polish": 1.0, "write": 0.2}
+DEV = {"solve": 2.0, "polish": 0.5}
+FIT = {"batch": 8, "nfeval_per_subint": [5, 6, 5, 7, 5, 6, 5, 30],
+       "n_bad": 1}
+
+
+def test_self_diff_passes(tmp_path, capsys):
+    a = make_run(tmp_path, "a", BASE, DEV, fit=FIT)
+    b = make_run(tmp_path, "b", BASE, DEV, fit=FIT)
+    assert main([a, b]) == 0
+    out = capsys.readouterr().out
+    assert "no regressions" in out
+
+
+def test_inflated_phase_wall_fails(tmp_path, capsys):
+    """The acceptance case: solve wall inflated 2x past a 30%
+    threshold -> nonzero exit naming the phase."""
+    a = make_run(tmp_path, "a", BASE, DEV, fit=FIT)
+    inflated = dict(BASE, solve=8.0)
+    b = make_run(tmp_path, "b", inflated, DEV, fit=FIT)
+    assert main([a, b]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "phase.solve.wall_s" in out
+
+
+def test_inflated_device_phase_fails(tmp_path, capsys):
+    """Device-time regressions are caught independently of wall —
+    the whole point of the devtime column (wall can hide a device
+    regression behind reduced host overhead)."""
+    a = make_run(tmp_path, "a", BASE, DEV, fit=FIT)
+    b = make_run(tmp_path, "b", BASE, dict(DEV, solve=5.0), fit=FIT)
+    assert main([a, b]) == 1
+    assert "phase.solve.device_s" in capsys.readouterr().out
+
+
+def test_faster_candidate_passes(tmp_path):
+    a = make_run(tmp_path, "a", BASE, DEV, fit=FIT)
+    b = make_run(tmp_path, "b",
+                 {k: v * 0.5 for k, v in BASE.items()},
+                 {k: v * 0.5 for k, v in DEV.items()}, fit=FIT)
+    assert main([a, b]) == 0
+
+
+def test_tiny_phase_jitter_ignored(tmp_path):
+    """Phases under --min-s never fail: 2x of 10 ms is noise."""
+    a = make_run(tmp_path, "a", dict(BASE, write=0.01), DEV, fit=FIT)
+    b = make_run(tmp_path, "b", dict(BASE, write=0.02), DEV, fit=FIT)
+    assert main([a, b, "--min-s", "0.05"]) == 0
+
+
+def test_nonconvergence_increase_fails(tmp_path, capsys):
+    a = make_run(tmp_path, "a", BASE, DEV, fit=FIT)
+    b = make_run(tmp_path, "b", BASE, DEV, fit=dict(FIT, n_bad=3))
+    assert main([a, b]) == 1
+    assert "n_bad" in capsys.readouterr().out
+    # ... unless explicitly allowed
+    assert main([a, make_run(tmp_path, "c", BASE, DEV,
+                             fit=dict(FIT, n_bad=3)),
+                 "--bad-allow", "2"]) == 0
+
+
+def test_subint_count_mismatch_fails(tmp_path, capsys):
+    """A 'faster' run that fit fewer subints is not faster."""
+    a = make_run(tmp_path, "a", BASE, DEV, fit=FIT)
+    b = make_run(tmp_path, "b", BASE, DEV, fit=dict(FIT, batch=6))
+    assert main([a, b]) == 1
+    assert "fit_subints" in capsys.readouterr().out
+
+
+def test_loose_thresholds_tolerate_2x(tmp_path):
+    """The check.sh smoke-vs-smoke stage's settings: rel 5.0 must
+    tolerate ordinary machine jitter (here a 2x everywhere)."""
+    a = make_run(tmp_path, "a", BASE, DEV, fit=FIT)
+    b = make_run(tmp_path, "b",
+                 {k: v * 2.0 for k, v in BASE.items()},
+                 {k: v * 2.0 for k, v in DEV.items()}, fit=FIT,
+                 wall_s=20.0, compile_s=2.0)
+    assert main([a, b, "--rel", "5.0", "--min-s", "1.0"]) == 0
+
+
+def test_run_summary_shape(tmp_path):
+    s = run_summary(make_run(tmp_path, "a", BASE, DEV, fit=FIT,
+                             counters={"fit_batches": 1}))
+    assert s["phases"]["solve"] == 4.0
+    assert s["device_phases"]["solve"] == 2.0
+    assert s["device_total_s"] == pytest.approx(2.5)
+    assert s["nfeval_median"] == 6  # upper median of 8 values
+    assert s["fit_subints"] == 8 and s["n_bad"] == 1
+    assert s["counters"] == {"fit_batches": 1}
+
+
+def test_missing_run_is_usage_error(tmp_path, capsys):
+    assert main([str(tmp_path / "nope"), str(tmp_path / "nope2")]) == 2
+
+
+def test_obs_dir_resolves_newest_run(tmp_path):
+    """Passing the obs dir (not the run dir) works like obs_report."""
+    make_run(tmp_path / "obs", "r1", BASE, DEV, fit=FIT)
+    os.utime(tmp_path / "obs" / "r1", (1, 1))
+    make_run(tmp_path / "obs", "r2", BASE, DEV, fit=FIT)
+    assert main([str(tmp_path / "obs"), str(tmp_path / "obs")]) == 0
+
+
+# -- BENCH_*.json baseline mode -------------------------------------------
+
+def _bench_doc(value, duration):
+    return {"n": 5, "cmd": "python bench.py", "rc": 0,
+            "parsed": {"metric": "fits/sec", "value": value,
+                       "unit": "TOAs/sec", "vs_baseline": value / 16.7,
+                       "extra": {"duration_sec": duration,
+                                 "backend_fallback": False}}}
+
+
+def test_bench_payload_flattens_numeric(tmp_path):
+    p = tmp_path / "BENCH_r99.json"
+    p.write_text(json.dumps(_bench_doc(20.0, 3.0)))
+    flat = bench_payload(str(p))
+    assert flat["value"] == 20.0
+    assert flat["extra.duration_sec"] == 3.0
+    assert "metric" not in flat          # strings dropped
+    assert "extra.backend_fallback" not in flat  # bools dropped
+
+
+def test_bench_baseline_vs_run(tmp_path, capsys):
+    base = tmp_path / "BENCH_r98.json"
+    base.write_text(json.dumps(_bench_doc(20.0, 3.0)))
+    # candidate run carrying a result event payload, as bench.py emits
+    run = tmp_path / "cand"
+    run.mkdir()
+    payload = _bench_doc(19.0, 3.1)["parsed"]  # within 30%
+    with open(run / "events.jsonl", "w") as fh:
+        fh.write(json.dumps({"t": 1.0, "kind": "event",
+                             "name": "result",
+                             "payload": payload}) + "\n")
+    (run / "manifest.json").write_text("{}")
+    assert main([str(base), str(run)]) == 0
+    # throughput halved: lower-is-worse direction must fire
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    payload_bad = _bench_doc(9.0, 3.0)["parsed"]
+    with open(bad / "events.jsonl", "w") as fh:
+        fh.write(json.dumps({"t": 1.0, "kind": "event",
+                             "name": "result",
+                             "payload": payload_bad}) + "\n")
+    (bad / "manifest.json").write_text("{}")
+    capsys.readouterr()
+    assert main([str(base), str(bad)]) == 1
+    assert "value" in capsys.readouterr().out
+
+
+def test_diff_payload_direction_heuristics():
+    a = {"value": 10.0, "extra.duration_sec": 2.0}
+    # slower AND less throughput
+    d = diff_payloads(a, {"value": 5.0, "extra.duration_sec": 4.0},
+                      rel=0.3)
+    assert len(d.regressions) == 2
+    d = diff_payloads(a, {"value": 11.0, "extra.duration_sec": 1.5},
+                      rel=0.3)
+    assert not d.regressions
+
+
+def test_diff_runs_api_direct(tmp_path):
+    a = run_summary(make_run(tmp_path, "a", BASE, DEV, fit=FIT))
+    b = run_summary(make_run(tmp_path, "b", dict(BASE, solve=40.0),
+                             DEV, fit=FIT))
+    d = diff_runs(a, b, rel=0.3, min_s=0.05)
+    assert any("phase.solve.wall_s" in r for r in d.regressions)
+    assert "REGRESSION" in d.table()

@@ -1,0 +1,133 @@
+"""obs/merge.py across sink rotation: multi-file shards merge whole.
+
+A survey-scale process under ``PPTPU_OBS_MAX_BYTES`` rotates its
+events.jsonl into ``events.jsonl.1``, ``.2``, ...; ``write_shard``
+preserves the rotation suffixes and ``merge_obs_shards`` must read
+every file of every shard — an off-by-one in the rotated-set
+traversal silently drops telemetry, so the assertions here count
+events exactly and cross-check the summed fit telemetry against the
+merged manifest counters.
+"""
+
+import json
+import os
+
+import pytest
+
+from pulseportraiture_tpu import obs
+from pulseportraiture_tpu.obs.merge import (list_shards,
+                                            merge_obs_shards,
+                                            write_shard)
+
+N_FITS = 40  # events per process; small cap below forces rotation
+
+
+def _run_one_process(base_dir, proc, monkeypatch):
+    """One per-process recorder emitting enough fit events to rotate
+    several times; returns (run_dir, n_events_written, n_subints)."""
+    monkeypatch.setenv("PPTPU_OBS_MAX_BYTES", "2000")
+    n_sub = 0
+    with obs.run("shardtest-p%d" % proc, base_dir=base_dir) as rec:
+        for i in range(N_FITS):
+            batch = 2 + (i + proc) % 3
+            rec.emit("fit", where="p%d/b%d" % (proc, i), batch=batch,
+                     nfeval_per_subint=[5] * batch,
+                     rc_hist={"1": batch}, n_bad=0)
+            rec.bump("fit_batches")
+            rec.bump("fit_subints", batch)
+            n_sub += batch
+        with obs.span("solve", proc=proc):
+            pass
+        run_dir = rec.dir
+    monkeypatch.delenv("PPTPU_OBS_MAX_BYTES")
+    return run_dir, n_sub
+
+
+def test_merge_across_rotated_shards(tmp_path, monkeypatch):
+    shards_dir = str(tmp_path / "shards")
+    merged_dir = str(tmp_path / "merged")
+    totals = {}
+    for proc in (0, 1):
+        run_dir, n_sub = _run_one_process(
+            str(tmp_path / ("obs%d" % proc)), proc, monkeypatch)
+        # the recorder really rotated: multiple event files on disk
+        files = [n for n in os.listdir(run_dir)
+                 if n.startswith("events.jsonl")]
+        assert len(files) > 2, \
+            "test premise broken: no rotation happened (%s)" % files
+        written = write_shard(run_dir, shards_dir, proc)
+        # every rotated file came along, suffixes preserved
+        assert len([w for w in written
+                    if "events.%d.jsonl" % proc in w]) == len(files)
+        totals[proc] = n_sub
+
+    shards = list_shards(shards_dir)
+    assert set(shards) == {0, 1}
+    for proc, paths in shards.items():
+        assert len(paths) > 2
+        # rotated files (oldest first) before the live file
+        assert paths[-1].endswith("events.%d.jsonl" % proc)
+
+    merge_obs_shards(shards_dir, merged_dir)
+    events = [json.loads(line) for line in
+              open(os.path.join(merged_dir, "events.jsonl"))]
+
+    # no events dropped: every fit event of both processes is present
+    fits = [e for e in events if e.get("kind") == "fit"]
+    assert len(fits) == 2 * N_FITS
+    for proc in (0, 1):
+        assert len([e for e in fits if e["proc"] == proc]) == N_FITS
+
+    # telemetry sums match what each process recorded
+    merged_subints = sum(e["batch"] for e in fits)
+    assert merged_subints == totals[0] + totals[1]
+    manifest = json.load(open(os.path.join(merged_dir,
+                                           "manifest.json")))
+    assert manifest["counters"]["fit_subints"] == merged_subints
+    assert manifest["counters"]["fit_batches"] == 2 * N_FITS
+    assert manifest["n_processes"] == 2
+
+    # ordering: merged stream is globally timestamp-ordered
+    ts = [e.get("t", 0.0) for e in events]
+    assert ts == sorted(ts)
+
+    # span paths carry the process prefix
+    spans = [e for e in events if e.get("kind") == "span"]
+    assert {e["path"] for e in spans} == {"p0/solve", "p1/solve"}
+
+    # the merged run reads like any other run (report renders, fit
+    # telemetry aggregates over every shard)
+    from tools.obs_report import summarize
+
+    text = summarize(merged_dir)
+    assert "fit batches: %d" % (2 * N_FITS) in text
+    assert "subints: %d" % merged_subints in text
+
+
+def test_merge_tags_devtime_regions(tmp_path, monkeypatch):
+    """devtime events keep per-process regions but aggregate phases."""
+    shards_dir = str(tmp_path / "shards")
+    for proc in (0, 1):
+        with obs.run("dt-p%d" % proc,
+                     base_dir=str(tmp_path / ("obs%d" % proc))) as rec:
+            rec.emit("devtime", region="bucket_64x256",
+                     device_total_s=1.0, unattributed_s=0.25,
+                     phases={"solve": 0.75}, scopes={"pp_solve": 0.75},
+                     top_ops={}, n_ops=3)
+            run_dir = rec.dir
+        write_shard(run_dir, shards_dir, proc)
+    merged = merge_obs_shards(shards_dir, str(tmp_path / "merged"))
+    events = [json.loads(line) for line in
+              open(os.path.join(merged, "events.jsonl"))]
+    devs = [e for e in events if e.get("kind") == "devtime"]
+    assert {e["region"] for e in devs} == {"p0/bucket_64x256",
+                                           "p1/bucket_64x256"}
+    from tools.obs_report import devtime_phases, devtime_totals
+
+    assert devtime_phases(events) == {"solve": pytest.approx(1.5)}
+    assert devtime_totals(events)["device_total_s"] == pytest.approx(2.0)
+
+
+def test_merge_empty_shards_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        merge_obs_shards(str(tmp_path / "none"), str(tmp_path / "out"))

@@ -430,3 +430,54 @@ def test_ppsurvey_cli_roundtrip(survey, tmp_path, capsys):
     assert main(["report", "-w", wd]) == 0
     text = capsys.readouterr().out
     assert "## phases" in text and "## survey state" in text
+
+
+def test_trace_bucket_capture_and_utilization(survey, tmp_path,
+                                              monkeypatch):
+    """--trace-bucket: one profiler capture per shape bucket, ingested
+    into devtime events, with device-utilization gauges in the run —
+    and GetTOAs's own per-archive capture degrading to trace_skipped
+    instead of raising inside the bucket capture (the obs/trace.py
+    reentrancy contract)."""
+    wd = str(tmp_path / "wd")
+    # point BOTH capture layers at the same root so the inner
+    # per-archive capture genuinely attempts (and must degrade)
+    monkeypatch.setenv("PPTPU_TRACE_DIR", os.path.join(wd, "traces"))
+    summary = run_survey(survey.plan, wd, process_index=0,
+                         process_count=1, bary=False,
+                         trace_bucket=True)
+    monkeypatch.delenv("PPTPU_TRACE_DIR")
+    assert summary["counts"]["done"] == 12
+
+    regions = sorted(os.listdir(os.path.join(wd, "traces")))
+    assert regions == ["bucket_16x128", "bucket_8x64"]
+
+    from pulseportraiture_tpu.obs import list_event_files
+
+    events = []
+    for path in list_event_files(summary["obs_run"]):
+        with open(path) as fh:
+            events.extend(json.loads(ln) for ln in fh if ln.strip())
+    devs = [e for e in events if e.get("kind") == "devtime"]
+    assert {e["region"] for e in devs} == {"bucket_8x64",
+                                           "bucket_16x128"}
+    assert all(e["device_total_s"] > 0.0 for e in devs)
+    # the inner per-archive captures degraded, one per fitted archive
+    skipped = [e for e in events if e.get("name") == "trace_skipped"]
+    assert len(skipped) == 12
+    assert all(s["active_region"].startswith("bucket_")
+               for s in skipped)
+
+    man = json.load(open(os.path.join(summary["obs_run"],
+                                      "manifest.json")))
+    assert man["gauges"]["device_total_s"] > 0.0
+    assert 0.0 <= man["gauges"]["device_utilization"] <= 8.0
+    assert man["counters"]["devtime_regions"] == 2
+    assert man["config"]["trace_bucket"] is True
+
+    # the report answers "where did the device time go"
+    from tools.obs_report import summarize
+
+    text = summarize(summary["obs_run"])
+    assert "## device time (named-scope attribution)" in text
+    assert "device busy:" in text

@@ -6,10 +6,14 @@
 #                 configuration lives in pyproject.toml [tool.ruff])
 #   3. obs smoke — tiny synthetic pptoas run must emit a valid
 #                 manifest + event stream (docs/OBSERVABILITY.md)
-#   4. runner smoke — tiny synthetic survey through the shape-bucketed
+#   4. obs diff  — a second smoke run self-diffed against the first
+#                 with loose thresholds: tools/obs_diff.py must see no
+#                 regression between two identical pipelines (and its
+#                 exit code is how real regressions will fail CI)
+#   5. runner smoke — tiny synthetic survey through the shape-bucketed
 #                 runner: 2 done + 1 quarantined + merged obs run
 #                 (docs/RUNNER.md)
-#   5. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
+#   6. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
 #
 # Exit status is non-zero when any stage fails.
 set -u
@@ -30,7 +34,8 @@ fi
 
 echo
 echo "== obs smoke (manifest + events, docs/OBSERVABILITY.md) =="
-timeout -k 10 300 env JAX_PLATFORMS=cpu PPTPU_OBS_DIR="" \
+obsdiff_dir=$(mktemp -d /tmp/_obs_diff.XXXXXX)
+timeout -k 10 300 env JAX_PLATFORMS=cpu PPTPU_OBS_DIR="$obsdiff_dir/a" \
     python -m tools.obs_smoke >/tmp/_obs_smoke.log 2>&1
 if [ $? -ne 0 ]; then
     tail -40 /tmp/_obs_smoke.log
@@ -38,6 +43,21 @@ if [ $? -ne 0 ]; then
 else
     tail -1 /tmp/_obs_smoke.log
 fi
+
+echo
+echo "== obs diff (smoke-vs-smoke self-diff, tools/obs_diff.py) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu PPTPU_OBS_DIR="$obsdiff_dir/b" \
+    python -m tools.obs_smoke >/tmp/_obs_smoke2.log 2>&1 \
+&& timeout -k 10 60 env JAX_PLATFORMS=cpu \
+    python -m tools.obs_diff "$obsdiff_dir/a" "$obsdiff_dir/b" \
+    --rel 5.0 --min-s 1.0 >/tmp/_obs_diff.log 2>&1
+if [ $? -ne 0 ]; then
+    tail -40 /tmp/_obs_diff.log 2>/dev/null || tail -40 /tmp/_obs_smoke2.log
+    fail=1
+else
+    tail -1 /tmp/_obs_diff.log
+fi
+rm -rf "$obsdiff_dir"
 
 echo
 echo "== runner smoke (shape-bucketed survey, docs/RUNNER.md) =="

@@ -44,6 +44,15 @@ _OBS_API_NAMES = {"span", "phases", "event", "counter", "gauge",
                   "trace_capture"}
 _OBS_BARE_CALLS = {"fit_telemetry", "trace_capture"}
 
+# obs.devtime (profiler-capture ingestion): host-side FILE PARSING by
+# contract — inside jit it would read gigabyte traces at trace time
+# and its result could never feed compiled code.  Matched as
+# ``devtime.<name>`` / ``obs.devtime.<name>`` or the bare imports.
+_DEVTIME_API_NAMES = {"record_devtime", "summarize_region",
+                      "summarize_trace_dir", "parse_chrome_trace",
+                      "parse_xplane_scopes", "self_times",
+                      "find_capture"}
+
 # survey-runner API (pulseportraiture_tpu.runner): host-side
 # orchestration by contract — file IO (header scans, JSONL ledger
 # appends, checkpoint rewrites) and process partitioning have no
@@ -351,6 +360,26 @@ class RuleVisitor(ast.NodeVisitor):
                           "once, at trace time) and fit telemetry "
                           "would sync a traced value; move it after "
                           "the jit boundary (docs/OBSERVABILITY.md)")
+            elif fname is not None and (
+                    fname.rsplit(".", 1)[-1] in _DEVTIME_API_NAMES
+                    and (fname in _DEVTIME_API_NAMES
+                         or fname.startswith(("devtime.",
+                                              "obs.devtime.")))):
+                self._add("J002", node,
+                          "obs.devtime call inside a jitted function "
+                          "— profiler-capture ingestion is host-side "
+                          "file parsing; under jit it runs once at "
+                          "trace time and cannot see the program it "
+                          "is part of (docs/OBSERVABILITY.md)")
+            elif fname in ("jax.named_scope", "named_scope") and \
+                    node.args and self._refs_traced(node.args[0]):
+                self._add("J002", node,
+                          "jax.named_scope name derived from a traced "
+                          "value — the name must be a host string; "
+                          "formatting a traced value into it forces a "
+                          "host sync (or burns the value seen at "
+                          "trace time into every execution); use a "
+                          "static label (docs/OBSERVABILITY.md)")
             elif fname is not None and (
                     (fname.startswith("runner.")
                      and fname.split(".", 1)[1] in _RUNNER_API_NAMES)

@@ -1,0 +1,298 @@
+"""Diff two observability runs and exit nonzero on regression.
+
+The obs layer makes runs comparable; this tool makes the comparison
+mechanical so a perf regression fails a gate instead of waiting for a
+human to eyeball two reports:
+
+    python -m tools.obs_diff <baseline> <candidate> [thresholds]
+    python -m tools.obs_diff BENCH_r05.json <candidate-run>
+
+``baseline``/``candidate`` are obs run directories (or obs dirs — the
+newest run inside is used, like tools/obs_report.py).  Either side may
+instead be a ``BENCH_*.json`` file (the committed bench driver line):
+the comparison then runs over the flattened numeric fields of its
+``parsed`` payload against the candidate run's ``result`` event — the
+two are the same bytes by construction (bench/obs unification), so a
+run can be diffed against committed history directly.
+
+What is compared (run-vs-run mode):
+
+* per-phase wall seconds and device seconds (the named-scope
+  ``devtime`` attribution) — relative threshold ``--rel``, phases
+  whose baseline is under ``--min-s`` are reported but never fail
+  (tiny phases are all jitter);
+* ``compile_total_s`` — ``--compile-rel`` (compile time through a
+  remote tunnel is noisy; default is looser than ``--rel``);
+* convergence: non-converged subints may not increase by more than
+  ``--bad-allow``; the nfeval median obeys ``--rel``;
+* counters: ``fit_subints`` (work actually done) must match exactly —
+  a "faster" run that fit fewer subints is not faster.
+
+Exit status: 0 = no regression, 1 = regression(s), 2 = usage/IO error.
+Wired into tools/check.sh as a smoke-vs-smoke self-diff stage (two
+identical pipelines must pass the loose default thresholds).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+from tools.obs_report import (devtime_phases, devtime_totals,
+                              find_run_dir, load_run, result_payload)
+
+# metric-name direction heuristics for BENCH payload mode
+_LOWER_IS_WORSE = ("per_sec", "fits_per_sec", "toas_per_sec", "value",
+                   "vs_baseline", "gflops")
+_HIGHER_IS_WORSE = ("_sec", "_s", "_ns", "duration", "overhead",
+                    "resid", "err")
+
+
+def run_summary(run_dir):
+    """The comparable slice of one run: phases, device time, compile,
+    convergence, counters."""
+    manifest, events = load_run(run_dir)
+    phases = {}
+    for e in events:
+        if e.get("kind") == "span":
+            name = e.get("name") or "?"
+        elif e.get("kind") == "compile":
+            name = "compile"
+        else:
+            continue
+        try:
+            dur = float(e.get("dur_s") or 0.0)
+        except (TypeError, ValueError):
+            dur = 0.0
+        phases[name] = phases.get(name, 0.0) + dur
+    nfev = []
+    n_bad = n_sub = 0
+    for e in events:
+        if e.get("kind") != "fit":
+            continue
+        nfev.extend(x for x in (e.get("nfeval_per_subint") or [])
+                    if isinstance(x, (int, float)))
+        n_bad += int(e.get("n_bad") or 0)
+        n_sub += int(e.get("batch") or 0)
+    counters = {k: v for k, v in (manifest.get("counters") or {}).items()
+                if isinstance(v, (int, float))}
+    return {
+        "run_dir": run_dir,
+        "wall_s": float(manifest.get("wall_s") or 0.0),
+        "compile_total_s": float(manifest.get("compile_total_s") or 0.0),
+        "phases": phases,
+        "device_phases": devtime_phases(events),
+        "device_total_s": devtime_totals(events)["device_total_s"],
+        "nfeval_median": (sorted(nfev)[len(nfev) // 2] if nfev else None),
+        "n_bad": n_bad,
+        "fit_subints": n_sub,
+        "counters": counters,
+    }
+
+
+def _flatten(obj, prefix=""):
+    """{'extra.duration_sec': 1.2, ...} numeric leaves of a payload."""
+    out = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.update(_flatten(v, prefix + str(k) + "."))
+    elif isinstance(obj, bool):
+        pass
+    elif isinstance(obj, (int, float)):
+        out[prefix.rstrip(".")] = float(obj)
+    return out
+
+
+def bench_payload(path):
+    """Numeric metrics of a BENCH_*.json driver line (its ``parsed``
+    payload when present, else the document itself)."""
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    payload = doc.get("parsed") if isinstance(doc, dict) else None
+    if not isinstance(payload, dict):
+        payload = doc if isinstance(doc, dict) else {}
+    return _flatten(payload)
+
+
+class Diff:
+    """Accumulates comparison rows and regression verdicts."""
+
+    def __init__(self):
+        self.rows = []       # (metric, a, b, ratio_str, verdict)
+        self.regressions = []
+
+    def check(self, metric, a, b, rel, floor=0.0, lower_is_worse=False):
+        """Compare baseline ``a`` vs candidate ``b`` under a relative
+        threshold; baselines under ``floor`` are informational only."""
+        if a is None or b is None:
+            self.rows.append((metric, _fmt(a), _fmt(b), "-",
+                              "missing" if a is None or b is None
+                              else "ok"))
+            return
+        ratio = (b / a) if a else None
+        worse = (b < a * (1.0 - rel)) if lower_is_worse \
+            else (b > a * (1.0 + rel))
+        gated = max(abs(a), abs(b)) >= floor
+        if worse and gated:
+            verdict = "REGRESSION"
+            self.regressions.append(
+                "%s: %s -> %s (rel threshold %.2f)"
+                % (metric, _fmt(a), _fmt(b), rel))
+        elif worse:
+            verdict = "jitter (< min-s)"
+        else:
+            verdict = "ok"
+        self.rows.append((metric, _fmt(a), _fmt(b),
+                          "%.2fx" % ratio if ratio is not None else "-",
+                          verdict))
+
+    def exact(self, metric, a, b):
+        if a != b:
+            self.regressions.append("%s: %s != %s" % (metric, a, b))
+            self.rows.append((metric, a, b, "-", "MISMATCH"))
+        else:
+            self.rows.append((metric, a, b, "-", "ok"))
+
+    def table(self):
+        headers = ["metric", "baseline", "candidate", "ratio", "verdict"]
+        out = ["| " + " | ".join(headers) + " |",
+               "|" + "|".join("---" for _ in headers) + "|"]
+        for row in self.rows:
+            out.append("| " + " | ".join(str(c) for c in row) + " |")
+        return "\n".join(out)
+
+
+def _fmt(x):
+    if x is None:
+        return "-"
+    if isinstance(x, float):
+        return "%.6g" % x
+    return str(x)
+
+
+def diff_runs(a, b, rel=0.3, min_s=0.05, compile_rel=None,
+              bad_allow=0):
+    """Diff two run summaries; returns a :class:`Diff`."""
+    if compile_rel is None:
+        compile_rel = max(rel, 1.0)
+    d = Diff()
+    for phase in sorted(set(a["phases"]) | set(b["phases"])):
+        d.check("phase.%s.wall_s" % phase, a["phases"].get(phase),
+                b["phases"].get(phase), rel, floor=min_s)
+    for phase in sorted(set(a["device_phases"])
+                        | set(b["device_phases"])):
+        d.check("phase.%s.device_s" % phase,
+                a["device_phases"].get(phase),
+                b["device_phases"].get(phase), rel, floor=min_s)
+    d.check("wall_s", a["wall_s"] or None, b["wall_s"] or None, rel,
+            floor=min_s)
+    d.check("compile_total_s", a["compile_total_s"],
+            b["compile_total_s"], compile_rel, floor=min_s)
+    if a["device_total_s"] or b["device_total_s"]:
+        d.check("device_total_s", a["device_total_s"],
+                b["device_total_s"], rel, floor=min_s)
+    if a["nfeval_median"] is not None or b["nfeval_median"] is not None:
+        d.check("nfeval_median", a["nfeval_median"], b["nfeval_median"],
+                rel)
+    if a["fit_subints"] or b["fit_subints"]:
+        d.exact("fit_subints", a["fit_subints"], b["fit_subints"])
+        nb_a, nb_b = a["n_bad"], b["n_bad"]
+        if nb_b > nb_a + bad_allow:
+            d.regressions.append(
+                "n_bad (non-converged subints): %d -> %d (+%d allowed)"
+                % (nb_a, nb_b, bad_allow))
+            d.rows.append(("n_bad", nb_a, nb_b, "-", "REGRESSION"))
+        else:
+            d.rows.append(("n_bad", nb_a, nb_b, "-", "ok"))
+    return d
+
+
+def diff_payloads(a, b, rel=0.3):
+    """Diff flattened numeric payloads (BENCH mode) over shared keys,
+    using name-based direction heuristics; returns a :class:`Diff`."""
+    d = Diff()
+    for key in sorted(set(a) & set(b)):
+        lower_worse = any(tok in key for tok in _LOWER_IS_WORSE)
+        higher_worse = any(key.endswith(tok) or tok in key
+                           for tok in _HIGHER_IS_WORSE)
+        if lower_worse:
+            d.check(key, a[key], b[key], rel, lower_is_worse=True)
+        elif higher_worse:
+            d.check(key, a[key], b[key], rel)
+        else:
+            d.rows.append((key, _fmt(a[key]), _fmt(b[key]), "-",
+                           "info"))
+    if not d.rows:
+        d.regressions.append("no shared numeric metrics to compare")
+    return d
+
+
+def _load_side(path):
+    """('payload', metrics) for a BENCH json, ('run', summary) for an
+    obs run directory."""
+    if os.path.isfile(path) and path.endswith(".json"):
+        return "payload", bench_payload(path)
+    run_dir = find_run_dir(path)
+    return "run", run_dir
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="obs_diff",
+        description="Diff two obs runs (or a BENCH_*.json baseline vs "
+                    "a run) and exit nonzero on regression "
+                    "(docs/OBSERVABILITY.md).")
+    p.add_argument("baseline", help="Obs run dir / obs dir / BENCH json")
+    p.add_argument("candidate", help="Obs run dir / obs dir / BENCH json")
+    p.add_argument("--rel", type=float, default=0.3,
+                   help="Relative regression threshold (default 0.3 = "
+                        "30%% worse fails).")
+    p.add_argument("--min-s", type=float, default=0.05, dest="min_s",
+                   help="Phases/timers whose baseline AND candidate "
+                        "are under this many seconds never fail "
+                        "(jitter floor, default 0.05).")
+    p.add_argument("--compile-rel", type=float, default=None,
+                   dest="compile_rel",
+                   help="Threshold for compile_total_s (default: "
+                        "max(--rel, 1.0) — compiles are noisy).")
+    p.add_argument("--bad-allow", type=int, default=0, dest="bad_allow",
+                   help="Allowed increase in non-converged subints "
+                        "(default 0).")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    try:
+        kind_a, side_a = _load_side(args.baseline)
+        kind_b, side_b = _load_side(args.candidate)
+    except (FileNotFoundError, OSError, json.JSONDecodeError) as e:
+        print("obs_diff: %s" % e, file=sys.stderr)
+        return 2
+    if kind_a == "payload" or kind_b == "payload":
+        a = side_a if kind_a == "payload" \
+            else _flatten(result_payload(side_a) or {})
+        b = side_b if kind_b == "payload" \
+            else _flatten(result_payload(side_b) or {})
+        d = diff_payloads(a, b, rel=args.rel)
+        print("# obs diff (payload mode): %s vs %s"
+              % (args.baseline, args.candidate))
+    else:
+        d = diff_runs(run_summary(side_a), run_summary(side_b),
+                      rel=args.rel, min_s=args.min_s,
+                      compile_rel=args.compile_rel,
+                      bad_allow=args.bad_allow)
+        print("# obs diff: %s vs %s" % (side_a, side_b))
+    print(d.table())
+    if d.regressions:
+        print()
+        for r in d.regressions:
+            print("REGRESSION: %s" % r)
+        print("obs_diff: %d regression(s)" % len(d.regressions))
+        return 1
+    print("obs_diff: no regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

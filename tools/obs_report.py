@@ -9,12 +9,20 @@ convergence tables PERF.md used to maintain by hand:
     python -m tools.obs_report                  # $PPTPU_OBS_DIR newest
 
 Sections: run header (platform, git SHA, wall), the phase-span table
-(load / compile / solve / polish / write, plus whatever else the run
-emitted — "compile" is synthesized from the jax.monitoring compile
-events, attributed to the span they fired inside), fit-quality
-telemetry aggregated over every batched solve (nfeval, reduced chi2,
-return-code histogram, non-converged subints), and the counters/gauges
-from the closed manifest.
+(load / compile / guess / solve / polish / write, plus whatever else
+the run emitted — "compile" is synthesized from the jax.monitoring
+compile events, attributed to the span they fired inside; the
+``device_s`` column is populated from the run's ``devtime`` events,
+i.e. from ingested profiler captures attributed by ``pp_*`` named
+scope — obs/devtime.py), device-time attribution per scope when
+captures exist, fit-quality telemetry aggregated over every batched
+solve (nfeval, reduced chi2, return-code histogram, non-converged
+subints), and the counters/gauges from the closed manifest.
+
+Degenerate runs render rather than raise: a run holding only a
+manifest, a crashed run with a torn manifest, zero archives, or an
+event stream with no spans all produce a (short) report — the report
+is a debugging tool and must work hardest on broken runs.
 """
 
 import json
@@ -36,8 +44,13 @@ def find_run_dir(path=None):
     if os.path.isfile(os.path.join(path, "events.jsonl")) or \
             os.path.isfile(os.path.join(path, "manifest.json")):
         return path
-    runs = [os.path.join(path, d) for d in os.listdir(path)
-            if os.path.isfile(os.path.join(path, d, "manifest.json"))]
+    try:
+        names = os.listdir(path)
+    except OSError as e:
+        raise FileNotFoundError(str(e))
+    runs = [os.path.join(path, d) for d in names
+            if os.path.isfile(os.path.join(path, d, "manifest.json"))
+            or os.path.isfile(os.path.join(path, d, "events.jsonl"))]
     if not runs:
         raise FileNotFoundError("no obs runs under %s" % path)
     return max(runs, key=os.path.getmtime)
@@ -50,15 +63,20 @@ def load_events(run_dir):
 
     events = []
     for epath in list_event_files(run_dir):
-        with open(epath, encoding="utf-8") as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    events.append(json.loads(line))
-                except json.JSONDecodeError:
-                    pass  # a torn tail line from a crashed run
+        try:
+            with open(epath, encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn tail line from a crashed run
+                    if isinstance(ev, dict):
+                        events.append(ev)
+        except OSError:
+            pass
     return events
 
 
@@ -78,17 +96,42 @@ def result_payload(run_dir):
 
 
 def load_run(run_dir):
-    """(manifest dict, list of event dicts) for one run directory."""
+    """(manifest dict, list of event dicts) for one run directory.
+
+    A missing or torn manifest degrades to ``{}`` — a crashed run must
+    still render its event stream.
+    """
     manifest = {}
     mpath = os.path.join(run_dir, "manifest.json")
     if os.path.isfile(mpath):
-        with open(mpath, encoding="utf-8") as fh:
-            manifest = json.load(fh)
+        try:
+            with open(mpath, encoding="utf-8") as fh:
+                loaded = json.load(fh)
+            if isinstance(loaded, dict):
+                manifest = loaded
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+            pass
     return manifest, load_events(run_dir)
+
+
+def _num(x, default=0.0):
+    """Float of a JSON field that should be numeric; garbage -> default
+    (a report over a half-written stream must not raise)."""
+    try:
+        v = float(x)
+    except (TypeError, ValueError):
+        return default
+    return v if v == v else default  # NaN -> default
 
 
 def _fmt_s(x):
     return "%.3f" % x
+
+
+def _fmt_dev(x):
+    """Device seconds: finer grain than wall (a tiny CPU smoke capture
+    attributes tens of microseconds, which %.3f would render as 0)."""
+    return "%.6f" % x
 
 
 def _table(headers, rows):
@@ -104,32 +147,92 @@ def _phase_key(name):
     try:
         return (0, _PHASE_ORDER.index(name))
     except ValueError:
-        return (1, name)
+        return (1, str(name))
 
 
-def summarize_spans(events):
+def devtime_phases(events):
+    """Device seconds per pipeline phase, summed over every ``devtime``
+    event (one per ingested profiler capture — obs/devtime.py)."""
+    phases = {}
+    for e in events:
+        if e.get("kind") != "devtime":
+            continue
+        for phase, secs in (e.get("phases") or {}).items():
+            phases[phase] = phases.get(phase, 0.0) + _num(secs)
+    return phases
+
+
+def devtime_totals(events):
+    """Aggregate device totals over every devtime event:
+    {"device_total_s", "unattributed_s", "n_regions", "scopes"}."""
+    total = unattr = 0.0
+    scopes = {}
+    n = 0
+    for e in events:
+        if e.get("kind") != "devtime":
+            continue
+        n += 1
+        total += _num(e.get("device_total_s"))
+        unattr += _num(e.get("unattributed_s"))
+        for k, v in (e.get("scopes") or {}).items():
+            scopes[k] = scopes.get(k, 0.0) + _num(v)
+    return {"device_total_s": total, "unattributed_s": unattr,
+            "n_regions": n, "scopes": scopes}
+
+
+def summarize_spans(events, dev_phases=None):
     """Aggregate span events by phase name; compile events synthesize
-    their own phase row (duration reported by jax.monitoring)."""
+    their own phase row (duration reported by jax.monitoring).  The
+    ``device_s`` column carries the named-scope-attributed device
+    seconds of each phase ("-" when no capture touched it)."""
+    if dev_phases is None:
+        dev_phases = devtime_phases(events)
     agg = {}
     for e in events:
         if e.get("kind") == "span":
-            name = e.get("name", "?")
+            name = e.get("name") or "?"
         elif e.get("kind") == "compile":
             name = "compile"
         else:
             continue
         a = agg.setdefault(name, {"count": 0, "total": 0.0, "max": 0.0})
-        dur = float(e.get("dur_s", 0.0))
+        dur = _num(e.get("dur_s"))
         a["count"] += 1
         a["total"] += dur
         a["max"] = max(a["max"], dur)
+    for name in dev_phases:  # capture of a phase no span recorded
+        agg.setdefault(name, {"count": 0, "total": 0.0, "max": 0.0})
     rows = []
     for name in sorted(agg, key=_phase_key):
         a = agg[name]
+        dev = dev_phases.get(name)
         rows.append([name, a["count"], _fmt_s(a["total"]),
-                     _fmt_s(a["total"] / a["count"]), _fmt_s(a["max"])])
-    return _table(["phase", "n", "total_s", "mean_s", "max_s"], rows) \
-        if rows else "(no span events)"
+                     _fmt_s(a["total"] / a["count"]) if a["count"]
+                     else "-",
+                     _fmt_s(a["max"]),
+                     _fmt_dev(dev) if dev is not None else "-"])
+    return _table(["phase", "n", "total_s", "mean_s", "max_s",
+                   "device_s"], rows) if rows else "(no span events)"
+
+
+def summarize_devtime(events):
+    """The device-time attribution section: per-scope table + totals,
+    or None when the run ingested no profiler capture."""
+    tot = devtime_totals(events)
+    if not tot["n_regions"]:
+        return None
+    lines = ["device total: %ss over %d capture(s)   unattributed: %ss"
+             % (_fmt_dev(tot["device_total_s"]), tot["n_regions"],
+                _fmt_dev(tot["unattributed_s"]))]
+    if tot["scopes"]:
+        rows = [[k, _fmt_dev(v)]
+                for k, v in sorted(tot["scopes"].items(),
+                                   key=lambda kv: -kv[1])]
+        lines.append(_table(["scope", "device_s"], rows))
+    else:
+        lines.append("(no pp_* named scopes in the captures — device "
+                     "time is unattributed)")
+    return "\n".join(lines)
 
 
 def summarize_compiles(events):
@@ -141,7 +244,7 @@ def summarize_compiles(events):
         key = e.get("span") or "(outside any span)"
         c = per_span.setdefault(key, {"count": 0, "total": 0.0})
         c["count"] += 1
-        c["total"] += float(e.get("dur_s", 0.0))
+        c["total"] += _num(e.get("dur_s"))
     if not per_span:
         return None
     rows = [[k, v["count"], _fmt_s(v["total"])]
@@ -158,13 +261,14 @@ def summarize_fits(events):
     nfev, chi2, rc_hist = [], [], {}
     n_bad = n_sub = 0
     for e in fits:
-        nfev.extend(e.get("nfeval_per_subint", []))
-        chi2.extend(c for c in e.get("red_chi2_per_subint", [])
-                    if c is not None)
+        nfev.extend(x for x in (e.get("nfeval_per_subint") or [])
+                    if isinstance(x, (int, float)))
+        chi2.extend(c for c in (e.get("red_chi2_per_subint") or [])
+                    if isinstance(c, (int, float)))
         for k, v in (e.get("rc_hist") or {}).items():
             rc_hist[k] = rc_hist.get(k, 0) + v
-        n_bad += int(e.get("n_bad", 0))
-        n_sub += int(e.get("batch", 0))
+        n_bad += int(_num(e.get("n_bad")))
+        n_sub += int(_num(e.get("batch")))
     lines = ["fit batches: %d   subints: %d   non-converged: %d"
              % (len(fits), n_sub, n_bad)]
     if nfev:
@@ -173,8 +277,7 @@ def summarize_fits(events):
                      % (s[0], s[len(s) // 2],
                         s[min(len(s) - 1, int(0.9 * len(s)))], s[-1]))
     fin = sorted(c for c in chi2
-                 if isinstance(c, (int, float)) and c == c
-                 and abs(c) != float("inf"))
+                 if c == c and abs(c) != float("inf"))
     if fin:
         lines.append("red_chi2: median %.4f / max %.4f"
                      % (fin[len(fin) // 2], fin[-1]))
@@ -202,13 +305,34 @@ def summarize(run_dir):
             head.append("%s: %s" % (key, manifest[key]))
     if manifest.get("backend_error"):
         head.append("backend_error: %s" % manifest["backend_error"])
-    out.append("  ".join(head))
+    if head:
+        out.append("  ".join(head))
+    if not events and not manifest:
+        out.append("(empty run: no readable manifest or events)")
     cfg = manifest.get("config") or {}
     if cfg:
-        out.append("config: " + json.dumps(cfg, sort_keys=True))
+        try:
+            out.append("config: " + json.dumps(cfg, sort_keys=True))
+        except (TypeError, ValueError):
+            pass
     out.append("")
     out.append("## phases")
-    out.append(summarize_spans(events))
+    dev_phases = devtime_phases(events)
+    out.append(summarize_spans(events, dev_phases))
+    dev = summarize_devtime(events)
+    if dev:
+        out.append("")
+        out.append("## device time (named-scope attribution)")
+        out.append(dev)
+        # fit-bound or IO-bound?  device-busy seconds vs the run wall
+        wall = _num(manifest.get("wall_s"))
+        tot = devtime_totals(events)["device_total_s"]
+        if wall > 0:
+            out.append("device busy: %ss over %ss wall (%.1f%%; "
+                       "captured regions only — device <= wall need "
+                       "not hold per phase, see docs/OBSERVABILITY.md)"
+                       % (_fmt_dev(tot), _fmt_s(wall),
+                          100.0 * tot / wall))
     comp = summarize_compiles(events)
     if comp:
         out.append("")
@@ -240,10 +364,14 @@ def summarize(run_dir):
         out.append(json.dumps(results[-1]))
     n_traces = sum(1 for e in events if e.get("kind") == "event"
                    and e.get("name") == "trace")
+    n_skipped = sum(1 for e in events if e.get("kind") == "event"
+                    and e.get("name") == "trace_skipped")
     if n_traces:
         out.append("")
         out.append("profiler traces captured: %d (PPTPU_TRACE_DIR)"
-                   % n_traces)
+                   % n_traces + (
+                       "; %d nested capture(s) skipped" % n_skipped
+                       if n_skipped else ""))
     return "\n".join(out) + "\n"
 
 

@@ -1,43 +1,45 @@
-"""Condense a jax.profiler Chrome trace into a committable op table.
+"""Condense a jax.profiler capture into a committable op table.
 
 Usage: python tools/trace_summary.py .jax_profile/scattering > out.json
-Finds the newest vm.trace.json.gz under the given directory and emits
-the top device ops by total duration (host python frames excluded) —
-the artifact PERF.md's decomposition tables are built from.
+
+Thin CLI shim over the one trace-reading code path,
+:mod:`pulseportraiture_tpu.obs.devtime` (which also feeds the obs
+``devtime`` events and the report's device column): finds the newest
+capture under the given region directory and emits the top device ops
+by SELF duration plus the ``pp_*`` named-scope attribution.  Unlike
+the pre-devtime version of this tool, durations are nesting-corrected
+— program-level (``jit_*``) and while-loop container rows no longer
+double-count their children, so rows partition device time and MAY be
+summed.  PERF.md's decomposition tables are built from this artifact.
 """
 
-import collections
-import glob
-import gzip
 import json
 import os
 import sys
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from pulseportraiture_tpu.obs import devtime  # noqa: E402
+
 
 def summarize(trace_dir, top=40):
-    paths = sorted(glob.glob(os.path.join(
-        trace_dir, "**", "*.trace.json.gz"), recursive=True))
-    if not paths:
+    summary = devtime.summarize_region(trace_dir, top=top)
+    if summary is None:
         raise SystemExit(f"no trace under {trace_dir}")
-    path = paths[-1]
-    d = json.load(gzip.open(path))
-    tot = collections.Counter()
-    for e in d.get("traceEvents", []):
-        if e.get("ph") == "X" and "dur" in e:
-            nm = e.get("name", "")
-            if nm.startswith("$") or "np.asarray" in nm:
-                continue  # host python frames
-            tot[nm] += e["dur"]
     return {
-        "trace": os.path.relpath(path, os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__)))),
-        "note": "durations are summed per event name over NESTED "
-                "Chrome-trace spans: program-level (jit_*) and "
-                "while-loop rows CONTAIN their child ops, so rows do "
-                "not partition device time and must not be added "
-                "across nesting levels",
-        "top_ops_seconds": {nm: round(us / 1e6, 4)
-                            for nm, us in tot.most_common(top)},
+        "trace": os.path.relpath(summary["trace"], _REPO),
+        "note": "durations are SELF times (nesting-corrected per "
+                "thread): container rows (jit_* programs, while "
+                "loops) exclude their children, so rows partition "
+                "device time and may be summed "
+                "(pulseportraiture_tpu/obs/devtime.py)",
+        "device_total_seconds": summary["device_total_s"],
+        "unattributed_seconds": summary["unattributed_s"],
+        "scopes_seconds": summary["scopes"],
+        "top_ops_seconds": {k: round(v, 4)
+                            for k, v in summary["top_ops"].items()},
     }
 
 
