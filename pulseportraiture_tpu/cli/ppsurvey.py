@@ -57,6 +57,25 @@ def build_parser():
         r.add_argument("--max_archives", type=int, default=None,
                        help="Stop after this many fit attempts "
                             "(incremental runs).")
+        r.add_argument("--watchdog", type=float, default=None,
+                       metavar="S", dest="watchdog_s",
+                       help="Per-archive dispatch watchdog [s]: a "
+                            "hung dispatch is requeued (and the "
+                            "event recorded) instead of wedging the "
+                            "run.  Pick it above the bucket's worst "
+                            "first-compile time.")
+        r.add_argument("--barrier_timeout", type=float, default=600.0,
+                       metavar="S", dest="barrier_timeout_s",
+                       help="Pre-merge multihost barrier timeout [s]; "
+                            "a straggler is recorded and the merge "
+                            "proceeds over the shards that exist.")
+        r.add_argument("--nonfinite_max_frac", type=float, default=0.5,
+                       metavar="F",
+                       help="Quarantine an archive when more than "
+                            "this fraction of its live channels is "
+                            "NaN/Inf (below it, bad channels are "
+                            "zero-weighted and counted as "
+                            "n_nonfinite_zapped).")
         r.add_argument("--mesh", action="store_true", dest="use_mesh",
                        help="Shard each bucket batch over the local "
                             "device mesh.")
@@ -118,13 +137,33 @@ def _cmd_run(args):
         process_count=args.processes, max_attempts=args.max_attempts,
         backoff_s=args.backoff, use_mesh=args.use_mesh,
         merge=args.merge, max_archives=args.max_archives,
-        trace_bucket=args.trace_bucket, quiet=args.quiet,
+        trace_bucket=args.trace_bucket, watchdog_s=args.watchdog_s,
+        barrier_timeout_s=args.barrier_timeout_s, quiet=args.quiet,
         tscrunch=args.tscrunch, bary=args.bary,
-        fit_scat=args.fit_scat)
-    print(json.dumps({"counts": summary["counts"],
-                      "quarantined": summary["quarantined"],
-                      "checkpoint": summary["checkpoint"]}))
-    return 0 if not summary["counts"].get("failed") else 1
+        fit_scat=args.fit_scat,
+        nonfinite_max_frac=args.nonfinite_max_frac)
+    out = {"counts": summary["counts"],
+           "quarantined": summary["quarantined"],
+           "checkpoint": summary["checkpoint"]}
+    if summary.get("drained"):
+        out["drained"] = summary["drained"]
+    if summary.get("barrier_timeout"):
+        out["barrier_timeout"] = summary["barrier_timeout"]
+    print(json.dumps(out))
+    # a drained run exits 0: preemption is a scheduled event, not a
+    # failure — 'ppsurvey resume' continues it
+    rc = 0 if not summary["counts"].get("failed") \
+        or summary.get("drained") else 1
+    from ..runner.execute import abandoned_workers
+
+    if abandoned_workers(grace_s=1.0):
+        # a watchdog-abandoned worker is wedged inside native code;
+        # interpreter teardown would abort (std::terminate) AFTER all
+        # state is safely flushed — skip teardown, keep the exit code
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(rc)
+    return rc
 
 
 def _cmd_status(args):

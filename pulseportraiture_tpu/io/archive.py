@@ -13,6 +13,7 @@ import numpy as np
 
 from ..config import host_stats_device
 from ..ops.fourier import get_bin_centers
+from ..testing import faults
 from ..ops.noise import get_SNR, get_noise
 from ..utils.databunch import DataBunch
 from ..utils.mjd import MJD
@@ -56,6 +57,10 @@ def load_data(filename, state=None, dedisperse=False, dededisperse=False,
     noise_stds [nsub, npol, nchan], SNRs, ok_isubs, ok_ichans, Ps,
     epochs, phases, prof, flux_prof, plus observation metadata.
     """
+    # chaos site: an injected read fault surfaces exactly like a
+    # truncated payload or NFS blip (testing/faults.py)
+    faults.check("archive_read", key=getattr(filename, "filename",
+                                             None) or str(filename))
     arch = filename if isinstance(filename, Archive) \
         else read_archive(filename)
     if refresh_arch:

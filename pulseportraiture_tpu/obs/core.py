@@ -37,6 +37,7 @@ import os
 import threading
 import time
 
+from ..testing import faults
 from . import monitor
 from .manifest import build_manifest
 
@@ -154,6 +155,7 @@ class Recorder:
         self.counters = {}
         self.gauges = {}
         self.n_events = 0
+        self.dropped_events = 0  # sink-write failures (never fatal)
         self.compile_total_s = 0.0
         self.manifest = build_manifest(name, self.run_id, config=config)
         self._write_manifest()
@@ -174,6 +176,10 @@ class Recorder:
             if self._closed:
                 return
             try:
+                # chaos site: an injected sink-write failure (full
+                # disk, dead NFS) must DROP the event, never crash the
+                # pipeline — the "never fatal" contract above
+                faults.check("obs_write")
                 if self._max_bytes and self._bytes and \
                         self._bytes + len(line) + 1 > self._max_bytes:
                     self._rotate()
@@ -181,8 +187,8 @@ class Recorder:
                 self._fh.flush()
                 self.n_events += 1
                 self._bytes += len(line) + 1
-            except OSError:
-                pass
+            except (OSError, faults.InjectedFault):
+                self.dropped_events += 1
 
     def _rotate(self):
         """Move the live events file aside as ``events.jsonl.<n>`` and
@@ -270,6 +276,7 @@ class Recorder:
             counters=dict(self.counters),
             gauges=dict(self.gauges),
             n_events=self.n_events,
+            dropped_events=self.dropped_events,
             compile_total_s=round(self.compile_total_s, 6),
             jit_cache_sizes=self._jit_cache_sizes(),
         )

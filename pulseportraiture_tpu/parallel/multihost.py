@@ -16,6 +16,9 @@ Typical use on each host of a pod slice / multi-host job:
         mesh, local_data, model, ...)        # globally-sharded fit out
 """
 
+import re
+import threading
+
 import numpy as np
 
 import jax
@@ -23,11 +26,12 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..fit.portrait import fit_portrait_full_batch
+from ..testing import faults
 from .mesh import make_mesh
 
 __all__ = ["initialize", "global_mesh", "distributed_sweep_fit",
            "process_count", "process_index", "partition_indices",
-           "barrier"]
+           "barrier", "BarrierTimeout"]
 
 
 def initialize(coordinator_address=None, num_processes=None,
@@ -88,15 +92,95 @@ def partition_indices(n, process_id=None, num_processes=None):
     return list(range(process_id, int(n), num_processes))
 
 
-def barrier(name="pptpu_barrier"):
+class BarrierTimeout(RuntimeError):
+    """A named barrier timed out; carries which processes never arrived
+    (when the coordination service can name them, else "unknown").
+
+    The runner treats this as a survivable condition: a preempted or
+    wedged straggler must not wedge every *other* process of a pod
+    forever (docs/RUNNER.md failure-modes matrix).
+    """
+
+    def __init__(self, name, timeout_s, missing="unknown"):
+        self.name = name
+        self.timeout_s = float(timeout_s)
+        self.missing = missing
+        super().__init__(
+            "barrier %r timed out after %.1fs (missing: %s)"
+            % (name, float(timeout_s), missing))
+
+
+def _missing_processes(err_text):
+    """Straggler process ids parsed from a coordination-service
+    deadline error, or "unknown"."""
+    ids = sorted({int(m) for m in re.findall(
+        r"(?:process|task)[_\s]*(?:id)?[:=\s/]*(\d+)", err_text,
+        re.IGNORECASE)})
+    return ids or "unknown"
+
+
+def barrier(name="pptpu_barrier", timeout_s=None):
     """Block until every process reaches this point (no-op when
     single-process).  The runner uses it before process 0 merges the
-    per-process obs shards, so no shard is read mid-write."""
-    if jax.process_count() <= 1:
-        return
-    from jax.experimental import multihost_utils
+    per-process obs shards, so no shard is read mid-write.
 
-    multihost_utils.sync_global_devices(name)
+    With ``timeout_s``, a straggler becomes a :class:`BarrierTimeout`
+    instead of an unbounded wedge.  On real multi-process runs the
+    coordination service's deadline error names the processes that
+    never arrived (``BarrierTimeout.missing``); otherwise arrival runs
+    in a watchdogged thread, which also makes the timeout path
+    exercisable single-process through the chaos harness's ``barrier``
+    site (an injected hang simulates the straggler).
+    """
+
+    def _arrive():
+        # chaos site: hang= simulates a straggler, fail= a torn DCN
+        faults.check("barrier", key=name)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(name)
+
+    if timeout_s is None:
+        _arrive()
+        return
+    if jax.process_count() > 1 and not faults.active():
+        client = None
+        try:
+            from jax._src import distributed
+
+            client = getattr(distributed.global_state, "client", None)
+        except Exception:
+            client = None
+        if client is not None:
+            try:
+                client.wait_at_barrier(name, int(timeout_s * 1000))
+                return
+            except Exception as e:
+                if "DEADLINE" not in str(e).upper():
+                    raise
+                raise BarrierTimeout(
+                    name, timeout_s,
+                    missing=_missing_processes(str(e))) from e
+    # thread-join fallback: also the single-process fault-injection
+    # path.  A timed-out arrival thread is abandoned (daemon) — it
+    # either raises into the void or dies with the process.
+    box = {}
+
+    def _run():
+        try:
+            _arrive()
+        except BaseException as e:  # surfaced below, incl. InjectedFault
+            box["err"] = e
+
+    t = threading.Thread(target=_run, daemon=True,
+                         name="pptpu-barrier-%s" % name)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise BarrierTimeout(name, timeout_s)
+    if "err" in box:
+        raise box["err"]
 
 
 def global_mesh(n_chan=1, n_bin=1, devices=None):

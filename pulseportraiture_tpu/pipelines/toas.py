@@ -31,9 +31,41 @@ from ..ops.fourier import rotate_data
 from ..ops.instrumental import instrumental_response_port_FT
 from ..ops.scattering import scattering_portrait_FT, scattering_times
 from ..ops.stats import weighted_mean
+from ..testing import faults
 from ..utils.databunch import DataBunch
 
 __all__ = ["GetTOAs", "drop_checkpoint_blocks"]
+
+
+def _nonfinite_guard(ports, errs_b, weights_b):
+    """Pre-jit non-finite guard over one archive's fit batch.
+
+    The Fourier-domain estimator (Taylor 1992 FFTFIT, extended to 2-D
+    portraits by Pennucci+14) has no intrinsic defense against NaN/Inf
+    inputs: one poisoned channel NaNs its subint's FFTs, weighted
+    reductions and ultimately the whole batched fit.  Weights alone do
+    not protect — ``NaN * 0 == NaN`` — so bad channels must be
+    *scrubbed* before anything reaches the device.
+
+    Returns ``(ports, errs_b, weights_b, bad_chan, n_zap, n_live)``:
+    copies with every live channel containing a non-finite data sample
+    or noise estimate zero-weighted and its data/noise replaced by
+    finite placeholders (excluded from the fit by the zero weight
+    anyway), the [B, nchan] bad-channel mask, the count of channels
+    zapped, and the count of channels that were live going in.  The
+    caller decides whether ``n_zap / n_live`` crosses the quarantine
+    threshold (``nonfinite_max_frac``).
+    """
+    wok = weights_b > 0.0
+    bad = (~np.isfinite(ports).all(axis=-1)
+           | ~np.isfinite(errs_b)) & wok
+    n_zap = int(bad.sum())
+    if n_zap == 0:
+        return ports, errs_b, weights_b, bad, 0, int(wok.sum())
+    ports = np.where(bad[..., None], 0.0, ports)
+    errs_b = np.where(bad, 1.0, errs_b)
+    weights_b = np.where(bad, 0.0, weights_b)
+    return ports, errs_b, weights_b, bad, n_zap, int(wok.sum())
 
 
 def _resume_checkpoint(checkpoint, quiet=True):
@@ -212,6 +244,11 @@ class GetTOAs:
         # load failures stay silent-but-skipped as before; device/
         # tunnel failures are recorded here
         self.failed_datafiles = []
+        # archives the non-finite guard refused to fit (too many
+        # NaN/Inf channels): (datafile, reason).  The survey runner
+        # quarantines these directly — retrying poisoned data is
+        # pointless (runner/execute.py)
+        self.poisoned_datafiles = []
         # batched-fit entry override (None = module-level
         # fit_portrait_full_batch, resolved at call time so tests can
         # monkeypatch the module attribute); the survey runner installs
@@ -227,7 +264,7 @@ class GetTOAs:
                      "snrs", "channel_snrs", "profile_fluxes",
                      "profile_flux_errs", "fluxes", "flux_errs",
                      "flux_freqs", "covariances", "red_chi2s", "nfevals",
-                     "rcs", "fit_durations"]:
+                     "rcs", "fit_durations", "n_nonfinite_zapped"]:
             setattr(self, attr, [])
         self.TOA_list = []
 
@@ -338,7 +375,8 @@ class GetTOAs:
                  addtnl_toa_flags=None, method="trust-ncg", bounds=None,
                  nu_fits=None, show_plot=False, quiet=None,
                  max_iter=50, checkpoint=None, polish_iter=None,
-                 coarse_iter=None, coarse_kmax=None):
+                 coarse_iter=None, coarse_kmax=None,
+                 nonfinite_max_frac=0.5):
         """Measure TOAs; results accumulate on self (reference-named).
 
         Equivalent of /root/reference/pptoas.py:150-738; ``method`` is
@@ -356,6 +394,13 @@ class GetTOAs:
         the f32 stage's iterations / its harmonics).  Defaults keep
         exact behavior; the sub-0.01-ns trade each knob buys on the
         bench configs is measured in PERF.md (bench ships 4/12/64).
+
+        ``nonfinite_max_frac``: the non-finite guard zero-weights
+        NaN/Inf-poisoned channels (counted as ``n_nonfinite_zapped``)
+        and fits the rest; an archive whose bad-channel fraction
+        exceeds this threshold is refused instead (recorded on
+        ``poisoned_datafiles`` — the survey runner quarantines it,
+        docs/RUNNER.md).
         """
         if quiet is None:
             quiet = self.quiet
@@ -395,6 +440,7 @@ class GetTOAs:
             # per-archive phase spans (docs/OBSERVABILITY.md): load /
             # guess / solve / polish / write — no-ops unless a run is
             # open (PPTPU_OBS_DIR + obs.run, see @obs.scoped_run above)
+            n_toa0 = len(self.TOA_list)
             ph = obs.phases(archive=datafile)
             ph.enter("load")
             data = self._load_archive(datafile, tscrunch, quiet)
@@ -416,7 +462,44 @@ class GetTOAs:
             errs_b = d.noise_stds[ok, 0]
             SNRs_b = d.SNRs[ok, 0]
             Ps_b = d.Ps[ok]
+
+            # non-finite guard: scrub or refuse BEFORE anything reaches
+            # a weighted reduction or the device (NaN * 0 == NaN, so
+            # zero weights alone cannot contain poisoned channels)
+            ports, errs_b, weights_b, bad_chan, n_zap, n_live = \
+                _nonfinite_guard(ports, errs_b, weights_b)
+            if n_zap:
+                frac = n_zap / max(n_live, 1)
+                obs.event("nonfinite_guard", datafile=datafile,
+                          n_zapped=n_zap, n_live=n_live,
+                          frac=round(frac, 4),
+                          quarantined=bool(frac > nonfinite_max_frac))
+                obs.counter("n_nonfinite_zapped", n_zap)
+                if frac > nonfinite_max_frac:
+                    reason = ("non-finite data: %d/%d live channels "
+                              "NaN/Inf (> nonfinite_max_frac=%.2f)"
+                              % (n_zap, n_live, nonfinite_max_frac))
+                    self.poisoned_datafiles.append((datafile, reason))
+                    ph.done(skipped="nonfinite_poison")
+                    if not quiet:
+                        print(f"{datafile}: {reason}; not fitting it.")
+                    continue
+                SNRs_b = np.where(bad_chan, 0.0, SNRs_b)
             wok = (weights_b > 0.0).astype(np.float64)
+            if n_zap:
+                keep = wok.sum(-1) > 0
+                if not keep.all():  # subints with no live channel left
+                    ok, ports, freqs_b, weights_b, errs_b, SNRs_b, \
+                        Ps_b, wok = (a[keep] for a in (
+                            ok, ports, freqs_b, weights_b, errs_b,
+                            SNRs_b, Ps_b, wok))
+                    B = len(ok)
+                    if B == 0:
+                        self.poisoned_datafiles.append(
+                            (datafile, "non-finite data: every subint "
+                                       "lost all live channels"))
+                        ph.done(skipped="nonfinite_poison")
+                        continue
 
             # transient device/tunnel failures (the remote-
             # compile tunnel here has died mid-run for hours at
@@ -551,6 +634,10 @@ class GetTOAs:
                     flags_groups.setdefault(fl, []).append(i)
 
                 ph.enter("solve", batch=int(B))
+                # chaos site: an injected dispatch fault/hang stands in
+                # for a wedged device or dead compile tunnel right at
+                # the jit boundary (testing/faults.py)
+                faults.check("dispatch", key=datafile)
                 results = [None] * B
                 # opt-in device profile of the fit dispatches
                 # (PPTPU_TRACE_DIR; a no-op context otherwise)
@@ -820,20 +907,29 @@ class GetTOAs:
             self.nfevals.append(nfevals)
             self.rcs.append(rcs)
             self.fit_durations.append(fit_duration)
+            self.n_nonfinite_zapped.append(n_zap)
             if checkpoint is not None:
                 ph.enter("write", checkpoint=checkpoint)
+                # chaos site: a flush failure here (full disk, kill)
+                # leaves the ledger not-done with no block — the
+                # reconcile/retry path must refit without duplicating
+                faults.check("checkpoint_flush", key=datafile)
                 # block + its pp_done marker go down in ONE append, so a
                 # crash leaves either a complete marked block or an
-                # unmarked partial one that _resume_checkpoint drops
+                # unmarked partial one that _resume_checkpoint drops.
+                # Only THIS call's TOAs are eligible: a same-process
+                # retry after a failed flush would otherwise write the
+                # archive's lines twice in one "valid" block
                 arch_toas = filter_TOAs(
-                    [t for t in self.TOA_list if t.archive == datafile],
+                    [t for t in self.TOA_list[n_toa0:]
+                     if t.archive == datafile],
                     "snr", 0.0, ">=", pass_unflagged=False)
                 blk = [format_toa_line(t) for t in arch_toas]
                 blk.append("C pp_done %s %d" % (datafile, len(blk)))
                 with open(checkpoint, "a") as cf:
                     cf.write("".join(line + "\n" for line in blk))
             ph.done(fit_duration_s=round(fit_duration, 6),
-                    n_toas=len(ok))
+                    n_toas=len(ok), n_nonfinite_zapped=n_zap)
             if not quiet:
                 print("--------------------------")
                 print(datafile)
@@ -857,7 +953,8 @@ class GetTOAs:
                             addtnl_toa_flags=None, method="trust-ncg",
                             bounds=None, show_plot=False, quiet=None,
                             max_iter=50, polish_iter=None,
-                            coarse_iter=None, coarse_kmax=None):
+                            coarse_iter=None, coarse_kmax=None,
+                            nonfinite_max_frac=0.5):
         """Measure per-channel (narrowband) TOAs.
 
         Equivalent of /root/reference/pptoas.py:740-1125, re-designed as
@@ -913,6 +1010,28 @@ class GetTOAs:
             weights_b = d.weights[ok]
             errs_b = d.noise_stds[ok, 0]
             Ps_b = d.Ps[ok]
+
+            # non-finite guard (see get_TOAs): scrub poisoned channels
+            # or refuse the archive before the per-channel fit batch
+            ports, errs_b, weights_b, _, n_zap, n_live = \
+                _nonfinite_guard(ports, errs_b, weights_b)
+            if n_zap:
+                frac = n_zap / max(n_live, 1)
+                obs.event("nonfinite_guard", datafile=datafile,
+                          n_zapped=n_zap, n_live=n_live,
+                          frac=round(frac, 4),
+                          quarantined=bool(frac > nonfinite_max_frac),
+                          narrowband=True)
+                obs.counter("n_nonfinite_zapped", n_zap)
+                if frac > nonfinite_max_frac:
+                    reason = ("non-finite data: %d/%d live channels "
+                              "NaN/Inf (> nonfinite_max_frac=%.2f)"
+                              % (n_zap, n_live, nonfinite_max_frac))
+                    self.poisoned_datafiles.append((datafile, reason))
+                    ph.done(skipped="nonfinite_poison")
+                    if not quiet:
+                        print(f"{datafile}: {reason}; not fitting it.")
+                    continue
             wok = (weights_b > 0.0).astype(np.float64)
 
             # transient device/tunnel failures (the remote-
@@ -942,6 +1061,13 @@ class GetTOAs:
                 nusx = freqs_b[jj, cc]
                 Psx = Ps_b[jj]
                 M = len(jj)
+                if M == 0:  # the guard zapped every live channel
+                    self.poisoned_datafiles.append(
+                        (datafile, "non-finite data: every live "
+                                   "channel zapped"))
+                    del self.ok_idatafiles[n_okid:]
+                    ph.done(skipped="nonfinite_poison")
+                    continue
 
                 taus_fit = np.zeros(M)
                 tau_errs_fit = np.zeros(M)
@@ -954,6 +1080,9 @@ class GetTOAs:
                         and None not in bounds[0]:
                     phi_bounds = tuple(bounds[0])
                 ph.enter("solve", batch=int(M))
+                # chaos site: same jit-boundary fault stand-in as the
+                # wideband driver (testing/faults.py)
+                faults.check("dispatch", key=datafile)
                 # opt-in device profile of the narrowband fit dispatches
                 # (PPTPU_TRACE_DIR; a no-op context otherwise) — the
                 # devtime ingestion attributes the capture by pp_* scope
@@ -1166,7 +1295,9 @@ class GetTOAs:
             self.nfevals.append(nfevals)
             self.rcs.append(rcs_a)
             self.fit_durations.append(fit_duration)
-            ph.done(fit_duration_s=round(fit_duration, 6), n_toas=M)
+            self.n_nonfinite_zapped.append(n_zap)
+            ph.done(fit_duration_s=round(fit_duration, 6), n_toas=M,
+                    n_nonfinite_zapped=n_zap)
             if not quiet:
                 print("--------------------------")
                 print(datafile)

@@ -32,6 +32,9 @@ pipeline's per-archive fit configuration.
 import contextlib
 import json
 import os
+import signal
+import sys
+import threading
 import time
 
 import numpy as np
@@ -43,7 +46,32 @@ from ..pipelines.toas import (GetTOAs, _resume_checkpoint,
 from .plan import SurveyPlan, pad_databunch
 from .queue import DONE, QUARANTINED, WorkQueue
 
-__all__ = ["run_survey", "make_mesh_fitter", "survey_status"]
+__all__ = ["run_survey", "make_mesh_fitter", "survey_status",
+           "abandoned_workers"]
+
+# workers the dispatch watchdog abandoned (may be wedged inside native
+# code forever); see abandoned_workers()
+_ABANDONED = []
+
+
+def abandoned_workers(grace_s=0.0):
+    """Watchdog-abandoned worker threads that are still alive, after
+    giving them ``grace_s`` (total) to finish.
+
+    A worker the watchdog gave up on may be wedged inside native
+    XLA/device code.  Exiting the interpreter with such a thread live
+    aborts hard in C++ teardown (``terminate called without an active
+    exception``) — so a process that used the watchdog should check
+    this before returning from main and ``os._exit`` past teardown
+    when any remain (cli/ppsurvey.py does).
+    """
+    global _ABANDONED
+    _ABANDONED = [t for t in _ABANDONED if t.is_alive()]
+    deadline = time.monotonic() + max(0.0, grace_s)
+    for t in list(_ABANDONED):
+        t.join(max(0.0, deadline - time.monotonic()))
+    _ABANDONED = [t for t in _ABANDONED if t.is_alive()]
+    return list(_ABANDONED)
 
 
 class _BucketedGetTOAs(GetTOAs):
@@ -204,11 +232,19 @@ def _reconcile(queue, checkpoint, assigned, quiet=True):
                   "block(s) the ledger does not confirm; refitting.")
 
 
-def _fit_one(gt, queue, info, checkpoint, padded, get_toas_kw, quiet):
+def _fit_one(gt, queue, info, checkpoint, padded, get_toas_kw, quiet,
+             cancelled=None):
     """Fit one archive with full fault isolation; returns its final
-    state.  Only BaseExceptions (kill signals) propagate."""
+    state.  Only BaseExceptions (kill signals) propagate.
+
+    ``cancelled`` (a threading.Event) is set by the dispatch watchdog
+    once it has settled this archive from outside; a late-finishing
+    abandoned worker must then make NO ledger transition — the
+    watchdog's ``fail`` record already owns the archive's state.
+    """
     queue.claim(info.path)
     n_fail0 = len(gt.failed_datafiles)
+    n_poison0 = len(gt.poisoned_datafiles)
     n_ord0 = len(gt.order)
     kw = dict(get_toas_kw)
     if padded:
@@ -219,12 +255,21 @@ def _fit_one(gt, queue, info, checkpoint, padded, get_toas_kw, quiet):
         gt.get_TOAs(datafile=info.path, checkpoint=checkpoint,
                     quiet=quiet, **kw)
     except Exception as e:  # fault isolation: one archive, not the run
+        if cancelled is not None and cancelled.is_set():
+            return None
         rec = queue.fail(info.path,
                          "%s: %s" % (type(e).__name__, e))
     else:
+        if cancelled is not None and cancelled.is_set():
+            return None
         if len(gt.failed_datafiles) > n_fail0:
             # transient device/tunnel failure GetTOAs already isolated
             rec = queue.fail(info.path, gt.failed_datafiles[-1][1])
+        elif len(gt.poisoned_datafiles) > n_poison0:
+            # non-finite guard refusal: retrying poisoned data is
+            # pointless — quarantine directly with the guard's reason
+            rec = queue.quarantine(info.path,
+                                   gt.poisoned_datafiles[-1][1])
         elif len(gt.order) == n_ord0:
             # loaded-but-unusable (corrupt payload, model mismatch,
             # no subints): deterministic-looking, but a flaky
@@ -238,6 +283,60 @@ def _fit_one(gt, queue, info, checkpoint, padded, get_toas_kw, quiet):
               state=rec["state"], attempts=rec.get("attempts", 0),
               reason=rec.get("reason"))
     return rec["state"]
+
+
+def _fit_one_guarded(gt, queue, info, checkpoint, padded, get_toas_kw,
+                     quiet, watchdog_s):
+    """:func:`_fit_one`, bounded by a dispatch watchdog.
+
+    With ``watchdog_s`` unset this is a plain call.  Otherwise the fit
+    runs in a daemon worker thread joined with the timeout, so a hang
+    (wedged device dispatch, stuck first compile through a dead
+    tunnel) becomes a bounded ``fail()``+requeue instead of wedging
+    the whole survey.  On timeout the worker is cancelled
+    cooperatively — it skips its ledger transitions once the watchdog
+    has settled the archive; injected hangs release themselves as
+    :class:`~..testing.faults.InjectedFault` (testing/faults.py), and
+    a genuinely wedged dispatch never returns and dies with the
+    process.  Returns ``(state, gt_poisoned)``: ``gt_poisoned`` means
+    the bucket's GetTOAs instance may still be touched by the
+    abandoned worker and must be discarded by the caller.
+    """
+    if not watchdog_s:
+        return _fit_one(gt, queue, info, checkpoint, padded,
+                        get_toas_kw, quiet), False
+    cancelled = threading.Event()
+    box = {}
+
+    def _work():
+        try:
+            box["state"] = _fit_one(gt, queue, info, checkpoint,
+                                    padded, get_toas_kw, quiet,
+                                    cancelled=cancelled)
+        except BaseException as e:
+            box["err"] = e
+
+    t = threading.Thread(
+        target=_work, daemon=True,
+        name="pptpu-fit-%s" % os.path.basename(info.path))
+    t.start()
+    t.join(watchdog_s)
+    if t.is_alive():
+        cancelled.set()
+        _ABANDONED.append(t)
+        obs.event("watchdog_fired", archive=info.path,
+                  timeout_s=watchdog_s)
+        obs.counter("watchdog_fired")
+        rec = queue.fail(
+            info.path,
+            "watchdog: dispatch exceeded %.1fs" % watchdog_s)
+        obs.event("runner_archive", archive=info.path,
+                  state=rec["state"], attempts=rec.get("attempts", 0),
+                  reason=rec.get("reason"))
+        return rec["state"], True
+    if "err" in box:
+        raise box["err"]
+    return box.get("state"), False
 
 
 def _write_survey_manifest(path, pid, nproc, queue, plan, extra=None):
@@ -300,7 +399,8 @@ def _merge_survey_manifests(workdir, out_path):
 def run_survey(plan, workdir, modelfile=None, process_index=None,
                process_count=None, max_attempts=3, backoff_s=0.0,
                use_mesh=False, mesh=None, merge=True, max_archives=None,
-               trace_bucket=False, quiet=True, **get_toas_kw):
+               trace_bucket=False, watchdog_s=None,
+               barrier_timeout_s=600.0, quiet=True, **get_toas_kw):
     """Execute (or resume) one process's share of a survey plan.
 
     ``plan`` is a SurveyPlan or a path to a saved plan.json.  All
@@ -313,6 +413,26 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
     left over stay pending in the ledger.  ``merge`` lets process 0
     fold the per-process obs shards + survey manifests into
     ``obs_merged/`` + ``survey.json`` once its own share is written.
+
+    **Graceful preemption** (docs/RUNNER.md): SIGTERM/SIGINT are
+    converted into a *drain* — the in-flight archive finishes, the
+    ledger/checkpoint/obs shard are flushed as usual, a
+    ``sigterm_drain`` event is recorded, and the call returns its
+    partial summary with ``"drained"`` set; ``ppsurvey resume`` then
+    refits nothing already done.  A second signal aborts hard
+    (KeyboardInterrupt).  Handlers are only installed on the main
+    thread; everything stays restorable and is restored on exit.
+
+    ``watchdog_s`` arms a per-archive dispatch watchdog: each fit runs
+    in a worker thread joined with the timeout, so a wedged device
+    dispatch or hung first compile becomes a bounded ``fail``+requeue
+    (``watchdog_fired`` event) instead of wedging the run.  Pick it
+    above the worst first-compile time of a bucket.
+
+    ``barrier_timeout_s`` bounds the pre-merge multihost barrier; a
+    straggler process yields a recorded ``barrier_timeout`` in the
+    summary and the merge proceeds over the shards that exist (the
+    straggler's shard folds in on the next resume/report).
 
     ``trace_bucket`` (``ppsurvey run --trace-bucket``) captures one
     jax.profiler trace per shape bucket into ``$PPTPU_TRACE_DIR`` (or
@@ -337,7 +457,8 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
     queue = WorkQueue(paths["ledger"], max_attempts=max_attempts,
                       backoff_s=backoff_s)
 
-    from ..parallel.multihost import barrier, partition_indices
+    from ..parallel.multihost import (BarrierTimeout, barrier,
+                                      partition_indices)
 
     ordered = list(plan.archives())
     mine = [ordered[i] for i in
@@ -368,108 +489,171 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
 
         trace_base = trace_dir() or os.path.join(workdir, "traces")
 
-    with obs.run("ppsurvey", base_dir=paths["obs"],
-                 config={"process": pid, "n_processes": nproc,
-                         "n_archives": len(mine),
-                         "n_buckets": len(plan.buckets),
-                         "modelfile": modelfile,
-                         "use_mesh": bool(use_mesh),
-                         "trace_bucket": bool(trace_bucket)}) as rec:
-        t0 = time.perf_counter()
-        _reconcile(queue, paths["checkpoint"],
-                   [info for info, _ in mine], quiet)
-        gts = {}
-        n_fit = 0
-        stop = False
-        tracer = contextlib.ExitStack()
-        cur_bucket = None
-        # retry rounds: each failure bumps the attempt counter, so
-        # max_attempts rounds settle every archive into done or
-        # quarantined (modulo backoff still pending, which the next
-        # resume picks up)
+    # SIGTERM/SIGINT drain handler: preemption must not tear state.
+    # The handler only flips a flag — the in-flight archive finishes
+    # (every store flushes per write), the loop then stops cleanly.
+    drain = {"sig": None}
+
+    def _drain_handler(signum, frame):
+        if drain["sig"] is not None:
+            raise KeyboardInterrupt  # second signal: abort hard
         try:
-            for _ in range(queue.max_attempts + 1):
-                ran = 0
-                for info, bucket in mine:
-                    if stop or queue.state(info.path) in (DONE,
-                                                          QUARANTINED):
-                        continue
-                    if not queue.ready(info.path):
-                        continue
-                    gt = gts.get(bucket.key)
-                    if gt is None:
-                        gt = _BucketedGetTOAs(
-                            [i.path for i, b in mine
-                             if b.key == bucket.key],
-                            modelfile, bucket.key, quiet=quiet)
-                        gt.fit_batch = fitter
-                        gts[bucket.key] = gt
-                    if trace_base is not None \
-                            and bucket.key != cur_bucket:
-                        tracer.close()  # stop + ingest previous bucket
-                        tracer = contextlib.ExitStack()
-                        tracer.enter_context(obs.trace_capture(
-                            "bucket_%dx%d" % bucket.key,
-                            base_dir=trace_base))
-                        cur_bucket = bucket.key
-                    padded = (info.nchan, info.nbin) != bucket.key
-                    _fit_one(gt, queue, info, paths["checkpoint"],
-                             padded, get_toas_kw, quiet)
-                    ran += 1
-                    n_fit += 1
-                    if max_archives is not None \
-                            and n_fit >= max_archives:
-                        stop = True
-                outstanding = queue.outstanding()
-                if stop or not outstanding:
-                    break
-                if ran == 0:
-                    # everything left is backing off; wait for the
-                    # earliest retry (bounded — backoff_s caps at
-                    # 2**max_attempts rounds) unless nothing is due ever
-                    waits = [entry.get("retry_at", 0.0) - time.time()
-                             for entry in
-                             (queue.entries[k] for k in outstanding)
-                             if entry["state"] == "failed"]
-                    if not waits:
+            drain["sig"] = signal.Signals(signum).name
+        except ValueError:
+            drain["sig"] = str(signum)
+
+    prev_handlers = {}
+    try:
+        for s in (signal.SIGTERM, signal.SIGINT):
+            prev_handlers[s] = signal.signal(s, _drain_handler)
+    except ValueError:
+        prev_handlers = {}  # not the main thread: no graceful drain
+
+    try:
+        with obs.run("ppsurvey", base_dir=paths["obs"],
+                     config={"process": pid, "n_processes": nproc,
+                             "n_archives": len(mine),
+                             "n_buckets": len(plan.buckets),
+                             "modelfile": modelfile,
+                             "use_mesh": bool(use_mesh),
+                             "watchdog_s": watchdog_s,
+                             "trace_bucket": bool(trace_bucket)}) as rec:
+            t0 = time.perf_counter()
+            _reconcile(queue, paths["checkpoint"],
+                       [info for info, _ in mine], quiet)
+            gts = {}
+            n_fit = 0
+            stop = False
+            tracer = contextlib.ExitStack()
+            cur_bucket = None
+            # retry rounds: each failure bumps the attempt counter, so
+            # max_attempts rounds settle every archive into done or
+            # quarantined (modulo backoff still pending, which the next
+            # resume picks up)
+            try:
+                for _ in range(queue.max_attempts + 1):
+                    ran = 0
+                    for info, bucket in mine:
+                        if drain["sig"]:
+                            stop = True
+                        if stop or queue.state(info.path) in \
+                                (DONE, QUARANTINED):
+                            continue
+                        if not queue.ready(info.path):
+                            continue
+                        gt = gts.get(bucket.key)
+                        if gt is None:
+                            gt = _BucketedGetTOAs(
+                                [i.path for i, b in mine
+                                 if b.key == bucket.key],
+                                modelfile, bucket.key, quiet=quiet)
+                            gt.fit_batch = fitter
+                            gts[bucket.key] = gt
+                        if trace_base is not None \
+                                and bucket.key != cur_bucket:
+                            tracer.close()  # stop + ingest prev bucket
+                            tracer = contextlib.ExitStack()
+                            tracer.enter_context(obs.trace_capture(
+                                "bucket_%dx%d" % bucket.key,
+                                base_dir=trace_base))
+                            cur_bucket = bucket.key
+                        padded = (info.nchan, info.nbin) != bucket.key
+                        _, gt_poisoned = _fit_one_guarded(
+                            gt, queue, info, paths["checkpoint"],
+                            padded, get_toas_kw, quiet, watchdog_s)
+                        if gt_poisoned:
+                            # the abandoned worker may still touch this
+                            # instance; retries get a fresh one
+                            gts.pop(bucket.key, None)
+                        ran += 1
+                        n_fit += 1
+                        if max_archives is not None \
+                                and n_fit >= max_archives:
+                            stop = True
+                    outstanding = queue.outstanding()
+                    if stop or drain["sig"] or not outstanding:
                         break
-                    wait = max(0.0, min(waits))
-                    if wait > 0:
-                        time.sleep(wait)
-        finally:
-            tracer.close()  # stop + ingest the last bucket capture
-        if rec is not None and trace_base is not None:
-            # was this run fit-bound or IO-bound?  devtime ingestion
-            # sums attributed device seconds into a run counter; the
-            # gauge compares them to this process's survey wall
-            dev_s = float(rec.counters.get("device_seconds_total", 0.0))
-            wall = time.perf_counter() - t0
-            obs.gauge("device_total_s", round(dev_s, 6))
-            obs.gauge("device_utilization",
-                      round(dev_s / wall, 4) if wall > 0 else 0.0)
-        obs.event("runner_summary", process=pid, **queue.counts())
-        run_dir = rec.dir if rec is not None else None
+                    if ran == 0:
+                        # everything left is backing off; wait for the
+                        # earliest retry (bounded — backoff_s caps at
+                        # 2**max_attempts rounds) unless nothing is due
+                        # ever.  Sleep in slices so a drain signal is
+                        # honored promptly.
+                        waits = [entry.get("retry_at", 0.0)
+                                 - time.time()
+                                 for entry in
+                                 (queue.entries[k] for k in outstanding)
+                                 if entry["state"] == "failed"]
+                        if not waits:
+                            break
+                        deadline = time.time() + max(0.0, min(waits))
+                        while time.time() < deadline \
+                                and not drain["sig"]:
+                            time.sleep(min(0.2,
+                                           deadline - time.time()))
+            finally:
+                tracer.close()  # stop + ingest the last bucket capture
+            if drain["sig"]:
+                obs.event("sigterm_drain", signal=drain["sig"],
+                          n_fit_attempts=n_fit, **queue.counts())
+                obs.counter("sigterm_drain")
+                if not quiet:
+                    print("ppsurvey: %s received — drained after %d "
+                          "fit attempt(s); resume continues the rest."
+                          % (drain["sig"], n_fit), file=sys.stderr)
+            if rec is not None and trace_base is not None:
+                # was this run fit-bound or IO-bound?  devtime
+                # ingestion sums attributed device seconds into a run
+                # counter; the gauge compares them to this process's
+                # survey wall
+                dev_s = float(rec.counters.get("device_seconds_total",
+                                               0.0))
+                wall = time.perf_counter() - t0
+                obs.gauge("device_total_s", round(dev_s, 6))
+                obs.gauge("device_utilization",
+                          round(dev_s / wall, 4) if wall > 0 else 0.0)
+            obs.event("runner_summary", process=pid, **queue.counts())
+            run_dir = rec.dir if rec is not None else None
 
-    if run_dir is not None:
-        write_shard(run_dir, paths["shards"], pid)
-    summary = _write_survey_manifest(
-        paths["survey"], pid, nproc, queue, plan,
-        extra={"checkpoint": paths["checkpoint"],
-               "obs_run": run_dir, "n_fit_attempts": n_fit})
-    queue.close()
+        if run_dir is not None:
+            write_shard(run_dir, paths["shards"], pid)
+        extra = {"checkpoint": paths["checkpoint"],
+                 "obs_run": run_dir, "n_fit_attempts": n_fit}
+        if drain["sig"]:
+            extra["drained"] = drain["sig"]
+        summary = _write_survey_manifest(
+            paths["survey"], pid, nproc, queue, plan, extra=extra)
+        queue.close()
 
-    if pid == 0 and merge:
-        if not simulated:
-            barrier("pptpu_runner_merge")
-        try:
-            merge_obs_shards(paths["shards"], paths["merged"])
-            summary["obs_merged"] = paths["merged"]
-        except FileNotFoundError:
-            pass
-        merged = _merge_survey_manifests(workdir,
-                                         paths["survey_merged"])
-        summary["merged_counts"] = merged["counts"]
-    return summary
+        if merge and not simulated and nproc > 1:
+            # ALL processes arrive (a barrier only 0 joins would wedge
+            # it); a straggler is bounded and recorded, and the merge
+            # proceeds over the shards that exist
+            try:
+                barrier("pptpu_runner_merge",
+                        timeout_s=barrier_timeout_s)
+            except BarrierTimeout as e:
+                summary["barrier_timeout"] = {
+                    "barrier": e.name, "timeout_s": e.timeout_s,
+                    "missing": e.missing}
+                print("ppsurvey: %s — merging available shards" % e,
+                      file=sys.stderr)
+        if pid == 0 and merge:
+            try:
+                merge_obs_shards(paths["shards"], paths["merged"])
+                summary["obs_merged"] = paths["merged"]
+            except FileNotFoundError:
+                pass
+            merged = _merge_survey_manifests(workdir,
+                                             paths["survey_merged"])
+            summary["merged_counts"] = merged["counts"]
+        return summary
+    finally:
+        for s, h in prev_handlers.items():
+            try:
+                signal.signal(s, h)
+            except (ValueError, OSError):
+                pass
 
 
 def survey_status(workdir):

@@ -33,6 +33,7 @@ import os
 import numpy as np
 
 from ..io.fits import BLOCK, CARD, Header
+from ..testing import faults
 
 __all__ = ["ArchiveInfo", "ShapeBucket", "SurveyPlan", "canonical_shape",
            "pad_databunch", "plan_survey", "scan_archive_header"]
@@ -130,6 +131,7 @@ def _iter_headers(f, path):
 def scan_archive_header(path):
     """ArchiveInfo from FITS headers only; raises ValueError when the
     file is not a readable PSRFITS archive (the quarantine trigger)."""
+    faults.check("header_scan", key=path)
     primary = None
     with open(path, "rb") as f:
         for hdr in _iter_headers(f, path):
@@ -248,7 +250,8 @@ def plan_survey(datafiles, modelfile=None, quiet=True):
     for path in paths:
         try:
             info = scan_archive_header(path)
-        except (OSError, ValueError, KeyError) as e:
+        except (OSError, ValueError, KeyError,
+                faults.InjectedFault) as e:
             unreadable.append((path, str(e)))
             if not quiet:
                 print(f"plan: unreadable archive {path}: {e}")
@@ -297,6 +300,7 @@ def pad_databunch(d, nchan_pad, nbin_pad):
     ``nbin_native``; bw scales with nchan so the per-channel bandwidth
     stays the native value.  No-op when already canonical.
     """
+    faults.check("archive_pad", key=getattr(d, "filename", None))
     nsub, npol, nchan, nbin = d.subints.shape
     if nchan == nchan_pad and nbin == nbin_pad:
         return d

@@ -24,9 +24,13 @@ PSRFITS file must not be able to wedge a week-long run in a retry
 loop.
 """
 
+import hashlib
 import json
 import os
+import threading
 import time
+
+from ..testing import faults
 
 __all__ = ["WorkQueue", "PENDING", "RUNNING", "DONE", "FAILED",
            "QUARANTINED"]
@@ -38,6 +42,22 @@ FAILED = "failed"
 QUARANTINED = "quarantined"
 
 _STATES = (PENDING, RUNNING, DONE, FAILED, QUARANTINED)
+
+
+def _jitter_factor(key, attempts):
+    """Deterministic backoff jitter in [0.5, 1.0), seeded from the
+    archive path + attempt number.
+
+    A bare ``backoff_s * 2**(attempts-1)`` is identical across every
+    process of a multihost run, so one shared transient (tunnel blip,
+    NFS hiccup) produces a synchronized retry stampede.  Hashing the
+    key decorrelates the retry times across archives and processes
+    while keeping every individual schedule exactly reproducible —
+    no global randomness, so tests (and reruns) see the same ledger.
+    """
+    h = hashlib.sha1(("%s|%d" % (key, int(attempts)))
+                     .encode("utf-8", "replace")).digest()
+    return 0.5 + int.from_bytes(h[:8], "big") / 2.0 ** 65
 
 
 class WorkQueue:
@@ -56,6 +76,9 @@ class WorkQueue:
         self.readonly = bool(readonly)
         self.entries = {}      # realpath -> latest record (dict)
         self._order = []       # insertion order of first sighting
+        # appends may race between the survey loop and its dispatch
+        # watchdog settling an abandoned archive (runner/execute.py)
+        self._iolock = threading.Lock()
         if os.path.isfile(path):
             self._replay()
         if self.readonly:
@@ -88,17 +111,22 @@ class WorkQueue:
     def _append(self, key, state, **fields):
         if self._fh is None:
             raise RuntimeError("WorkQueue opened readonly")
-        rec = {"t": round(time.time(), 6), "archive": key,
-               "state": state}
-        prev = self.entries.get(key)
-        rec["attempts"] = int(fields.pop("attempts",
-                                         (prev or {}).get("attempts", 0)))
-        rec.update(fields)
-        if key not in self.entries:
-            self._order.append(key)
-        self.entries[key] = rec
-        self._fh.write(json.dumps(rec) + "\n")
-        self._fh.flush()
+        # chaos site: an injected append fault is a hard crash (full
+        # disk, killed process) — nothing is recorded, and the resume
+        # path must reconstruct from what IS on disk
+        faults.check("ledger_append", key=key)
+        with self._iolock:
+            rec = {"t": round(time.time(), 6), "archive": key,
+                   "state": state}
+            prev = self.entries.get(key)
+            rec["attempts"] = int(fields.pop(
+                "attempts", (prev or {}).get("attempts", 0)))
+            rec.update(fields)
+            if key not in self.entries:
+                self._order.append(key)
+            self.entries[key] = rec
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
         return rec
 
     def _recover(self):
@@ -144,7 +172,8 @@ class WorkQueue:
             return self._append(
                 key, QUARANTINED, attempts=attempts,
                 reason=f"retries exhausted ({attempts}): {reason}")
-        retry_at = time.time() + self.backoff_s * 2 ** (attempts - 1)
+        span = self.backoff_s * 2 ** (attempts - 1)
+        retry_at = time.time() + span * _jitter_factor(key, attempts)
         return self._append(key, FAILED, attempts=attempts,
                             reason=str(reason),
                             retry_at=round(retry_at, 6))

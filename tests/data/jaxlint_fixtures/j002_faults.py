@@ -1,0 +1,57 @@
+"""J002 fixtures: chaos-harness (testing.faults) misuse inside jit.
+
+Fault-injection sites are host-only by construction — a ``check()``
+under jit would fire once at trace time, and the injected control flow
+(raise / hang / signal delivery) cannot exist in compiled code.  This
+corpus proves no harness entry point is reachable inside a jit trace
+without the linter firing.  docs/RUNNER.md.
+"""
+
+import jax
+
+from pulseportraiture_tpu import testing
+from pulseportraiture_tpu.testing import faults
+
+
+@jax.jit
+def bad_check_in_jit(x):
+    faults.check("dispatch")  # EXPECT: J002
+    return x * 2.0
+
+
+@jax.jit
+def bad_dotted_check(x):
+    testing.faults.check("archive_read", key="a.fits")  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_configure_in_jit(x):
+    faults.configure("site:dispatch@nth=1")  # EXPECT: J002
+    return x + 1.0
+
+
+@jax.jit
+def bad_active_in_jit(x):
+    if faults.active():  # EXPECT: J002
+        return x
+    return -x
+
+
+@jax.jit
+def ok_suppressed(x):
+    faults.reset()  # jaxlint: disable=J002
+    return x
+
+
+def ok_host_side(path):
+    # outside jit: exactly where the pipeline places its sites
+    faults.check("archive_read", key=path)
+    return path
+
+
+@jax.jit
+def ok_unrelated_name(x, faults_mask):
+    # an array merely NAMED faults-ish must not trip the rule, and a
+    # bare check() of some other object is far too generic to match
+    return x * faults_mask.sum()

@@ -1,0 +1,303 @@
+"""Survey hardening tests (the ISSUE 5 acceptance scenarios).
+
+Driven through the chaos harness (testing/faults.py): a SIGTERM lands
+mid-survey and the run drains + resumes losslessly; a hung dispatch
+trips the watchdog, requeues the archive and the survey finishes; a
+NaN-poisoned archive fits with its bad channels zero-weighted while a
+majority-poisoned one is quarantined un-fitted; a failed checkpoint
+flush refits without duplicating TOA blocks; and a straggling barrier
+becomes a named BarrierTimeout instead of an unbounded wedge.
+"""
+
+import json
+import os
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.io.archive import make_fake_pulsar
+from pulseportraiture_tpu.io.gmodel import write_model
+from pulseportraiture_tpu.parallel.multihost import (BarrierTimeout,
+                                                     barrier)
+from pulseportraiture_tpu.pipelines import toas as toas_mod
+from pulseportraiture_tpu.pipelines.toas import GetTOAs
+from pulseportraiture_tpu.runner.execute import run_survey
+from pulseportraiture_tpu.runner.plan import plan_survey
+from pulseportraiture_tpu.runner.queue import WorkQueue
+from pulseportraiture_tpu.testing import InjectedFault, faults
+
+MODEL_PARAMS = np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5])
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("PPTPU_FAULTS", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def survey(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("runner_chaos")
+    gm = str(tmp / "c.gmodel")
+    write_model(gm, "c", "000", 1500.0, MODEL_PARAMS, np.ones(8, int),
+                -4.0, 0, quiet=True)
+    par = str(tmp / "c.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    files = []
+    # nbin=128 (not 64) keeps this module's compiled programs disjoint
+    # from test_runner_execute's bucket set — its cache-growth
+    # acceptance test counts NEW programs and must not find this
+    # module's already cached
+    for i in range(3):
+        out = str(tmp / f"c{i}.fits")
+        make_fake_pulsar(gm, par, out, nsub=2, nchan=8, nbin=128,
+                         nu0=1500.0, bw=400.0, tsub=60.0,
+                         phase=0.03 * (i + 1), dDM=5e-4,
+                         noise_stds=0.01, dedispersed=False,
+                         seed=70 + i, quiet=True)
+        files.append(out)
+    return SimpleNamespace(tmp=tmp, gm=gm, files=files)
+
+
+def _ledger(workdir, proc=0):
+    with open(os.path.join(workdir, "ledger.%d.jsonl" % proc)) as fh:
+        return [json.loads(ln) for ln in fh if ln.strip()]
+
+
+def _toa_lines(ckpt):
+    return [ln for ln in open(ckpt)
+            if ln.split() and ln.split()[0] not in ("FORMAT", "C", "#")]
+
+
+def _obs_events(run_dir):
+    from pulseportraiture_tpu.obs import list_event_files
+
+    out = []
+    for path in list_event_files(run_dir):
+        with open(path) as fh:
+            out.extend(json.loads(ln) for ln in fh if ln.strip())
+    return out
+
+
+def test_sigterm_drain_and_resume(survey, tmp_path):
+    """Acceptance: SIGTERM mid-survey drains cleanly — the in-flight
+    archive finishes, state flushes — and resume refits nothing."""
+    wd = str(tmp_path / "wd")
+    plan = plan_survey(survey.files, modelfile=survey.gm)
+    faults.configure("sigterm@after=1")  # during the 1st dispatch
+    s1 = run_survey(plan, wd, process_index=0, process_count=1,
+                    bary=False, backoff_s=0.0, merge=False)
+    assert s1.get("drained") == "SIGTERM", s1
+    assert s1["counts"]["done"] == 1      # the in-flight archive
+    assert s1["counts"]["pending"] == 2   # never started
+    assert s1["counts"]["running"] == 0   # nothing torn
+    evs = _obs_events(s1["obs_run"])
+    drains = [e for e in evs if e.get("name") == "sigterm_drain"]
+    assert len(drains) == 1 and drains[0]["signal"] == "SIGTERM"
+
+    faults.reset()
+    s2 = run_survey(plan, wd, process_index=0, process_count=1,
+                    bary=False, backoff_s=0.0, merge=False)
+    assert not s2.get("drained")
+    assert s2["counts"]["done"] == 3
+    # nothing refit: exactly one done record per archive, one block of
+    # nsub TOA lines each
+    done = {}
+    for rec in _ledger(wd):
+        if rec["state"] == "done":
+            done[rec["archive"]] = done.get(rec["archive"], 0) + 1
+    assert done == {WorkQueue.key_for(f): 1 for f in survey.files}
+    per_arch = {}
+    for ln in _toa_lines(s2["checkpoint"]):
+        per_arch[ln.split()[0]] = per_arch.get(ln.split()[0], 0) + 1
+    assert per_arch == {f: 2 for f in survey.files}
+
+
+def test_second_signal_aborts_hard(survey, tmp_path):
+    """A second SIGTERM/SIGINT during the drain escalates to a hard
+    KeyboardInterrupt (operators can always insist)."""
+    import signal as _signal
+
+    wd = str(tmp_path / "wd")
+    plan = plan_survey(survey.files[:2], modelfile=survey.gm)
+    real_fit = toas_mod.fit_portrait_full_batch
+
+    def double_kill(*a, **k):
+        os.kill(os.getpid(), _signal.SIGTERM)
+        time.sleep(0.01)  # let the first handler run
+        os.kill(os.getpid(), _signal.SIGTERM)
+        return real_fit(*a, **k)
+
+    try:
+        toas_mod.fit_portrait_full_batch = double_kill
+        with pytest.raises(KeyboardInterrupt):
+            run_survey(plan, wd, process_index=0, process_count=1,
+                       bary=False, merge=False)
+    finally:
+        toas_mod.fit_portrait_full_batch = real_fit
+
+
+def test_watchdog_requeues_hung_dispatch(survey, tmp_path):
+    """Acceptance: a hung dispatch (injected) trips the watchdog, the
+    archive is requeued and the survey finishes; the event is visible
+    in obs_report."""
+    from tools.obs_report import summarize
+
+    wd = str(tmp_path / "wd")
+    plan = plan_survey(survey.files[:2], modelfile=survey.gm)
+    faults.configure("site:dispatch@nth=1,hang=5")
+    summary = run_survey(plan, wd, process_index=0, process_count=1,
+                         bary=False, backoff_s=0.0, merge=False,
+                         watchdog_s=0.5)
+    assert summary["counts"]["done"] == 2
+    assert summary["counts"]["failed"] == 0
+    fails = [r for r in _ledger(wd) if r["state"] == "failed"]
+    assert len(fails) == 1
+    assert fails[0]["reason"].startswith("watchdog:")
+    evs = _obs_events(summary["obs_run"])
+    wf = [e for e in evs if e.get("name") == "watchdog_fired"]
+    assert len(wf) == 1 and wf[0]["timeout_s"] == 0.5
+    # no duplicated blocks from the abandoned worker
+    per_arch = {}
+    for ln in _toa_lines(summary["checkpoint"]):
+        per_arch[ln.split()[0]] = per_arch.get(ln.split()[0], 0) + 1
+    assert per_arch == {f: 2 for f in survey.files[:2]}
+    text = summarize(summary["obs_run"])
+    assert "## faults & robustness" in text
+    assert "watchdog_fired" in text
+
+
+def test_nonfinite_channels_zero_weighted(survey, monkeypatch):
+    """Acceptance: NaN-poisoned channels below the threshold are
+    zero-weighted, counted as n_nonfinite_zapped, and the fit
+    succeeds on the remaining channels."""
+    real_load = toas_mod.load_data
+
+    def poisoned_load(filename, **kw):
+        d = real_load(filename, **kw)
+        d.subints[:, :, :2, :] = np.nan  # 2 of 8 channels
+        return d
+
+    monkeypatch.setattr(toas_mod, "load_data", poisoned_load)
+    gt = GetTOAs([survey.files[0]], survey.gm, quiet=True)
+    gt.get_TOAs(bary=False, quiet=True)
+    assert len(gt.order) == 1 and not gt.poisoned_datafiles
+    assert gt.n_nonfinite_zapped == [4]  # 2 channels x 2 subints
+    assert len(gt.TOA_list) == 2
+    assert np.all(np.isfinite(np.asarray(gt.phis[0])))
+    assert np.all(np.isfinite(np.asarray(gt.red_chi2s[0])))
+    # the zapped channels are excluded: nchx reports 6 live channels
+    assert all(t.flags["nchx"] == 6 for t in gt.TOA_list)
+    # NaN-zapping must equal WEIGHT-zapping the same channels: same
+    # live set, same reference frequencies, same answer (a direct
+    # clean-vs-zapped comparison would differ by the real dispersion
+    # between the two fits' nu_DM references)
+    def weight_zapped_load(filename, **kw):
+        d = real_load(filename, **kw)
+        d.weights[:, :2] = 0.0
+        return d
+
+    monkeypatch.setattr(toas_mod, "load_data", weight_zapped_load)
+    ref = GetTOAs([survey.files[0]], survey.gm, quiet=True)
+    ref.get_TOAs(bary=False, quiet=True)
+    dphi = np.abs(((np.asarray(gt.phis[0]) - np.asarray(ref.phis[0]))
+                   + 0.5) % 1.0 - 0.5)
+    err = np.asarray(ref.phi_errs[0])
+    assert np.all(dphi < 5 * err), (dphi, err)
+    np.testing.assert_allclose(np.asarray(gt.DMs[0]),
+                               np.asarray(ref.DMs[0]), atol=5e-4)
+
+
+def test_nonfinite_majority_quarantined(survey, tmp_path, monkeypatch):
+    """Acceptance: an archive whose bad-channel fraction exceeds the
+    threshold is quarantined with that reason, not fitted (and not
+    retried — poisoned data does not heal)."""
+    real_load = toas_mod.load_data
+
+    def poisoned_load(filename, **kw):
+        d = real_load(filename, **kw)
+        d.subints[:, :, :7, :] = np.nan  # 7 of 8 channels
+        return d
+
+    monkeypatch.setattr(toas_mod, "load_data", poisoned_load)
+    wd = str(tmp_path / "wd")
+    plan = plan_survey(survey.files[:1], modelfile=survey.gm)
+    summary = run_survey(plan, wd, process_index=0, process_count=1,
+                         bary=False, backoff_s=0.0, merge=False)
+    assert summary["counts"]["quarantined"] == 1
+    assert summary["counts"]["done"] == 0
+    (q,) = summary["quarantined"]
+    assert "non-finite" in q["reason"]
+    assert "nonfinite_max_frac" in q["reason"]
+    # quarantined on first sight: no retry chain, no checkpoint block
+    recs = _ledger(wd)
+    assert [r["state"] for r in recs] == ["pending", "running",
+                                         "quarantined"]
+    assert not os.path.isfile(summary["checkpoint"]) \
+        or not _toa_lines(summary["checkpoint"])
+    evs = _obs_events(summary["obs_run"])
+    guard = [e for e in evs if e.get("name") == "nonfinite_guard"]
+    assert len(guard) == 1 and guard[0]["quarantined"] is True
+    assert guard[0]["n_zapped"] == 14  # 7 channels x 2 subints
+
+
+def test_checkpoint_flush_fault_refits_without_duplicates(survey,
+                                                          tmp_path):
+    """A failed checkpoint flush (full disk, kill) leaves the ledger
+    not-done with no block; the same-process retry must write exactly
+    one block — not the archive's TOAs twice."""
+    wd = str(tmp_path / "wd")
+    plan = plan_survey(survey.files[:1], modelfile=survey.gm)
+    faults.configure("site:checkpoint_flush@nth=1")
+    summary = run_survey(plan, wd, process_index=0, process_count=1,
+                         bary=False, backoff_s=0.0, merge=False)
+    assert summary["counts"]["done"] == 1
+    fails = [r for r in _ledger(wd) if r["state"] == "failed"]
+    assert len(fails) == 1 and "InjectedFault" in fails[0]["reason"]
+    lines = open(summary["checkpoint"]).readlines()
+    assert len(_toa_lines(summary["checkpoint"])) == 2  # nsub, once
+    markers = [ln for ln in lines
+               if ln.split()[:2] == ["C", "pp_done"]]
+    assert len(markers) == 1
+    assert markers[0].split()[3] == "2"  # the marker count matches
+
+
+def test_barrier_timeout_names_the_barrier():
+    """An injected straggler trips the bounded timeout path with the
+    barrier's name on the error; a clean barrier still passes."""
+    faults.configure("site:barrier@nth=1,hang=5")
+    t0 = time.monotonic()
+    with pytest.raises(BarrierTimeout) as ei:
+        barrier("pptpu_runner_merge", timeout_s=0.3)
+    assert time.monotonic() - t0 < 3.0  # bounded, not the hang
+    assert ei.value.name == "pptpu_runner_merge"
+    assert ei.value.timeout_s == 0.3
+    assert "pptpu_runner_merge" in str(ei.value)
+    faults.reset()
+    barrier("pptpu_runner_merge", timeout_s=0.3)  # clean pass
+
+
+def test_barrier_injected_failure_propagates():
+    """A fail-mode barrier fault (torn DCN) surfaces as the fault, not
+    as a timeout — the two are distinguishable to the caller."""
+    faults.configure("site:barrier@nth=1")
+    with pytest.raises(InjectedFault):
+        barrier("pptpu_runner_merge", timeout_s=1.0)
+
+
+def test_watchdog_off_by_default(survey, tmp_path):
+    """Without watchdog_s the guarded path is a plain call — no worker
+    threads, identical results (the tier-1 perf contract)."""
+    wd = str(tmp_path / "wd")
+    plan = plan_survey(survey.files[:1], modelfile=survey.gm)
+    summary = run_survey(plan, wd, process_index=0, process_count=1,
+                         bary=False, merge=False)
+    assert summary["counts"]["done"] == 1
+    assert not [e for e in _obs_events(summary["obs_run"])
+                if e.get("name") == "watchdog_fired"]

@@ -9,9 +9,11 @@ tail line from a kill is dropped — never a crash.
 
 import json
 import os
+import time
 
 from pulseportraiture_tpu.runner.queue import (DONE, FAILED, PENDING,
-                                               QUARANTINED, WorkQueue)
+                                               QUARANTINED, WorkQueue,
+                                               _jitter_factor)
 
 
 def _q(tmp_path, **kw):
@@ -57,14 +59,18 @@ def test_running_recovers_to_pending(tmp_path):
 def test_retries_backoff_then_quarantine(tmp_path):
     q = _q(tmp_path, max_attempts=3, backoff_s=30.0)
     q.add(["a.fits"])
+    t1 = time.time()
     rec = q.fail("a.fits", "tunnel down")
     assert rec["state"] == FAILED and rec["attempts"] == 1
+    # jittered exponential: attempt n waits backoff_s * 2**(n-1) *
+    # [0.5, 1.0) — deterministic per (archive, attempt)
+    assert 15.0 <= rec["retry_at"] - t1 < 30.0 + 1.0
     assert not q.ready("a.fits")  # backing off
     assert q.ready("a.fits", now=rec["retry_at"] + 1)
+    t2 = time.time()
     rec2 = q.fail("a.fits", "tunnel down")
     assert rec2["attempts"] == 2
-    # exponential: second wait is double the first
-    assert rec2["retry_at"] - rec["retry_at"] > 25.0
+    assert 30.0 <= rec2["retry_at"] - t2 < 60.0 + 1.0
     rec3 = q.fail("a.fits", "tunnel down")
     assert rec3["state"] == QUARANTINED
     assert "retries exhausted (3)" in rec3["reason"]
@@ -72,6 +78,64 @@ def test_retries_backoff_then_quarantine(tmp_path):
     assert not q.ready("a.fits", now=1e18)  # terminal
     assert q.outstanding() == []
     q.close()
+
+
+def test_backoff_jitter_deterministic_and_decorrelated():
+    """The jitter that breaks multihost retry stampedes: seeded from
+    (archive, attempt) so it reproduces exactly, differs across
+    archives (no synchronized retries after a shared transient), and
+    differs across attempts of one archive."""
+    f = _jitter_factor("x/a.fits", 1)
+    assert f == _jitter_factor("x/a.fits", 1)  # reproducible
+    assert 0.5 <= f < 1.0
+    assert _jitter_factor("x/a.fits", 1) != _jitter_factor("x/b.fits", 1)
+    assert _jitter_factor("x/a.fits", 1) != _jitter_factor("x/a.fits", 2)
+    # every factor stays in the contract interval
+    for i in range(50):
+        fi = _jitter_factor("arch%03d.fits" % i, 1 + i % 4)
+        assert 0.5 <= fi < 1.0
+
+
+def test_quarantine_reason_chain_survives_kill_and_resume(tmp_path):
+    """ISSUE satellite: a crash landing between ``fail()`` and the
+    requeue (or anywhere mid-retry) must not lose the attempt/reason
+    history — the resumed ledger still carries the full chain, and the
+    final quarantine reflects every prior attempt."""
+    q = _q(tmp_path, max_attempts=3, backoff_s=0.0)
+    q.add(["a.fits"])
+    q.claim("a.fits")
+    q.fail("a.fits", "tunnel down (attempt 1)")
+    q.claim("a.fits")
+    q.fail("a.fits", "tunnel down (attempt 2)")
+    q.close()  # hard kill right after the fail, before any requeue
+
+    # resume: the chain replays — attempts survive, state is FAILED
+    # (not running, not reset) and the next failure quarantines with
+    # the full count
+    q2 = _q(tmp_path)
+    assert q2.state("a.fits") == FAILED
+    assert q2.record("a.fits")["attempts"] == 2
+    assert "attempt 2" in q2.record("a.fits")["reason"]
+    q2.claim("a.fits")
+    rec = q2.fail("a.fits", "tunnel down (attempt 3)")
+    assert rec["state"] == QUARANTINED and rec["attempts"] == 3
+    assert "retries exhausted (3)" in rec["reason"]
+    assert "attempt 3" in rec["reason"]
+    q2.close()
+
+    # the on-disk history is complete: every transition of every life
+    lines = [json.loads(ln) for ln in
+             open(str(tmp_path / "ledger.jsonl"))]
+    states = [ln["state"] for ln in lines]
+    assert states == [PENDING, "running", FAILED, "running", FAILED,
+                      "running", QUARANTINED]
+    reasons = [ln.get("reason", "") for ln in lines]
+    assert any("attempt 1" in r for r in reasons)
+    assert any("attempt 2" in r for r in reasons)
+    # a third reopen still reports the terminal state + reason
+    q3 = _q(tmp_path, readonly=True)
+    assert q3.quarantined()[0][1].startswith("retries exhausted (3)")
+    q3.close()
 
 
 def test_torn_tail_line_dropped(tmp_path):

@@ -1,0 +1,169 @@
+"""Chaos smoke gate: a survey under injected faults must drain and
+resume losslessly (wired into tools/check.sh).
+
+Builds 4 good archives (one shape bucket, so the fit order is the
+metafile order) plus one header-corrupt file, then runs the survey
+with the chaos harness active via the environment::
+
+    PPTPU_FAULTS="site:archive_read@nth=1;site:dispatch@nth=2;sigterm@after=3"
+
+which injects, deterministically:
+
+* a corrupt read on the 1st archive load   -> archive A fails, retries
+* a transient dispatch fault (2nd dispatch) -> archive C fails, retries
+* a SIGTERM when the 3rd dispatch starts (~50% progress) -> the run
+  DRAINS: the in-flight archive (D) finishes, state flushes, the call
+  returns a partial summary
+
+The asserted contract (docs/RUNNER.md): after clearing the faults,
+``ppsurvey resume`` (a second run_survey over the same workdir) ends
+with the exact expected counts — 4 done + 1 quarantined — having refit
+nothing already done, with zero duplicated or lost ``.tim`` blocks,
+and with the injected faults + drain auditable in the obs run.
+
+Run:  env JAX_PLATFORMS=cpu python -m tools.chaos_smoke
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as np
+
+FAULT_SPEC = ("site:archive_read@nth=1;"
+              "site:dispatch@nth=2;"
+              "sigterm@after=3")
+
+
+def _events(run_dir):
+    from pulseportraiture_tpu.obs import list_event_files
+
+    out = []
+    for path in list_event_files(run_dir):
+        with open(path, encoding="utf-8") as fh:
+            out.extend(json.loads(ln) for ln in fh if ln.strip())
+    return out
+
+
+def main():
+    workroot = tempfile.mkdtemp(prefix="pptpu_chaos_smoke_")
+    prev_spec = os.environ.get("PPTPU_FAULTS")
+    try:
+        from pulseportraiture_tpu.io.archive import make_fake_pulsar
+        from pulseportraiture_tpu.io.gmodel import write_model
+        from pulseportraiture_tpu.runner import plan_survey, run_survey
+        from pulseportraiture_tpu.testing import faults
+
+        gm = os.path.join(workroot, "chaos.gmodel")
+        write_model(gm, "chaos", "000", 1500.0,
+                    np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5]),
+                    np.ones(8, int), -4.0, 0, quiet=True)
+        par = os.path.join(workroot, "chaos.par")
+        with open(par, "w") as f:
+            f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                    "PEPOCH 56000.0\nDM 30.0\n")
+        files = []
+        for i in range(4):
+            fits = os.path.join(workroot, "arch%d.fits" % i)
+            make_fake_pulsar(gm, par, fits, nsub=2, nchan=8, nbin=64,
+                             nu0=1500.0, bw=800.0, tsub=60.0,
+                             phase=0.03 * (i + 1), dDM=5e-4,
+                             noise_stds=0.01, dedispersed=False,
+                             seed=41 + i, quiet=True)
+            files.append(fits)
+        corrupt = os.path.join(workroot, "corrupt.fits")
+        with open(corrupt, "wb") as f:
+            f.write(b"SIMPLE  =                    T" + b"\x00" * 64)
+        meta = os.path.join(workroot, "survey.meta")
+        with open(meta, "w") as f:
+            f.write("\n".join(files + [corrupt]) + "\n")
+
+        workdir = os.path.join(workroot, "wd")
+        plan = plan_survey(meta, modelfile=gm)
+        assert plan.n_archives == 4 and len(plan.buckets) == 1, \
+            plan.to_dict()
+
+        # -- run 1: chaos active (env-gated, like a real deployment) --
+        os.environ["PPTPU_FAULTS"] = FAULT_SPEC
+        faults.reset()  # drop any cached spec from this process
+        s1 = run_survey(plan, workdir, process_index=0,
+                        process_count=1, bary=False, backoff_s=0.0,
+                        max_attempts=3)
+        c1 = s1["counts"]
+        assert s1.get("drained") == "SIGTERM", s1
+        assert c1["done"] == 2, c1          # B and the in-flight D
+        assert c1["failed"] == 2, c1        # A (read) + C (dispatch)
+        assert c1["quarantined"] == 1, c1   # the header-corrupt file
+        # the injected faults and the drain are on the record
+        ev1 = _events(s1["obs_run"])
+        inj = [e for e in ev1 if e.get("name") == "fault_injected"]
+        assert {e["site"] for e in inj} == {"archive_read", "dispatch"}
+        assert any(e["action"] == "sigterm" for e in inj), inj
+        assert sum(1 for e in ev1
+                   if e.get("name") == "sigterm_drain") == 1
+
+        # -- run 2: faults cleared; resume must finish losslessly -----
+        del os.environ["PPTPU_FAULTS"]
+        faults.reset()
+        s2 = run_survey(plan, workdir, process_index=0,
+                        process_count=1, bary=False, backoff_s=0.0,
+                        max_attempts=3)
+        c2 = s2["counts"]
+        assert not s2.get("drained"), s2
+        assert c2["done"] == 4 and c2["quarantined"] == 1, c2
+        assert c2["failed"] == 0 and c2["pending"] == 0, c2
+
+        # exactly one done per archive across BOTH runs: nothing refit
+        done_per_arch = {}
+        with open(os.path.join(workdir, "ledger.0.jsonl")) as fh:
+            for ln in fh:
+                rec = json.loads(ln)
+                if rec["state"] == "done":
+                    done_per_arch[rec["archive"]] = \
+                        done_per_arch.get(rec["archive"], 0) + 1
+        assert len(done_per_arch) == 4, done_per_arch
+        assert all(n == 1 for n in done_per_arch.values()), \
+            done_per_arch
+
+        # zero duplicated or lost .tim blocks: one marked block per
+        # archive, nsub TOA lines each
+        lines = open(s2["checkpoint"]).readlines()
+        toa_per_arch = {}
+        for ln in lines:
+            tok = ln.split()
+            if tok and tok[0] not in ("FORMAT", "C", "#"):
+                toa_per_arch[tok[0]] = toa_per_arch.get(tok[0], 0) + 1
+        assert toa_per_arch == {f: 2 for f in files}, toa_per_arch
+        markers = [ln.split()[2] for ln in lines
+                   if ln.split()[:2] == ["C", "pp_done"]]
+        assert sorted(markers) == sorted(files), markers
+
+        # the merged report shows the chaos run's audit trail
+        from tools.obs_report import summarize
+
+        text = summarize(s1["obs_run"])
+        assert "## faults & robustness" in text, text
+        assert "fault_injected" in text and "sigterm_drain" in text
+
+        print("chaos smoke OK: drained at 50% under "
+              "read+dispatch+SIGTERM faults, resumed to 4 done + "
+              "1 quarantined with no duplicated or lost blocks")
+        return 0
+    finally:
+        if prev_spec is None:
+            os.environ.pop("PPTPU_FAULTS", None)
+        else:
+            os.environ["PPTPU_FAULTS"] = prev_spec
+        try:
+            from pulseportraiture_tpu.testing import faults as _f
+
+            _f.reset()
+        except Exception:
+            pass
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,7 +13,12 @@
 #   5. runner smoke — tiny synthetic survey through the shape-bucketed
 #                 runner: 2 done + 1 quarantined + merged obs run
 #                 (docs/RUNNER.md)
-#   6. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
+#   6. chaos smoke — the same survey machinery under injected faults
+#                 (corrupt read, transient dispatch fault, SIGTERM at
+#                 ~50% progress): must drain, then resume to the exact
+#                 expected counts with no duplicated/lost .tim blocks
+#                 (docs/RUNNER.md, testing/faults.py)
+#   7. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
 #
 # Exit status is non-zero when any stage fails.
 set -u
@@ -68,6 +73,17 @@ if [ $? -ne 0 ]; then
     fail=1
 else
     tail -1 /tmp/_runner_smoke.log
+fi
+
+echo
+echo "== chaos smoke (fault injection + drain + resume, docs/RUNNER.md) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu PPTPU_OBS_DIR="" PPTPU_FAULTS="" \
+    python -m tools.chaos_smoke >/tmp/_chaos_smoke.log 2>&1
+if [ $? -ne 0 ]; then
+    tail -40 /tmp/_chaos_smoke.log
+    fail=1
+else
+    tail -1 /tmp/_chaos_smoke.log
 fi
 
 echo

@@ -62,6 +62,15 @@ _RUNNER_API_NAMES = {"plan_survey", "run_survey", "scan_archive_header",
                      "pad_databunch", "canonical_shape", "survey_status",
                      "merge_obs_shards", "WorkQueue"}
 
+# chaos harness (pulseportraiture_tpu.testing.faults): fault sites are
+# host-only by construction — a check() under jit would fire once at
+# trace time, and the injected control flow (raise / hang / signal)
+# cannot exist in compiled code.  Matched as ``faults.<name>`` /
+# ``testing.faults.<name>`` (the bare name ``check`` is far too
+# generic to match unqualified).
+_FAULTS_API_NAMES = {"check", "configure", "reset", "fired", "active",
+                     "spec_string"}
+
 _JNP_PREFIXES = ("jnp.", "jax.numpy.")
 
 
@@ -380,6 +389,17 @@ class RuleVisitor(ast.NodeVisitor):
                           "host sync (or burns the value seen at "
                           "trace time into every execution); use a "
                           "static label (docs/OBSERVABILITY.md)")
+            elif fname is not None and (
+                    fname.rsplit(".", 1)[-1] in _FAULTS_API_NAMES
+                    and fname.startswith(("faults.",
+                                          "testing.faults."))):
+                self._add("J002", node,
+                          "testing.faults call inside a jitted "
+                          "function — fault-injection sites are "
+                          "host-only by construction: under jit the "
+                          "check fires once at trace time, and the "
+                          "injected raise/hang/signal cannot exist in "
+                          "compiled code (docs/RUNNER.md)")
             elif fname is not None and (
                     (fname.startswith("runner.")
                      and fname.split(".", 1)[1] in _RUNNER_API_NAMES)

@@ -291,6 +291,39 @@ def summarize_fits(events):
     return "\n".join(lines)
 
 
+_ROBUSTNESS_EVENTS = ("fault_injected", "watchdog_fired",
+                      "sigterm_drain", "barrier_timeout",
+                      "nonfinite_guard")
+
+
+def summarize_robustness(events):
+    """Chaos/robustness audit trail: injected faults, watchdog
+    firings, preemption drains, barrier timeouts and non-finite-guard
+    decisions (docs/RUNNER.md failure-modes matrix) — a chaos run must
+    be reviewable from its report alone."""
+    evs = [e for e in events if e.get("kind") == "event"
+           and e.get("name") in _ROBUSTNESS_EVENTS]
+    if not evs:
+        return None
+    counts = {}
+    for e in evs:
+        counts[e["name"]] = counts.get(e["name"], 0) + 1
+    lines = ["  ".join("%s: %d" % (k, v)
+                       for k, v in sorted(counts.items()))]
+    for e in evs[:20]:
+        detail = {k: v for k, v in e.items()
+                  if k not in ("kind", "t", "name") and v is not None}
+        try:
+            lines.append("- %s %s" % (e["name"],
+                                      json.dumps(detail,
+                                                 sort_keys=True)))
+        except (TypeError, ValueError):
+            lines.append("- %s" % e["name"])
+    if len(evs) > 20:
+        lines.append("- ... %d more" % (len(evs) - 20))
+    return "\n".join(lines)
+
+
 def summarize(run_dir):
     """Full human-readable report for one run directory."""
     manifest, events = load_run(run_dir)
@@ -343,6 +376,11 @@ def summarize(run_dir):
         out.append("")
         out.append("## fit telemetry (per-subint convergence)")
         out.append(fits)
+    rob = summarize_robustness(events)
+    if rob:
+        out.append("")
+        out.append("## faults & robustness")
+        out.append(rob)
     counters = manifest.get("counters") or {}
     gauges = manifest.get("gauges") or {}
     caches = manifest.get("jit_cache_sizes") or {}
