@@ -67,8 +67,23 @@ def build_parser():
         r.add_argument("--barrier_timeout", type=float, default=600.0,
                        metavar="S", dest="barrier_timeout_s",
                        help="Pre-merge multihost barrier timeout [s]; "
-                            "a straggler is recorded and the merge "
-                            "proceeds over the shards that exist.")
+                            "a straggler is recorded, its leases are "
+                            "revoked back into the pool, and the "
+                            "merge proceeds over the shards that "
+                            "exist.")
+        r.add_argument("--lease", type=float, default=600.0,
+                       metavar="S", dest="lease_s",
+                       help="Work-ownership lease [s] (renewed every "
+                            "S/3 by the heartbeat): a dead process's "
+                            "claims expire back into the pool after "
+                            "S, so any resume — with ANY process "
+                            "count — or a surviving sibling takes "
+                            "them over (docs/RUNNER.md Elasticity).")
+        r.add_argument("--narrowband", action="store_true",
+                       help="Measure per-channel (narrowband) TOAs "
+                            "(get_narrowband_TOAs) through the same "
+                            "bucket/ledger/lease/checkpoint "
+                            "machinery.")
         r.add_argument("--nonfinite_max_frac", type=float, default=0.5,
                        metavar="F",
                        help="Quarantine an archive when more than "
@@ -132,16 +147,21 @@ def _cmd_run(args):
         print(f"ppsurvey: no plan at {plan} — run 'ppsurvey plan' "
               "first.", file=sys.stderr)
         return 1
+    # driver-specific fit kwargs: the narrowband driver has no bary
+    # (per-channel TOAs are referenced at each channel's frequency)
+    fit_kw = dict(tscrunch=args.tscrunch, fit_scat=args.fit_scat,
+                  nonfinite_max_frac=args.nonfinite_max_frac)
+    if not args.narrowband:
+        fit_kw["bary"] = args.bary
     summary = run_survey(
         plan, args.workdir, process_index=args.process,
         process_count=args.processes, max_attempts=args.max_attempts,
         backoff_s=args.backoff, use_mesh=args.use_mesh,
         merge=args.merge, max_archives=args.max_archives,
         trace_bucket=args.trace_bucket, watchdog_s=args.watchdog_s,
-        barrier_timeout_s=args.barrier_timeout_s, quiet=args.quiet,
-        tscrunch=args.tscrunch, bary=args.bary,
-        fit_scat=args.fit_scat,
-        nonfinite_max_frac=args.nonfinite_max_frac)
+        barrier_timeout_s=args.barrier_timeout_s,
+        lease_s=args.lease_s, narrowband=args.narrowband,
+        quiet=args.quiet, **fit_kw)
     out = {"counts": summary["counts"],
            "quarantined": summary["quarantined"],
            "checkpoint": summary["checkpoint"]}
@@ -174,11 +194,24 @@ def _cmd_status(args):
     except FileNotFoundError as e:
         print(f"ppsurvey: {e}", file=sys.stderr)
         return 1
+    # readonly union replay over every ledger shard: works on a LIVE
+    # multi-shard workdir (no appends, no crash recovery) and shows
+    # who owns what, each lease's time-to-expiry, and the expired
+    # leases a resume of any process count would take over
     print(json.dumps({"counts": status["counts"],
                       "quarantined": [
                           {"archive": a, "reason": r}
-                          for a, r in status["quarantined"]]},
+                          for a, r in status["quarantined"]],
+                      "owners": status["owners"],
+                      "leases": status["leases"],
+                      "expired_unreclaimed":
+                          status["expired_unreclaimed"]},
                      indent=1))
+    if status["expired_unreclaimed"]:
+        print("ppsurvey: %d expired-but-unreclaimed lease(s) — "
+              "'ppsurvey resume' (any --processes) will take them "
+              "over" % len(status["expired_unreclaimed"]),
+              file=sys.stderr)
     return 0
 
 

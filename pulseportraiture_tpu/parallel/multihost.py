@@ -31,7 +31,7 @@ from .mesh import make_mesh
 
 __all__ = ["initialize", "global_mesh", "distributed_sweep_fit",
            "process_count", "process_index", "partition_indices",
-           "barrier", "BarrierTimeout"]
+           "barrier", "BarrierTimeout", "straggler_ids"]
 
 
 def initialize(coordinator_address=None, num_processes=None,
@@ -108,6 +108,29 @@ class BarrierTimeout(RuntimeError):
         super().__init__(
             "barrier %r timed out after %.1fs (missing: %s)"
             % (name, float(timeout_s), missing))
+
+
+def straggler_ids(missing):
+    """Normalize :attr:`BarrierTimeout.missing` to a list of process
+    ids ([] for ``"unknown"``).
+
+    The runner feeds these into lease revocation
+    (``WorkQueue.revoke_owner``): a process the coordination service
+    names as never having arrived at the merge barrier is presumed
+    dead or wedged, so its ``running`` leases are returned to the pool
+    for the survivors (or the next resume, of any process count) to
+    claim — docs/RUNNER.md "Elasticity".  With an unnameable straggler
+    nothing is revoked; its leases simply expire.
+    """
+    if isinstance(missing, (list, tuple)):
+        out = []
+        for m in missing:
+            try:
+                out.append(int(m))
+            except (TypeError, ValueError):
+                continue
+        return out
+    return []
 
 
 def _missing_processes(err_text):

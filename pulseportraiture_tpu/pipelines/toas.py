@@ -952,7 +952,8 @@ class GetTOAs:
                             add_instrumental_response=False,
                             addtnl_toa_flags=None, method="trust-ncg",
                             bounds=None, show_plot=False, quiet=None,
-                            max_iter=50, polish_iter=None,
+                            max_iter=50, checkpoint=None,
+                            polish_iter=None,
                             coarse_iter=None, coarse_kmax=None,
                             nonfinite_max_frac=0.5):
         """Measure per-channel (narrowband) TOAs.
@@ -973,6 +974,13 @@ class GetTOAs:
         knobs for the 5-parameter kernel (see get_TOAs / PERF.md) —
         they apply ONLY to the fit_scat=True path; the default
         phase-only mode runs the FFTFIT kernel, which never sees them.
+
+        ``checkpoint``: same crash-resume .tim protocol as
+        :meth:`get_TOAs` (block + ``C pp_done`` marker in one append
+        per archive; archives already present are skipped), so the
+        survey runner drives narrowband surveys through the identical
+        ledger/lease/checkpoint machinery (``run_survey``'s
+        ``narrowband=True``, docs/RUNNER.md).
         """
         if quiet is None:
             quiet = self.quiet
@@ -992,8 +1000,18 @@ class GetTOAs:
         obs.configure(pipeline="get_narrowband_TOAs",
                       modelfile=self.modelfile,
                       n_datafiles=len(datafiles), fit_scat=fit_scat,
-                      log10_tau=log10_tau, max_iter=max_iter)
+                      log10_tau=log10_tau, max_iter=max_iter,
+                      checkpoint=checkpoint)
+        done_archives = set()
+        if checkpoint is not None and os.path.isfile(checkpoint):
+            done_archives = _resume_checkpoint(checkpoint, quiet)
         for iarch, datafile in enumerate(datafiles):
+            if os.path.realpath(datafile) in done_archives:
+                if not quiet:
+                    print(f"{datafile} already in checkpoint "
+                          f"{checkpoint}; skipping it.")
+                continue
+            n_toa0 = len(self.TOA_list)
             ph = obs.phases(archive=datafile)
             ph.enter("load")
             data = self._load_archive(datafile, tscrunch, quiet)
@@ -1296,6 +1314,21 @@ class GetTOAs:
             self.rcs.append(rcs_a)
             self.fit_durations.append(fit_duration)
             self.n_nonfinite_zapped.append(n_zap)
+            if checkpoint is not None:
+                ph.enter("write", checkpoint=checkpoint)
+                # same protocol as the wideband driver: block + its
+                # pp_done marker in ONE append, sliced to THIS call's
+                # TOAs so a retry after a failed flush cannot double
+                # the block (see get_TOAs)
+                faults.check("checkpoint_flush", key=datafile)
+                arch_toas = filter_TOAs(
+                    [t for t in self.TOA_list[n_toa0:]
+                     if t.archive == datafile],
+                    "snr", 0.0, ">=", pass_unflagged=False)
+                blk = [format_toa_line(t) for t in arch_toas]
+                blk.append("C pp_done %s %d" % (datafile, len(blk)))
+                with open(checkpoint, "a") as cf:
+                    cf.write("".join(line + "\n" for line in blk))
             ph.done(fit_duration_s=round(fit_duration, 6), n_toas=M,
                     n_nonfinite_zapped=n_zap)
             if not quiet:

@@ -1,26 +1,35 @@
-"""Survey execution: bucketed batches, fault isolation, obs shards.
+"""Survey execution: bucketed batches, lease-based claiming, obs shards.
 
 ``run_survey`` drives a :class:`~.plan.SurveyPlan` to completion for
 ONE process of a (possibly multi-process) job:
 
-* the plan's bucket-major archive order is round-robin partitioned
-  across processes (``parallel.multihost.partition_indices``) with no
-  communication — the batch axis is embarrassingly parallel, so DCN
-  never carries anything;
+* work ownership is **lease-based over the union of ledger shards**
+  (:class:`~.queue.WorkQueue` union mode), not a static partition: a
+  claim is a ``running`` record carrying ``owner`` + an expiring
+  lease, renewed by a heartbeat thread while the fit is in flight.
+  The plan's bucket-major order round-robined by process index is only
+  a *preference* (it minimizes claim conflicts and keeps bucket
+  batching intact); any process may claim any ready archive, so a
+  resumed survey can run with fewer or more processes than the run
+  that was preempted, and a dead straggler's archives expire back into
+  the pool instead of staying stranded (docs/RUNNER.md "Elasticity");
 * archives are fit bucket by bucket through the normal ``GetTOAs``
-  pipeline, each archive padded to its bucket's canonical shape at
-  load time (:func:`~.plan.pad_databunch`) so the whole survey
-  compiles O(#buckets) program sets instead of O(#shapes);
+  pipeline (or ``get_narrowband_TOAs`` with ``narrowband=True``), each
+  archive padded to its bucket's canonical shape at load time
+  (:func:`~.plan.pad_databunch`) so the whole survey compiles
+  O(#buckets) program sets instead of O(#shapes);
 * per-archive state lives in this process's ledger shard
-  (:class:`~.queue.WorkQueue`): transient failures retry with backoff,
-  poison archives are quarantined with a reason, and a killed run
-  resumes exactly where it stopped — reconciled against the ``.tim``
-  checkpoint so a disagreement between the two refits rather than
-  silently skipping (``_reconcile``);
+  (``ledger.<pid>.jsonl`` — each process appends only to its own
+  file): transient failures retry with backoff, poison archives are
+  quarantined with a reason, and a killed run resumes exactly where it
+  stopped — reconciled against the ``.tim`` checkpoints so a
+  disagreement (or a lease takeover) refits rather than silently
+  skipping or double-writing a block (``_reconcile``);
 * each process records its own obs run and publishes it as a shard
   (``obs_shards/events.<proc>.jsonl``); process 0 merges the shards
   into one report (``obs/merge.py``) after a barrier on real
-  multihost runs.
+  multihost runs, and a barrier straggler's leases are revoked from
+  its ``BarrierTimeout.missing`` ids.
 
 With more than one local device, each bucket's batched fit is sharded
 over a ('subint', 'chan') mesh via :func:`make_mesh_fitter`
@@ -30,6 +39,7 @@ pipeline's per-archive fit configuration.
 """
 
 import contextlib
+import itertools
 import json
 import os
 import signal
@@ -44,7 +54,8 @@ from ..obs.merge import merge_obs_shards, write_shard
 from ..pipelines.toas import (GetTOAs, _resume_checkpoint,
                               drop_checkpoint_blocks)
 from .plan import SurveyPlan, pad_databunch
-from .queue import DONE, QUARANTINED, WorkQueue
+from .queue import DONE, FAILED, QUARANTINED, RUNNING, WorkQueue, \
+    owner_pid
 
 __all__ = ["run_survey", "make_mesh_fitter", "survey_status",
            "abandoned_workers"]
@@ -52,6 +63,11 @@ __all__ = ["run_survey", "make_mesh_fitter", "survey_status",
 # workers the dispatch watchdog abandoned (may be wedged inside native
 # code forever); see abandoned_workers()
 _ABANDONED = []
+
+# run-epoch counter: owner strings must differ across run_survey calls
+# in one interpreter (simulated multi-process tests) AND across OS
+# processes, so an owner is "p<pid>@<ospid>.<n>"
+_RUN_SEQ = itertools.count(1)
 
 
 def abandoned_workers(grace_s=0.0):
@@ -199,68 +215,178 @@ def _paths(workdir, pid):
     }
 
 
-def _reconcile(queue, checkpoint, assigned, quiet=True):
-    """Make the ledger and the .tim checkpoint agree before fitting.
+def _ckpt_path(workdir, pid):
+    return os.path.join(workdir, "toas.%d.tim" % pid)
 
-    Disagreements REFIT rather than silently skip (docs/RUNNER.md):
 
-    * ledger ``done`` but no complete checkpoint block -> the TOAs are
-      lost (crash between fit and append) -> reset to pending;
-    * checkpoint block present but ledger not ``done`` -> the block is
-      half-trusted (crash between the two appends) -> drop the block,
-      the archive refits and re-appends.
+class _LeaseHeartbeat:
+    """Daemon thread renewing the in-flight archive's lease.
+
+    The fit loop (and the dispatch watchdog's worker) can block inside
+    a device dispatch for longer than a lease, so renewal cannot live
+    on the fitting thread: :meth:`hold` marks the archive whose lease
+    the thread keeps alive with ``queue.renew`` heartbeat appends
+    (``lease_renewed`` events).  A renewal that fails — injected
+    ``lease_renew`` fault, NFS blip — is dropped and counted; the
+    lease then simply runs out and the fit's completion guard abandons
+    without a transition if someone took over.
     """
+
+    def __init__(self, queue, interval_s):
+        self.queue = queue
+        self.interval_s = max(0.05, float(interval_s))
+        self._key = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True,
+                                   name="pptpu-lease-heartbeat")
+        self._t.start()
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            with self._lock:
+                key = self._key
+            if key is None:
+                continue
+            try:
+                rec = self.queue.renew(key)
+            except Exception:
+                obs.counter("lease_renew_failures")
+                continue
+            if rec is not None:
+                obs.event("lease_renewed", archive=key,
+                          owner=self.queue.owner,
+                          lease_expires_at=rec.get("lease_expires_at"),
+                          renewals=rec.get("renewals"))
+                obs.counter("leases_renewed")
+
+    @contextlib.contextmanager
+    def hold(self, path):
+        with self._lock:
+            self._key = self.queue.key_for(path)
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._key = None
+
+    def stop(self):
+        self._stop.set()
+        self._t.join(2.0)
+
+
+def _reconcile(queue, workdir, pid, assigned_paths, quiet=True):
+    """Make the union ledger and MY .tim checkpoint agree before
+    fitting.  Disagreements REFIT rather than silently skip
+    (docs/RUNNER.md):
+
+    * ledger ``done`` with the block recorded in MY checkpoint
+      (``ckpt == pid``) but no complete block there -> the TOAs are
+      lost (crash between fit and append) -> reset to pending;
+    * block present in MY checkpoint but the ledger does not confirm
+      it as mine -> half-trusted (crash between the two appends, or a
+      lease takeover refit it elsewhere) -> drop the block, never
+      skip, never duplicate.
+
+    ``done`` records owned by OTHER processes are trusted as-is: their
+    blocks live in their own ``toas.<pid>.tim`` (the final survey TOAs
+    are the union of all checkpoints), and a takeover additionally
+    scrubs the previous owner's block at claim time.
+    """
+    checkpoint = _ckpt_path(workdir, pid)
     done_ckpt = _resume_checkpoint(checkpoint, quiet) \
         if os.path.isfile(checkpoint) else set()
     to_drop = []
-    for info in assigned:
-        key = queue.key_for(info.path)
-        state = queue.state(info.path)
+    for path in assigned_paths:
+        key = queue.key_for(path)
+        rec = queue.entries.get(key)
+        state = rec["state"] if rec else None
         in_ckpt = key in done_ckpt
-        if state == DONE and not in_ckpt:
-            queue.reset(info.path, "checkpoint_missing_block")
-            obs.event("runner_reconcile", archive=info.path,
+        ck = None
+        if rec is not None and state == DONE:
+            ck = rec.get("ckpt")
+            if ck is None:
+                ck = queue.shard_of(path)
+            if ck is None:
+                ck = pid  # legacy single-shard ledger
+        if state == DONE and ck == pid and not in_ckpt:
+            queue.reset(path, "checkpoint_missing_block")
+            obs.event("runner_reconcile", archive=path,
                       action="refit", cause="checkpoint_missing_block")
+        elif state == DONE and ck != pid and in_ckpt:
+            # the confirmed block lives in another process's
+            # checkpoint; mine is a stale partial from a lost lease
+            to_drop.append(path)
+            obs.event("runner_reconcile", archive=path,
+                      action="drop_block", cause="done_elsewhere")
         elif state not in (DONE, QUARANTINED) and in_ckpt:
-            to_drop.append(info.path)
-            obs.event("runner_reconcile", archive=info.path,
+            to_drop.append(path)
+            obs.event("runner_reconcile", archive=path,
                       action="refit", cause="ledger_not_done")
     if to_drop:
         drop_checkpoint_blocks(checkpoint, to_drop)
         if not quiet:
             print(f"reconcile: dropped {len(to_drop)} checkpoint "
-                  "block(s) the ledger does not confirm; refitting.")
+                  "block(s) the ledger does not confirm as this "
+                  "process's; refitting where needed.")
+
+
+def _lease_lost(queue, info, checkpoint, wrote_block):
+    """The lease was taken over mid-fit: abandon with NO ledger
+    transition (the taker owns the archive's state now) and drop any
+    block this fit just wrote so a re-claimed archive never
+    double-writes a checkpoint block."""
+    if wrote_block:
+        drop_checkpoint_blocks(checkpoint, [info.path])
+    cur = queue.record(info.path) or {}
+    obs.event("lease_lost", archive=info.path, owner=queue.owner,
+              new_owner=cur.get("owner"),
+              block_dropped=bool(wrote_block))
+    obs.counter("leases_lost")
 
 
 def _fit_one(gt, queue, info, checkpoint, padded, get_toas_kw, quiet,
-             cancelled=None):
-    """Fit one archive with full fault isolation; returns its final
-    state.  Only BaseExceptions (kill signals) propagate.
+             cancelled=None, narrowband=False):
+    """Fit one (already claimed) archive with full fault isolation;
+    returns its final state.  Only BaseExceptions (kill signals)
+    propagate.
 
     ``cancelled`` (a threading.Event) is set by the dispatch watchdog
     once it has settled this archive from outside; a late-finishing
     abandoned worker must then make NO ledger transition — the
-    watchdog's ``fail`` record already owns the archive's state.
+    watchdog's ``fail`` record already owns the archive's state.  The
+    same no-transition discipline applies when the union ledger shows
+    the lease was taken over mid-fit (:func:`_lease_lost`).
     """
-    queue.claim(info.path)
     n_fail0 = len(gt.failed_datafiles)
     n_poison0 = len(gt.poisoned_datafiles)
     n_ord0 = len(gt.order)
+    n_toa0 = len(gt.TOA_list)
     kw = dict(get_toas_kw)
     if padded:
         flags = dict(kw.get("addtnl_toa_flags") or {})
         flags.setdefault("pp_grid", "%dx%d" % gt._bucket_shape)
         kw["addtnl_toa_flags"] = flags
+    fit = gt.get_narrowband_TOAs if narrowband else gt.get_TOAs
     try:
-        gt.get_TOAs(datafile=info.path, checkpoint=checkpoint,
-                    quiet=quiet, **kw)
+        fit(datafile=info.path, checkpoint=checkpoint, quiet=quiet,
+            **kw)
     except Exception as e:  # fault isolation: one archive, not the run
         if cancelled is not None and cancelled.is_set():
+            return None
+        if not queue.owns(info.path, refresh=True):
+            _lease_lost(queue, info, checkpoint, wrote_block=False)
             return None
         rec = queue.fail(info.path,
                          "%s: %s" % (type(e).__name__, e))
     else:
         if cancelled is not None and cancelled.is_set():
+            return None
+        if not queue.owns(info.path, refresh=True):
+            # success, but someone else holds the archive now — the
+            # block we just appended would duplicate the taker's
+            _lease_lost(queue, info, checkpoint,
+                        wrote_block=len(gt.order) > n_ord0)
             return None
         if len(gt.failed_datafiles) > n_fail0:
             # transient device/tunnel failure GetTOAs already isolated
@@ -278,7 +404,7 @@ def _fit_one(gt, queue, info, checkpoint, padded, get_toas_kw, quiet,
             rec = queue.fail(info.path, "load_failed_or_model_mismatch")
         else:
             rec = queue.complete(info.path,
-                                 n_toas=int(len(gt.ok_isubs[-1])))
+                                 n_toas=int(len(gt.TOA_list) - n_toa0))
     obs.event("runner_archive", archive=info.path,
               state=rec["state"], attempts=rec.get("attempts", 0),
               reason=rec.get("reason"))
@@ -286,7 +412,7 @@ def _fit_one(gt, queue, info, checkpoint, padded, get_toas_kw, quiet,
 
 
 def _fit_one_guarded(gt, queue, info, checkpoint, padded, get_toas_kw,
-                     quiet, watchdog_s):
+                     quiet, watchdog_s, narrowband=False):
     """:func:`_fit_one`, bounded by a dispatch watchdog.
 
     With ``watchdog_s`` unset this is a plain call.  Otherwise the fit
@@ -304,7 +430,8 @@ def _fit_one_guarded(gt, queue, info, checkpoint, padded, get_toas_kw,
     """
     if not watchdog_s:
         return _fit_one(gt, queue, info, checkpoint, padded,
-                        get_toas_kw, quiet), False
+                        get_toas_kw, quiet,
+                        narrowband=narrowband), False
     cancelled = threading.Event()
     box = {}
 
@@ -312,7 +439,8 @@ def _fit_one_guarded(gt, queue, info, checkpoint, padded, get_toas_kw,
         try:
             box["state"] = _fit_one(gt, queue, info, checkpoint,
                                     padded, get_toas_kw, quiet,
-                                    cancelled=cancelled)
+                                    cancelled=cancelled,
+                                    narrowband=narrowband)
         except BaseException as e:
             box["err"] = e
 
@@ -327,6 +455,11 @@ def _fit_one_guarded(gt, queue, info, checkpoint, padded, get_toas_kw,
         obs.event("watchdog_fired", archive=info.path,
                   timeout_s=watchdog_s)
         obs.counter("watchdog_fired")
+        if not queue.owns(info.path, refresh=True):
+            # the hang outlived the lease and someone took over: the
+            # taker's record stands, the watchdog records nothing
+            _lease_lost(queue, info, checkpoint, wrote_block=False)
+            return None, True
         rec = queue.fail(
             info.path,
             "watchdog: dispatch exceeded %.1fs" % watchdog_s)
@@ -344,6 +477,7 @@ def _write_survey_manifest(path, pid, nproc, queue, plan, extra=None):
         "schema": "pptpu-survey-run-v1",
         "process": pid,
         "n_processes": nproc,
+        "owner": queue.owner,
         "t": time.time(),
         "counts": queue.counts(),
         "n_buckets": len(plan.buckets),
@@ -351,7 +485,9 @@ def _write_survey_manifest(path, pid, nproc, queue, plan, extra=None):
                         for a, r in queue.quarantined()],
         "archives": {k: {f: v for f, v in rec.items()
                          if f in ("state", "attempts", "reason",
-                                  "n_toas")}
+                                  "n_toas", "owner",
+                                  "lease_expires_at", "ckpt",
+                                  "takeover_from", "prev_owner")}
                      for k, rec in queue.entries.items()},
     }
     doc.update(extra or {})
@@ -364,30 +500,36 @@ def _write_survey_manifest(path, pid, nproc, queue, plan, extra=None):
 
 
 def _merge_survey_manifests(workdir, out_path):
-    """Fold every survey.<proc>.json into one survey.json."""
-    shards = []
+    """Fold the per-process survey manifests into one survey.json.
+
+    Counts/states come from a readonly union replay of every ledger
+    shard (the single source of truth) — summing per-shard counts
+    would double-count archives that several shards have seen.
+    """
+    n_shards = 0
     for name in sorted(os.listdir(workdir)):
         if name.startswith("survey.") and name.endswith(".json") \
                 and name != os.path.basename(out_path):
             stem = name[len("survey."):-len(".json")]
             if stem.isdigit():
-                with open(os.path.join(workdir, name),
-                          encoding="utf-8") as fh:
-                    shards.append(json.load(fh))
-    counts = {}
-    archives = {}
-    quarantined = []
-    for sh in shards:
-        for k, v in sh.get("counts", {}).items():
-            counts[k] = counts.get(k, 0) + v
-        archives.update(sh.get("archives", {}))
-        quarantined.extend(sh.get("quarantined", []))
-    doc = {"schema": "pptpu-survey-run-v1",
-           "n_processes": len(shards),
-           "t": time.time(),
-           "counts": counts,
-           "quarantined": quarantined,
-           "archives": archives}
+                n_shards += 1
+    q = WorkQueue(None, readonly=True, union_dir=workdir)
+    try:
+        doc = {"schema": "pptpu-survey-run-v1",
+               "n_processes": n_shards,
+               "t": time.time(),
+               "counts": q.counts(),
+               "quarantined": [{"archive": a, "reason": r}
+                               for a, r in q.quarantined()],
+               "archives": {k: {f: v for f, v in rec.items()
+                                if f in ("state", "attempts", "reason",
+                                         "n_toas", "owner",
+                                         "lease_expires_at", "ckpt",
+                                         "takeover_from",
+                                         "prev_owner")}
+                            for k, rec in q.entries.items()}}
+    finally:
+        q.close()
     tmp = out_path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as fh:
         json.dump(doc, fh, indent=1)
@@ -400,13 +542,28 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                process_count=None, max_attempts=3, backoff_s=0.0,
                use_mesh=False, mesh=None, merge=True, max_archives=None,
                trace_bucket=False, watchdog_s=None,
-               barrier_timeout_s=600.0, quiet=True, **get_toas_kw):
+               barrier_timeout_s=600.0, lease_s=600.0,
+               narrowband=False, quiet=True, **get_toas_kw):
     """Execute (or resume) one process's share of a survey plan.
 
     ``plan`` is a SurveyPlan or a path to a saved plan.json.  All
     state lives under ``workdir``; calling again with the same workdir
     resumes.  Returns the process's survey-manifest dict (counts,
-    quarantined archives with reasons, per-archive states).
+    quarantined archives with reasons, per-archive states).  Counts
+    reflect the UNION of all ledger shards (the whole survey as this
+    process last saw it), not just this process's own fits.
+
+    **Elastic ownership** (docs/RUNNER.md "Elasticity"): work is
+    claimed from the union ledger under expiring leases
+    (``lease_s``, renewed by a heartbeat thread while each fit is in
+    flight), with the process's round-robin slice of the plan as a
+    claim-order *preference* only.  Resuming with a different
+    ``process_count`` than the interrupted run is therefore fully
+    supported, and a process that outlives a dead sibling takes over
+    its expired leases in the same run (visible ``lease_expired`` /
+    ``takeover_from`` records in the ledger, ``lease_*`` obs events).
+    Pick ``lease_s`` well above the worst per-archive fit+compile time
+    divided by three (the heartbeat renews every ``lease_s/3``).
 
     ``max_archives`` bounds how many fit attempts this call makes
     (incremental surveys, deterministic kill/resume tests); archives
@@ -414,14 +571,19 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
     fold the per-process obs shards + survey manifests into
     ``obs_merged/`` + ``survey.json`` once its own share is written.
 
+    ``narrowband=True`` routes ``get_narrowband_TOAs`` through the
+    same bucket/ledger/lease/checkpoint machinery (``get_toas_kw``
+    must then hold narrowband-driver keywords only).
+
     **Graceful preemption** (docs/RUNNER.md): SIGTERM/SIGINT are
     converted into a *drain* — the in-flight archive finishes, the
     ledger/checkpoint/obs shard are flushed as usual, a
     ``sigterm_drain`` event is recorded, and the call returns its
     partial summary with ``"drained"`` set; ``ppsurvey resume`` then
     refits nothing already done.  A second signal aborts hard
-    (KeyboardInterrupt).  Handlers are only installed on the main
-    thread; everything stays restorable and is restored on exit.
+    (KeyboardInterrupt).  A hard kill (SIGKILL, OOM) needs no
+    cooperation at all: the stranded lease expires and any process —
+    of any later topology — reclaims the archive.
 
     ``watchdog_s`` arms a per-archive dispatch watchdog: each fit runs
     in a worker thread joined with the timeout, so a wedged device
@@ -431,8 +593,10 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
 
     ``barrier_timeout_s`` bounds the pre-merge multihost barrier; a
     straggler process yields a recorded ``barrier_timeout`` in the
-    summary and the merge proceeds over the shards that exist (the
-    straggler's shard folds in on the next resume/report).
+    summary, its named leases are revoked back into the pool
+    (``lease_revoked`` ledger records), and the merge proceeds over
+    the shards that exist (the straggler's shard folds in on the next
+    resume/report).
 
     ``trace_bucket`` (``ppsurvey run --trace-bucket``) captures one
     jax.profiler trace per shape bucket into ``$PPTPU_TRACE_DIR`` (or
@@ -454,22 +618,31 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                                              process_count)
     os.makedirs(workdir, exist_ok=True)
     paths = _paths(workdir, pid)
+    owner = "p%d@%d.%d" % (pid, os.getpid(), next(_RUN_SEQ))
     queue = WorkQueue(paths["ledger"], max_attempts=max_attempts,
-                      backoff_s=backoff_s)
+                      backoff_s=backoff_s, union_dir=workdir,
+                      owner=owner, lease_s=lease_s, process_index=pid)
 
     from ..parallel.multihost import (BarrierTimeout, barrier,
-                                      partition_indices)
+                                      partition_indices,
+                                      straggler_ids)
 
     ordered = list(plan.archives())
-    mine = [ordered[i] for i in
-            partition_indices(len(ordered), process_id=pid,
-                              num_processes=nproc)]
-    queue.add([info.path for info, _ in mine])
-    if pid == 0:
-        for path, reason in plan.unreadable:
-            if queue.state(path) != QUARANTINED:
-                queue.quarantine(path, "unreadable at plan time: %s"
-                                 % reason)
+    # round-robin slice as claim-order PREFERENCE only: it keeps claim
+    # conflicts rare and bucket batching intact, but any process may
+    # scavenge any other ready archive afterwards (elastic ownership)
+    pref = partition_indices(len(ordered), process_id=pid,
+                             num_processes=nproc)
+    in_pref = set(pref)
+    order_idx = pref + [i for i in range(len(ordered))
+                        if i not in in_pref]
+    queue.add([info.path for info, _ in ordered])
+    for path, reason in plan.unreadable:
+        # any process may quarantine plan-time unreadables (a survey
+        # resumed without process 0 must still record them)
+        if queue.state(path) != QUARANTINED:
+            queue.quarantine(path, "unreadable at plan time: %s"
+                             % reason)
 
     fitter = None
     if use_mesh:
@@ -509,31 +682,34 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
     except ValueError:
         prev_handlers = {}  # not the main thread: no graceful drain
 
+    hb = _LeaseHeartbeat(queue, lease_s / 3.0) if lease_s else None
+    revoked = []
     try:
         with obs.run("ppsurvey", base_dir=paths["obs"],
                      config={"process": pid, "n_processes": nproc,
-                             "n_archives": len(mine),
+                             "owner": owner,
+                             "n_archives": len(ordered),
                              "n_buckets": len(plan.buckets),
                              "modelfile": modelfile,
                              "use_mesh": bool(use_mesh),
                              "watchdog_s": watchdog_s,
+                             "lease_s": lease_s,
+                             "narrowband": bool(narrowband),
                              "trace_bucket": bool(trace_bucket)}) as rec:
             t0 = time.perf_counter()
-            _reconcile(queue, paths["checkpoint"],
-                       [info for info, _ in mine], quiet)
+            _reconcile(queue, workdir, pid,
+                       [info.path for info, _ in ordered], quiet)
             gts = {}
             n_fit = 0
             stop = False
+            stalled = 0
             tracer = contextlib.ExitStack()
             cur_bucket = None
-            # retry rounds: each failure bumps the attempt counter, so
-            # max_attempts rounds settle every archive into done or
-            # quarantined (modulo backoff still pending, which the next
-            # resume picks up)
             try:
-                for _ in range(queue.max_attempts + 1):
+                while True:
                     ran = 0
-                    for info, bucket in mine:
+                    for idx in order_idx:
+                        info, bucket = ordered[idx]
                         if drain["sig"]:
                             stop = True
                         if stop or queue.state(info.path) in \
@@ -541,10 +717,64 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                             continue
                         if not queue.ready(info.path):
                             continue
+                        # -- lease claim (union-replay protocol) -----
+                        # sync the union view first: a sibling may have
+                        # claimed or even completed this archive since
+                        # the last refresh, and a claim layered on top
+                        # of an unseen ``done`` would win the (t, owner)
+                        # order and refit it
+                        queue.refresh()
+                        if queue.state(info.path) in \
+                                (DONE, QUARANTINED) \
+                                or not queue.ready(info.path):
+                            continue
+                        prev_rec = queue.record(info.path) or {}
+                        was_held = prev_rec.get("state") == RUNNING
+                        claim = queue.claim(info.path)
+                        queue.refresh()
+                        if not queue.owns(info.path):
+                            # double-claim lost: the deterministic
+                            # (t, owner) union order elected the other
+                            # claimant — abandon with NO transition
+                            obs.event("lease_claim_lost",
+                                      archive=info.path, owner=owner,
+                                      winner=(queue.record(info.path)
+                                              or {}).get("owner"))
+                            obs.counter("lease_claims_lost")
+                            continue
+                        if was_held:
+                            obs.event(
+                                "lease_expired", archive=info.path,
+                                prev_owner=prev_rec.get("owner"),
+                                lease_expires_at=prev_rec.get(
+                                    "lease_expires_at"))
+                            obs.counter("leases_expired")
+                        takeover = claim.get("takeover_from")
+                        n_scrubbed = 0
+                        if takeover:
+                            ppid = owner_pid(takeover)
+                            if ppid is not None and ppid != pid:
+                                # the previous owner may have died
+                                # between its checkpoint flush and the
+                                # ledger append: scrub its block so
+                                # the refit cannot double-write
+                                n_scrubbed = drop_checkpoint_blocks(
+                                    _ckpt_path(workdir, ppid),
+                                    [info.path])
+                            obs.counter("lease_takeovers")
+                        obs.event("lease_claimed", archive=info.path,
+                                  owner=owner,
+                                  lease_expires_at=claim.get(
+                                      "lease_expires_at"),
+                                  takeover_from=takeover,
+                                  blocks_scrubbed=n_scrubbed or None,
+                                  attempts=claim.get("attempts", 0))
+                        obs.counter("leases_claimed")
+                        # -- bucketed fit ----------------------------
                         gt = gts.get(bucket.key)
                         if gt is None:
                             gt = _BucketedGetTOAs(
-                                [i.path for i, b in mine
+                                [i.path for i, b in ordered
                                  if b.key == bucket.key],
                                 modelfile, bucket.key, quiet=quiet)
                             gt.fit_batch = fitter
@@ -558,9 +788,13 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                                 base_dir=trace_base))
                             cur_bucket = bucket.key
                         padded = (info.nchan, info.nbin) != bucket.key
-                        _, gt_poisoned = _fit_one_guarded(
-                            gt, queue, info, paths["checkpoint"],
-                            padded, get_toas_kw, quiet, watchdog_s)
+                        hold = hb.hold(info.path) if hb is not None \
+                            else contextlib.nullcontext()
+                        with hold:
+                            _, gt_poisoned = _fit_one_guarded(
+                                gt, queue, info, paths["checkpoint"],
+                                padded, get_toas_kw, quiet, watchdog_s,
+                                narrowband=narrowband)
                         if gt_poisoned:
                             # the abandoned worker may still touch this
                             # instance; retries get a fresh one
@@ -573,24 +807,43 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                     outstanding = queue.outstanding()
                     if stop or drain["sig"] or not outstanding:
                         break
-                    if ran == 0:
-                        # everything left is backing off; wait for the
-                        # earliest retry (bounded — backoff_s caps at
-                        # 2**max_attempts rounds) unless nothing is due
-                        # ever.  Sleep in slices so a drain signal is
-                        # honored promptly.
-                        waits = [entry.get("retry_at", 0.0)
-                                 - time.time()
-                                 for entry in
-                                 (queue.entries[k] for k in outstanding)
-                                 if entry["state"] == "failed"]
-                        if not waits:
-                            break
-                        deadline = time.time() + max(0.0, min(waits))
-                        while time.time() < deadline \
-                                and not drain["sig"]:
-                            time.sleep(min(0.2,
-                                           deadline - time.time()))
+                    if ran:
+                        stalled = 0
+                        continue
+                    # everything left is backing off or leased to
+                    # another process; wait for the earliest retry or
+                    # lease expiry (so a survivor takes over a dead
+                    # sibling's work IN this run), unless nothing will
+                    # ever become ready.  Sleep in slices so a drain
+                    # signal is honored promptly.
+                    now = time.time()
+                    waits = []
+                    for k in outstanding:
+                        entry = queue.entries[k]
+                        if entry["state"] == FAILED:
+                            waits.append(entry.get("retry_at", 0.0)
+                                         - now)
+                        elif entry["state"] == RUNNING \
+                                and entry.get("owner") != owner:
+                            exp = entry.get("lease_expires_at")
+                            waits.append(0.0 if exp is None
+                                         else exp - now)
+                    if not waits:
+                        break
+                    deadline = now + max(0.0, min(waits))
+                    while time.time() < deadline \
+                            and not drain["sig"]:
+                        time.sleep(min(0.2, deadline - time.time()))
+                    n_new = queue.refresh()
+                    # a live sibling renewing or completing IS
+                    # progress; only a dead-still union view counts
+                    # toward the stall cap (a backstop against claim
+                    # ping-pong, never hit in healthy runs)
+                    stalled = 0 if n_new else stalled + 1
+                    if stalled > max(8, 2 * queue.max_attempts + 4):
+                        obs.event("runner_stalled",
+                                  outstanding=len(outstanding))
+                        break
             finally:
                 tracer.close()  # stop + ingest the last bucket capture
             if drain["sig"]:
@@ -612,32 +865,48 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                 obs.gauge("device_total_s", round(dev_s, 6))
                 obs.gauge("device_utilization",
                           round(dev_s / wall, 4) if wall > 0 else 0.0)
-            obs.event("runner_summary", process=pid, **queue.counts())
+            obs.event("runner_summary", process=pid, owner=owner,
+                      **queue.counts())
             run_dir = rec.dir if rec is not None else None
 
         if run_dir is not None:
             write_shard(run_dir, paths["shards"], pid)
-        extra = {"checkpoint": paths["checkpoint"],
-                 "obs_run": run_dir, "n_fit_attempts": n_fit}
-        if drain["sig"]:
-            extra["drained"] = drain["sig"]
-        summary = _write_survey_manifest(
-            paths["survey"], pid, nproc, queue, plan, extra=extra)
-        queue.close()
 
+        barrier_timeout = None
         if merge and not simulated and nproc > 1:
             # ALL processes arrive (a barrier only 0 joins would wedge
-            # it); a straggler is bounded and recorded, and the merge
+            # it); a straggler is bounded and recorded, its named
+            # leases are revoked back into the pool, and the merge
             # proceeds over the shards that exist
             try:
                 barrier("pptpu_runner_merge",
                         timeout_s=barrier_timeout_s)
             except BarrierTimeout as e:
-                summary["barrier_timeout"] = {
+                barrier_timeout = {
                     "barrier": e.name, "timeout_s": e.timeout_s,
                     "missing": e.missing}
-                print("ppsurvey: %s — merging available shards" % e,
+                for mpid in straggler_ids(e.missing):
+                    revoked.extend(queue.revoke_owner(
+                        mpid, "lease_revoked: barrier straggler "
+                        "p%d" % mpid))
+                print("ppsurvey: %s — revoked %d lease(s), merging "
+                      "available shards" % (e, len(revoked)),
                       file=sys.stderr)
+
+        extra = {"checkpoint": paths["checkpoint"],
+                 "obs_run": run_dir, "n_fit_attempts": n_fit}
+        if drain["sig"]:
+            extra["drained"] = drain["sig"]
+        if barrier_timeout:
+            extra["barrier_timeout"] = barrier_timeout
+        if revoked:
+            extra["leases_revoked"] = [
+                {"archive": r["archive"],
+                 "prev_owner": r.get("prev_owner")} for r in revoked]
+        summary = _write_survey_manifest(
+            paths["survey"], pid, nproc, queue, plan, extra=extra)
+        queue.close()
+
         if pid == 0 and merge:
             try:
                 merge_obs_shards(paths["shards"], paths["merged"])
@@ -649,6 +918,8 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
             summary["merged_counts"] = merged["counts"]
         return summary
     finally:
+        if hb is not None:
+            hb.stop()
         for s, h in prev_handlers.items():
             try:
                 signal.signal(s, h)
@@ -656,27 +927,30 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                 pass
 
 
-def survey_status(workdir):
-    """Aggregate {counts, quarantined, per-archive states} across every
-    ledger shard under ``workdir`` (the ``ppsurvey status`` payload)."""
-    counts = {}
-    quarantined = []
-    archives = {}
-    found = False
-    for name in sorted(os.listdir(workdir)):
-        if not (name.startswith("ledger.") and name.endswith(".jsonl")):
-            continue
-        found = True
-        q = WorkQueue(os.path.join(workdir, name), readonly=True)
-        try:
-            for k, v in q.counts().items():
-                counts[k] = counts.get(k, 0) + v
-            quarantined.extend(q.quarantined())
-            for k, recq in q.entries.items():
-                archives[k] = recq
-        finally:
-            q.close()
-    if not found:
-        raise FileNotFoundError(f"no ledger shards under {workdir}")
-    return {"counts": counts, "quarantined": quarantined,
-            "archives": archives}
+def survey_status(workdir, now=None):
+    """Union-replay status across every ledger shard under ``workdir``
+    (the ``ppsurvey status`` payload): merged {counts, quarantined,
+    per-archive states}, per-owner state counts, the lease table for
+    every ``running`` entry, and the expired-but-unreclaimed leases a
+    resume (of any process count) would take over.  Readonly — a live
+    run may own the shards."""
+    q = WorkQueue(None, readonly=True, union_dir=workdir)
+    try:
+        if not q.shards_seen:
+            raise FileNotFoundError(f"no ledger shards under {workdir}")
+        now = time.time() if now is None else now
+        owners = {}
+        for rec in q.entries.values():
+            o = rec.get("owner") or "(unowned)"
+            per = owners.setdefault(o, {})
+            per[rec["state"]] = per.get(rec["state"], 0) + 1
+        leases = q.leases(now=now)
+        return {"counts": q.counts(),
+                "quarantined": q.quarantined(),
+                "archives": dict(q.entries),
+                "owners": owners,
+                "leases": leases,
+                "expired_unreclaimed": [x for x in leases
+                                        if x["expired"]]}
+    finally:
+        q.close()

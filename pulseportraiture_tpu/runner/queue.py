@@ -22,18 +22,45 @@ never completed) and are reverted to ``pending``, mirroring how the
 reported with their reason, never silently retried — one corrupt
 PSRFITS file must not be able to wedge a week-long run in a retry
 loop.
+
+Union replay & leases (elastic multihost, docs/RUNNER.md)
+---------------------------------------------------------
+
+With ``union_dir`` set, a queue still appends **only to its own shard**
+(``ledger.<pid>.jsonl`` — never multi-writer files) but replays the
+union of every shard under the directory, so the merged ledger — not a
+static partition — is the single source of truth for work ownership:
+
+* ``claim()`` appends a ``running`` record carrying ``owner`` (process
+  index + run epoch, e.g. ``p1@8812.2``) and ``lease_expires_at``;
+  ``renew()`` heartbeats extend the lease with further appends.
+* Merge order is deterministic and independent of shard read order:
+  records sort by ``(t, owner, seq)`` and the **max** record per
+  archive wins.  A double-claim therefore resolves identically on
+  every process; the loser abandons with *no* ledger transition (the
+  same discipline the dispatch watchdog uses for late finishers).
+* ``ready()`` treats an expired-lease ``running`` entry as claimable:
+  a dead straggler's archives expire back into the pool instead of
+  staying stranded until a full restart.  The claimant first appends a
+  visible ``pending`` revocation (``reason="lease_expired"``,
+  ``prev_owner=...``) so every takeover is auditable from the ledger
+  alone; ``revoke()`` does the same for barrier-named stragglers.
+* ``refresh()`` tails every shard incrementally (byte offsets, torn
+  tails never consumed) so a live process observes other processes'
+  claims/completions without rereading whole files.
 """
 
 import hashlib
 import json
 import os
+import re
 import threading
 import time
 
 from ..testing import faults
 
 __all__ = ["WorkQueue", "PENDING", "RUNNING", "DONE", "FAILED",
-           "QUARANTINED"]
+           "QUARANTINED", "owner_pid"]
 
 PENDING = "pending"
 RUNNING = "running"
@@ -42,6 +69,18 @@ FAILED = "failed"
 QUARANTINED = "quarantined"
 
 _STATES = (PENDING, RUNNING, DONE, FAILED, QUARANTINED)
+
+_LEDGER_RE = re.compile(r"^ledger\.(\d+)\.jsonl$")
+_OWNER_RE = re.compile(r"^p(\d+)@")
+
+
+def owner_pid(owner):
+    """Process index encoded in an owner string (``p<idx>@<epoch>``),
+    or None for legacy/unparseable owners."""
+    if not owner:
+        return None
+    m = _OWNER_RE.match(str(owner))
+    return int(m.group(1)) if m else None
 
 
 def _jitter_factor(key, attempts):
@@ -60,38 +99,89 @@ def _jitter_factor(key, attempts):
     return 0.5 + int.from_bytes(h[:8], "big") / 2.0 ** 65
 
 
+def _rec_key(rec):
+    """Total order for union replay: ``(t, owner, seq)`` primary (seq
+    breaks same-owner microsecond ties causally), then state + the
+    canonical JSON as a final deterministic tie-break so the merged
+    winner is identical regardless of shard read order."""
+    try:
+        seq = int(rec.get("seq") or 0)
+    except (TypeError, ValueError):
+        seq = 0
+    try:
+        t = float(rec.get("t") or 0.0)
+    except (TypeError, ValueError):
+        t = 0.0
+    return (t, str(rec.get("owner") or ""), seq,
+            str(rec.get("state") or ""),
+            json.dumps(rec, sort_keys=True, default=str))
+
+
 class WorkQueue:
-    """On-disk per-archive state machine for one survey (one process).
+    """On-disk per-archive state machine for one survey.
 
     Archives are keyed by ``os.path.realpath`` so resumed runs match
     regardless of path spelling, exactly like the checkpoint resume in
-    pipelines/toas.py.  All writes are appends flushed per line.
+    pipelines/toas.py.  All writes are appends flushed per line, and
+    always to ``path`` (this process's own shard) only; with
+    ``union_dir`` set the *read* side replays every ``ledger.*.jsonl``
+    under it (module docstring).  ``owner``/``lease_s`` arm lease-based
+    claiming; ``process_index`` identifies which stale ``running``
+    records are this process's own crash leftovers.
     """
 
     def __init__(self, path, max_attempts=3, backoff_s=1.0,
-                 readonly=False):
+                 readonly=False, union_dir=None, owner=None,
+                 lease_s=600.0, process_index=None):
         self.path = path
         self.max_attempts = int(max_attempts)
         self.backoff_s = float(backoff_s)
         self.readonly = bool(readonly)
+        self.union_dir = union_dir
+        self.owner = owner
+        self.lease_s = float(lease_s)
+        if process_index is None:
+            process_index = owner_pid(owner)
+        self.process_index = process_index
         self.entries = {}      # realpath -> latest record (dict)
         self._order = []       # insertion order of first sighting
-        # appends may race between the survey loop and its dispatch
-        # watchdog settling an abandoned archive (runner/execute.py)
+        self._seq = 0          # per-process record sequence (union tie-break)
+        self._offsets = {}     # shard path -> bytes consumed
+        self._shard_of = {}    # realpath -> shard pid of winning record
+        self.shards_seen = 0   # shard files found by the last refresh
+        self.scan_errors = 0   # unreadable shards tolerated by refresh
+        # appends may race between the survey loop, its dispatch
+        # watchdog settling an abandoned archive, and the lease
+        # heartbeat thread (runner/execute.py)
         self._iolock = threading.Lock()
-        if os.path.isfile(path):
+        if self.union_dir is not None:
+            self.refresh(include_own=True)
+        elif path is not None and os.path.isfile(path):
             self._replay()
         if self.readonly:
             # inspection only (ppsurvey status): no appends, and no
             # crash recovery — a live run may own the file
             self._fh = None
             return
+        if path is None:
+            raise ValueError("WorkQueue needs a shard path unless "
+                             "readonly")
+        # a torn tail (kill mid-append) must not glue the next append
+        # onto the partial line — both records would then be lost
+        if os.path.isfile(path) and os.path.getsize(path):
+            with open(path, "rb") as fh:
+                fh.seek(-1, os.SEEK_END)
+                torn = fh.read(1) != b"\n"
+            if torn:
+                with open(path, "ab") as fh:
+                    fh.write(b"\n")
         self._fh = open(path, "a", encoding="utf-8")
         self._recover()
 
     # -- persistence ----------------------------------------------------
 
     def _replay(self):
+        """Single-shard replay: file order IS the causal order."""
         with open(self.path, encoding="utf-8") as fh:
             for line in fh:
                 line = line.strip()
@@ -107,6 +197,86 @@ class WorkQueue:
                 if key not in self.entries:
                     self._order.append(key)
                 self.entries[key] = rec
+                self._seq = max(self._seq, int(rec.get("seq") or 0))
+
+    def _apply(self, rec, shard):
+        """Merge one replayed record: max ``_rec_key`` per archive wins
+        (idempotent, shard-read-order independent)."""
+        key = rec.get("archive")
+        if key is None or rec.get("state") not in _STATES:
+            return
+        if key not in self.entries:
+            self._order.append(key)
+            self.entries[key] = rec
+            self._shard_of[key] = shard
+        elif _rec_key(rec) >= _rec_key(self.entries[key]):
+            self.entries[key] = rec
+            self._shard_of[key] = shard
+
+    def _read_shard(self, path, shard):
+        """Tail one shard from its consumed offset; never consume an
+        unterminated tail line (it may still be mid-append — or torn
+        forever, in which case it stays ignored)."""
+        off = self._offsets.get(path, 0)
+        with open(path, "rb") as fh:
+            fh.seek(off)
+            data = fh.read()
+        if not data:
+            return 0
+        lines = data.split(b"\n")
+        tail = lines.pop()  # b"" when data ends on a newline
+        self._offsets[path] = off + len(data) - len(tail)
+        n = 0
+        for raw in lines:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw.decode("utf-8", "replace"))
+            except json.JSONDecodeError:
+                continue  # torn line mid-file (pre-fix glue): skip it
+            self._apply(rec, shard)
+            n += 1
+        return n
+
+    def refresh(self, include_own=False):
+        """Union mode: fold in every shard's new records; returns how
+        many records were read.  A shard that cannot be read right now
+        (NFS blip, injected ``ledger_scan`` fault) is skipped and
+        retried on the next refresh — the view is then momentarily
+        stale, which the claim protocol tolerates (ownership is
+        re-checked against the union before every transition)."""
+        if self.union_dir is None:
+            return 0
+        try:
+            names = os.listdir(self.union_dir)
+        except OSError:
+            return 0
+        own = os.path.basename(self.path) if self.path else None
+        n = 0
+        self.shards_seen = 0
+        for name in sorted(names):
+            m = _LEDGER_RE.match(name)
+            if not m:
+                continue
+            self.shards_seen += 1
+            if not include_own and name == own:
+                continue  # own appends are applied at write time
+            spath = os.path.join(self.union_dir, name)
+            try:
+                # chaos site: a failed shard scan must degrade to a
+                # stale view, never crash the claim loop (checked
+                # outside the lock — injected hangs must not block
+                # the heartbeat thread's appends)
+                faults.check("ledger_scan", key=spath)
+                with self._iolock:
+                    n += self._read_shard(spath, int(m.group(1)))
+            except (faults.InjectedFault, OSError):
+                self.scan_errors += 1
+                continue
+        for rec in self.entries.values():
+            self._seq = max(self._seq, int(rec.get("seq") or 0))
+        return n
 
     def _append(self, key, state, **fields):
         if self._fh is None:
@@ -116,23 +286,45 @@ class WorkQueue:
         # path must reconstruct from what IS on disk
         faults.check("ledger_append", key=key)
         with self._iolock:
+            self._seq += 1
             rec = {"t": round(time.time(), 6), "archive": key,
-                   "state": state}
+                   "state": state, "seq": self._seq}
+            if self.owner is not None:
+                rec["owner"] = self.owner
             prev = self.entries.get(key)
             rec["attempts"] = int(fields.pop(
                 "attempts", (prev or {}).get("attempts", 0)))
             rec.update(fields)
-            if key not in self.entries:
-                self._order.append(key)
-            self.entries[key] = rec
+            if self.union_dir is not None:
+                self._apply(rec, self.process_index)
+            else:
+                if key not in self.entries:
+                    self._order.append(key)
+                self.entries[key] = rec
             self._fh.write(json.dumps(rec) + "\n")
             self._fh.flush()
         return rec
 
     def _recover(self):
-        """Crash recovery: running -> pending (the fit never finished)."""
+        """Crash recovery: running -> pending (the fit never finished).
+
+        In union mode only THIS process's own stale claims are
+        recovered (an older epoch of the same process index); other
+        owners' claims are left to lease expiry — their process may be
+        alive and mid-fit.
+        """
         for key, rec in list(self.entries.items()):
-            if rec["state"] == RUNNING:
+            if rec["state"] != RUNNING:
+                continue
+            own = rec.get("owner")
+            if self.union_dir is not None:
+                if own == self.owner:
+                    continue  # cannot happen on open, but be safe
+                if owner_pid(own) != self.process_index:
+                    continue  # someone else's lease: expiry handles it
+                self._append(key, PENDING, reason="recovered_from_crash",
+                             prev_owner=own)
+            else:
                 self._append(key, PENDING, reason="recovered_from_crash")
 
     def close(self):
@@ -150,17 +342,113 @@ class WorkQueue:
         return os.path.realpath(path)
 
     def add(self, paths):
-        """Register archives as pending; known archives keep their
-        state (idempotent across resumes)."""
+        """Register archives as pending; known archives (in ANY shard
+        of a union) keep their state (idempotent across resumes)."""
         for path in paths:
             key = self.key_for(path)
             if key not in self.entries:
                 self._append(key, PENDING, path=path)
 
-    def claim(self, path):
-        return self._append(self.key_for(path), RUNNING)
+    def claim(self, path, lease_s=None):
+        """Claim an archive for this owner.
+
+        Without an owner this is the legacy bare ``running`` append.
+        With one, the record carries ``owner`` + ``lease_expires_at``;
+        taking over another owner's expired (or revoked) claim first
+        appends a visible ``pending`` revocation and tags the new claim
+        with ``takeover_from``, so the ledger narrates every takeover.
+        The caller must re-check :meth:`owns` after a
+        :meth:`refresh` — a concurrent double-claim is resolved by the
+        deterministic ``(t, owner)`` union order and the loser must
+        abandon with no further transition.
+        """
+        key = self.key_for(path)
+        if self.owner is None:
+            return self._append(key, RUNNING)
+        prev = self.entries.get(key)
+        fields = {"lease_expires_at": round(
+            time.time() + (self.lease_s if lease_s is None
+                           else float(lease_s)), 6)}
+        if prev is not None:
+            if prev.get("state") == RUNNING \
+                    and prev.get("owner") != self.owner:
+                # visible revocation: the dead owner's lease expires
+                # into the pool as an explicit ledger transition
+                self._append(key, PENDING, reason="lease_expired",
+                             prev_owner=prev.get("owner"),
+                             attempts=prev.get("attempts", 0))
+                fields["takeover_from"] = prev.get("owner")
+            elif prev.get("prev_owner") \
+                    and prev.get("prev_owner") != self.owner:
+                # claimed straight off a revocation/recovery record
+                fields["takeover_from"] = prev.get("prev_owner")
+        return self._append(key, RUNNING, **fields)
+
+    def renew(self, path):
+        """Heartbeat: extend this owner's lease with a fresh append.
+        No-op (returns None) once the archive is no longer this
+        owner's — ownership is verified against a *refreshed* union
+        first, because a renewal appended over an unseen takeover
+        would steal the archive back and double-fit it."""
+        key = self.key_for(path)
+        self.refresh()
+        rec = self.entries.get(key)
+        if self.owner is None or rec is None \
+                or rec.get("state") != RUNNING \
+                or rec.get("owner") != self.owner:
+            return None
+        # chaos site: a failed renewal lets the lease run out — the
+        # fit's completion guard must then abandon without transitions
+        faults.check("lease_renew", key=key)
+        return self._append(
+            key, RUNNING,
+            lease_expires_at=round(time.time() + self.lease_s, 6),
+            renewals=int(rec.get("renewals", 0)) + 1)
+
+    def owns(self, path, refresh=False):
+        """True when this owner holds the archive's current ``running``
+        record in the union view (always True without lease mode)."""
+        if self.owner is None:
+            return True
+        if refresh:
+            self.refresh()
+        rec = self.entries.get(self.key_for(path))
+        return rec is not None and rec.get("state") == RUNNING \
+            and rec.get("owner") == self.owner
+
+    def revoke(self, path, reason):
+        """Force another owner's ``running`` claim back to pending
+        (barrier-named straggler, operator action).  Returns the
+        revocation record, or None when there is nothing to revoke."""
+        key = self.key_for(path)
+        rec = self.entries.get(key)
+        if rec is None or rec.get("state") != RUNNING \
+                or rec.get("owner") == self.owner:
+            return None
+        return self._append(key, PENDING, reason=str(reason),
+                            prev_owner=rec.get("owner"),
+                            attempts=rec.get("attempts", 0))
+
+    def revoke_owner(self, process_index, reason):
+        """Revoke every ``running`` lease held by a process index (the
+        ``BarrierTimeout.missing`` straggler path).  Returns the
+        revocation records."""
+        out = []
+        for key, rec in list(self.entries.items()):
+            if rec.get("state") == RUNNING \
+                    and rec.get("owner") != self.owner \
+                    and owner_pid(rec.get("owner")) == process_index:
+                out.append(self._append(
+                    key, PENDING, reason=str(reason),
+                    prev_owner=rec.get("owner"),
+                    attempts=rec.get("attempts", 0)))
+        return out
 
     def complete(self, path, **info):
+        if self.process_index is not None:
+            # which process's .tim checkpoint holds this archive's
+            # block (reconcile + elastic resume need to know)
+            info.setdefault("ckpt", int(self.process_index))
         return self._append(self.key_for(path), DONE, **info)
 
     def fail(self, path, reason):
@@ -198,9 +486,16 @@ class WorkQueue:
     def record(self, path):
         return self.entries.get(self.key_for(path))
 
+    def shard_of(self, path):
+        """Shard pid whose record currently wins for this archive
+        (union mode; None single-shard)."""
+        return self._shard_of.get(self.key_for(path))
+
     def ready(self, path, now=None):
-        """True when the archive should be (re)fit now: pending, or
-        failed with its backoff elapsed."""
+        """True when the archive should be (re)fit now: pending,
+        failed with its backoff elapsed, or — in union/lease mode —
+        ``running`` under another owner's *expired* lease (a lease no
+        one can renew counts as expired immediately)."""
         rec = self.entries.get(self.key_for(path))
         if rec is None:
             return False
@@ -209,6 +504,14 @@ class WorkQueue:
         if rec["state"] == FAILED:
             now = time.time() if now is None else now
             return now >= rec.get("retry_at", 0.0)
+        if rec["state"] == RUNNING and self.union_dir is not None \
+                and self.owner is not None \
+                and rec.get("owner") != self.owner:
+            exp = rec.get("lease_expires_at")
+            if exp is None:
+                return True  # unrenewable legacy claim: claimable
+            now = time.time() if now is None else now
+            return now >= exp
         return False
 
     def outstanding(self):
@@ -231,4 +534,24 @@ class WorkQueue:
         out = {s: 0 for s in _STATES}
         for rec in self.entries.values():
             out[rec["state"]] += 1
+        return out
+
+    def leases(self, now=None):
+        """[{archive, owner, lease_expires_at, expires_in, expired}]
+        for every ``running`` entry — the ``ppsurvey status`` lease
+        table."""
+        now = time.time() if now is None else now
+        out = []
+        for k in self._order:
+            rec = self.entries[k]
+            if rec["state"] != RUNNING:
+                continue
+            exp = rec.get("lease_expires_at")
+            out.append({
+                "archive": k,
+                "owner": rec.get("owner"),
+                "lease_expires_at": exp,
+                "expires_in": None if exp is None
+                else round(exp - now, 3),
+                "expired": exp is None or now >= exp})
         return out

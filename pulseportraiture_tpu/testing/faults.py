@@ -20,6 +20,10 @@ site               where it fires
                    fit (wideband and narrowband drivers)
 ``ledger_append``  ``runner/queue.WorkQueue._append`` (every ledger
                    state transition)
+``ledger_scan``    ``runner/queue.WorkQueue.refresh`` (per union-shard
+                   tail read; a failure degrades to a stale view)
+``lease_renew``    ``runner/queue.WorkQueue.renew`` (lease heartbeat;
+                   a failure lets the lease run out — takeover fodder)
 ``checkpoint_flush``  the per-archive ``.tim`` checkpoint append
 ``obs_write``      ``obs/core.Recorder.emit`` (event-sink writes; the
                    injected failure must DROP the event, never crash)
@@ -31,7 +35,8 @@ Spec grammar (``PPTPU_FAULTS`` or :func:`configure`)::
 
     spec    := clause (";" clause)*
     clause  := "site:"NAME "@" param ("," param)*
-             | ("sigterm" | "sigint") "@" param ("," param)*
+             | ("sigterm" | "sigint" | "sigkill") "@" param
+               ("," param)*
     param   := FLOAT          probability per check, decided by a
                               stable hash of (seed, site, key) — a
                               given key either always faults or never
@@ -41,7 +46,14 @@ Spec grammar (``PPTPU_FAULTS`` or :func:`configure`)::
              | "every="K      fire on every K-th check
              | "after="K      sites: fire on every check past the K-th;
                               signals: deliver ONCE when the counting
-                              site's check counter reaches K
+                              site's check counter reaches K.
+                              ``sigkill`` is a REAL hard kill — no
+                              handler, no drain, the check never
+                              returns — so lease-expiry recovery is
+                              testable without any cooperation from
+                              the victim (docs/RUNNER.md elasticity;
+                              use it on a subprocess, never in-process
+                              in a test runner)
              | "at="NAME      signal clauses: the counting site
                               (default "dispatch")
              | "hang="SECS    on fire, sleep SECS first — watchdog
@@ -82,9 +94,11 @@ __all__ = ["InjectedFault", "SITES", "check", "active", "configure",
            "reset", "fired", "spec_string"]
 
 SITES = ("archive_read", "header_scan", "archive_pad", "dispatch",
-         "ledger_append", "checkpoint_flush", "obs_write", "barrier")
+         "ledger_append", "ledger_scan", "lease_renew",
+         "checkpoint_flush", "obs_write", "barrier")
 
-_SIGNALS = {"sigterm": _signal.SIGTERM, "sigint": _signal.SIGINT}
+_SIGNALS = {"sigterm": _signal.SIGTERM, "sigint": _signal.SIGINT,
+            "sigkill": _signal.SIGKILL}
 
 # injected hangs sleep in slices this long, so a process exit (or the
 # hang deadline) is never more than one slice away
@@ -255,7 +269,8 @@ class _Harness:
             if c.signal is not None:
                 # deliver ONCE, exactly when the counting site's
                 # counter reaches after=N (preemption at a defined
-                # progress point); the check itself then proceeds
+                # progress point); the check itself then proceeds —
+                # except sigkill, which never returns (hard death)
                 if site == c.at and n == c.after:
                     self._record(c, site, n, key, c.signal)
                     os.kill(os.getpid(), _SIGNALS[c.signal])

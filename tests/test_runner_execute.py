@@ -303,17 +303,30 @@ def test_checkpoint_present_ledger_pending_refits(survey, tmp_path):
 def test_two_process_run_merges_one_obs_report(survey, tmp_path):
     """The acceptance scenario: a simulated 2-process run writes one
     obs shard per process and process 0 merges them into a single run
-    + survey manifest."""
+    + survey manifest.  Ownership is lease-claimed from the union
+    ledger (not statically partitioned), so the first process is
+    capped at its round-robin half — uncapped it would elastically
+    scavenge the idle sibling's share too — and each process's summary
+    counts reflect the union view."""
     from tools.obs_report import summarize
 
     wd = str(tmp_path / "wd")
     s1 = run_survey(survey.plan, wd, process_index=1, process_count=2,
-                    bary=False, merge=False)
-    assert s1["counts"]["done"] == 6  # round-robin half
+                    bary=False, merge=False, max_archives=6)
+    assert s1["counts"]["done"] == 6  # its round-robin preference
     s0 = run_survey(survey.plan, wd, process_index=0, process_count=2,
                     bary=False, merge=True)
-    assert s0["counts"]["done"] == 6
+    assert s0["counts"]["done"] == 12  # union of both shards
     assert s0["merged_counts"]["done"] == 12
+    # claims never overlapped: every archive done exactly once, half
+    # per owner process
+    owners = {}
+    for rec in json.load(open(os.path.join(wd, "survey.json")))[
+            "archives"].values():
+        assert rec["state"] == "done"
+        pid = rec["owner"].split("@")[0]
+        owners[pid] = owners.get(pid, 0) + 1
+    assert owners == {"p0": 6, "p1": 6}
 
     merged = s0["obs_merged"]
     man = json.load(open(os.path.join(merged, "manifest.json")))

@@ -1,0 +1,516 @@
+"""Lease-based work ownership over the union of ledger shards (the
+ISSUE 6 tentpole acceptance scenarios).
+
+docs/RUNNER.md "Elasticity" contract: the merged ledger — not a static
+partition — is the single source of truth for ownership.  Union replay
+must be deterministic and identical regardless of shard read order
+(last record per archive wins under the ``(t, owner, seq)`` total
+order) through torn tails, double-claims and out-of-order timestamps;
+an expired lease is claimable with a *visible* revocation record; a
+takeover mid-fit makes the loser abandon with no ledger transition and
+no duplicated checkpoint block; and a resumed survey may run with a
+different process count than the run that was preempted.
+"""
+
+import itertools
+import json
+import os
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.io.archive import make_fake_pulsar
+from pulseportraiture_tpu.io.gmodel import write_model
+from pulseportraiture_tpu.runner.execute import run_survey, survey_status
+from pulseportraiture_tpu.runner.plan import plan_survey
+from pulseportraiture_tpu.runner.queue import (DONE, PENDING, RUNNING,
+                                               WorkQueue, owner_pid)
+from pulseportraiture_tpu.testing import faults
+
+MODEL_PARAMS = np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5])
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("PPTPU_FAULTS", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def survey(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("runner_leases")
+    gm = str(tmp / "l.gmodel")
+    write_model(gm, "l", "000", 1500.0, MODEL_PARAMS, np.ones(8, int),
+                -4.0, 0, quiet=True)
+    par = str(tmp / "l.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    files = []
+    # nbin=128 (like test_runner_chaos): stays off test_runner_execute's
+    # cache-growth acceptance buckets
+    for i in range(4):
+        out = str(tmp / f"l{i}.fits")
+        make_fake_pulsar(gm, par, out, nsub=2, nchan=8, nbin=128,
+                         nu0=1500.0, bw=400.0, tsub=60.0,
+                         phase=0.02 * (i + 1), dDM=5e-4,
+                         noise_stds=0.01, dedispersed=False,
+                         seed=90 + i, quiet=True)
+        files.append(out)
+    return SimpleNamespace(tmp=tmp, gm=gm, files=files)
+
+
+def _union_ledger(workdir):
+    recs = []
+    for name in sorted(os.listdir(workdir)):
+        if name.startswith("ledger.") and name.endswith(".jsonl"):
+            with open(os.path.join(workdir, name)) as fh:
+                for ln in fh:
+                    if ln.strip():
+                        recs.append(json.loads(ln))
+    return recs
+
+
+def _obs_events(run_dir):
+    from pulseportraiture_tpu.obs import list_event_files
+
+    out = []
+    for path in list_event_files(run_dir):
+        with open(path) as fh:
+            out.extend(json.loads(ln) for ln in fh if ln.strip())
+    return out
+
+
+def _toa_lines(ckpt):
+    if not os.path.isfile(ckpt):
+        return []
+    return [ln for ln in open(ckpt)
+            if ln.split() and ln.split()[0] not in ("FORMAT", "C", "#")]
+
+
+# -- union replay determinism (satellite) -------------------------------
+
+def _write_shard(path, recs, torn_tail=None):
+    with open(path, "w") as fh:
+        for rec in recs:
+            fh.write(json.dumps(rec) + "\n")
+        if torn_tail is not None:
+            fh.write(torn_tail)  # kill mid-append: no newline
+
+
+def test_union_replay_deterministic_across_shard_distributions(
+        tmp_path):
+    """Property: the merged state is a pure fold over the record SET —
+    interleaved shards with torn tails, double-claims and out-of-order
+    timestamps replay to the same winner per archive no matter how the
+    records are distributed across (or ordered within) shards."""
+    recs = [
+        # archive A: claimed by p0 and p1 ~simultaneously (same t!),
+        # p1's later (t, owner) claim must win deterministically
+        {"t": 10.0, "seq": 1, "archive": "A", "state": "pending"},
+        {"t": 11.0, "seq": 2, "archive": "A", "state": "running",
+         "owner": "p0@1.1", "lease_expires_at": 611.0},
+        {"t": 11.0, "seq": 1, "archive": "A", "state": "running",
+         "owner": "p1@2.1", "lease_expires_at": 611.0},
+        # archive B: done by p1 after a p0 failure, out-of-order in
+        # the shard files
+        {"t": 22.0, "seq": 2, "archive": "B", "state": "done",
+         "owner": "p1@2.1", "n_toas": 2, "ckpt": 1},
+        {"t": 20.0, "seq": 1, "archive": "B", "state": "pending"},
+        {"t": 21.0, "seq": 3, "archive": "B", "state": "failed",
+         "owner": "p0@1.1", "reason": "x", "attempts": 1},
+        # archive C: same owner, same microsecond — seq breaks the tie
+        # causally (running then failed)
+        {"t": 30.0, "seq": 5, "archive": "C", "state": "running",
+         "owner": "p0@1.1"},
+        {"t": 30.0, "seq": 6, "archive": "C", "state": "failed",
+         "owner": "p0@1.1", "reason": "y", "attempts": 1},
+    ]
+    states = {}
+    for perm_i, perm in enumerate(itertools.permutations(range(3))):
+        wd = str(tmp_path / ("u%d" % perm_i))
+        os.makedirs(wd)
+        shards = {0: [], 1: [], 2: []}
+        for i, rec in enumerate(recs):
+            shards[perm[i % 3]].append(rec)
+        for pid, srecs in shards.items():
+            _write_shard(os.path.join(wd, "ledger.%d.jsonl" % pid),
+                         srecs,
+                         torn_tail='{"t": 99.0, "archive": "A", "sta')
+        q = WorkQueue(None, readonly=True, union_dir=wd)
+        states[perm_i] = {k: (v["state"], v.get("owner"))
+                          for k, v in q.entries.items()}
+        q.close()
+    first = states[0]
+    assert all(s == first for s in states.values()), states
+    # the deterministic winners: A -> p1's claim (same t, later owner),
+    # B -> done (latest t; the torn t=99 record is dropped), C -> the
+    # same-owner same-t record with the higher seq
+    assert first["A"] == ("running", "p1@2.1")
+    assert first["B"] == ("done", "p1@2.1")
+    assert first["C"] == ("failed", "p0@1.1")
+
+
+def test_union_refresh_tails_incrementally(tmp_path):
+    """refresh() consumes only complete new lines: a partial tail is
+    left for the next refresh (the writer may still be mid-append) and
+    is folded in once completed."""
+    wd = str(tmp_path)
+    a = os.path.join(wd, "ledger.0.jsonl")
+    _write_shard(a, [{"t": 1.0, "seq": 1, "archive": "X",
+                      "state": "pending"}])
+    q = WorkQueue(os.path.join(wd, "ledger.1.jsonl"), union_dir=wd,
+                  owner="p1@1.1", process_index=1)
+    assert q.entries["X"]["state"] == PENDING
+    # another process appends: half a line first...
+    full = json.dumps({"t": 2.0, "seq": 2, "archive": "X",
+                       "state": "running", "owner": "p0@9.9",
+                       "lease_expires_at": 9e9})
+    with open(a, "a") as fh:
+        fh.write(full[:20])
+    q.refresh()
+    assert q.entries["X"]["state"] == PENDING  # partial tail skipped
+    with open(a, "a") as fh:
+        fh.write(full[20:] + "\n")
+    q.refresh()
+    assert q.entries["X"]["state"] == RUNNING
+    assert q.entries["X"]["owner"] == "p0@9.9"
+    q.close()
+
+
+def test_ledger_scan_fault_degrades_to_stale_view(tmp_path):
+    """An injected ledger_scan fault (unreadable shard) skips the
+    shard and counts it — never crashes the claim loop; the next clean
+    refresh folds the records in."""
+    wd = str(tmp_path)
+    _write_shard(os.path.join(wd, "ledger.0.jsonl"),
+                 [{"t": 1.0, "seq": 1, "archive": "X",
+                   "state": "done", "ckpt": 0}])
+    faults.configure("site:ledger_scan@nth=1")
+    q = WorkQueue(os.path.join(wd, "ledger.1.jsonl"), union_dir=wd,
+                  owner="p1@1.1", process_index=1)
+    assert q.scan_errors == 1
+    assert "X" not in q.entries  # stale view, not a crash
+    q.refresh()
+    assert q.entries["X"]["state"] == DONE
+    q.close()
+
+
+# -- lease lifecycle ----------------------------------------------------
+
+def test_lease_claim_expiry_and_visible_takeover(tmp_path):
+    """An expired lease is claimable; the takeover first appends a
+    visible ``pending/lease_expired`` revocation carrying the previous
+    owner, then the new claim tagged ``takeover_from`` — the whole
+    story reads off the ledger."""
+    wd = str(tmp_path)
+    q1 = WorkQueue(os.path.join(wd, "ledger.1.jsonl"), union_dir=wd,
+                   owner="p1@7.1", lease_s=0.05, process_index=1)
+    q1.add(["a.fits"])
+    rec = q1.claim("a.fits")
+    assert rec["owner"] == "p1@7.1"
+    assert rec["lease_expires_at"] > time.time()
+    q1.close()  # hard death: no drain, no transition
+
+    q0 = WorkQueue(os.path.join(wd, "ledger.0.jsonl"), union_dir=wd,
+                   owner="p0@8.1", lease_s=60.0, process_index=0)
+    # before expiry: not claimable (the owner may be mid-fit)
+    assert not q0.ready("a.fits", now=rec["lease_expires_at"] - 0.01)
+    assert q0.ready("a.fits", now=rec["lease_expires_at"] + 0.01)
+    time.sleep(0.06)
+    claim = q0.claim("a.fits")
+    assert claim["takeover_from"] == "p1@7.1"
+    assert q0.owns("a.fits")
+    q0.close()
+    states = [(r["state"], r.get("reason"), r.get("prev_owner"))
+              for r in _union_ledger(wd)
+              if r["archive"] == q0.key_for("a.fits")]
+    assert ("pending", "lease_expired", "p1@7.1") in states
+    assert owner_pid(claim["takeover_from"]) == 1
+
+
+def test_renew_extends_lease_and_refuses_after_takeover(tmp_path):
+    wd = str(tmp_path)
+    q1 = WorkQueue(os.path.join(wd, "ledger.1.jsonl"), union_dir=wd,
+                   owner="p1@7.1", lease_s=0.2, process_index=1)
+    q1.add(["a.fits"])
+    exp0 = q1.claim("a.fits")["lease_expires_at"]
+    time.sleep(0.05)
+    renewed = q1.renew("a.fits")
+    assert renewed["lease_expires_at"] > exp0
+    assert renewed["renewals"] == 1
+
+    # another owner takes over after expiry: the stale renewal must
+    # refuse (None) rather than steal the archive back
+    time.sleep(0.25)
+    q0 = WorkQueue(os.path.join(wd, "ledger.0.jsonl"), union_dir=wd,
+                   owner="p0@8.1", lease_s=60.0, process_index=0)
+    assert q0.ready("a.fits")
+    q0.claim("a.fits")
+    assert q1.renew("a.fits") is None
+    q0.close()
+    q1.close()
+
+
+def test_lease_renew_fault_site(tmp_path):
+    """The lease_renew chaos site fires inside renew(): the heartbeat
+    must treat it as a dropped renewal (the caller catches)."""
+    wd = str(tmp_path)
+    q = WorkQueue(os.path.join(wd, "ledger.0.jsonl"), union_dir=wd,
+                  owner="p0@1.1", lease_s=10.0, process_index=0)
+    q.add(["a.fits"])
+    q.claim("a.fits")
+    faults.configure("site:lease_renew@nth=1")
+    with pytest.raises(faults.InjectedFault):
+        q.renew("a.fits")
+    faults.reset()
+    assert q.renew("a.fits")["renewals"] == 1  # next heartbeat lands
+    q.close()
+
+
+def test_revoke_owner_barrier_straggler_path(tmp_path):
+    """revoke_owner returns every lease of a named straggler to the
+    pool with the reason + prev_owner recorded (BarrierTimeout.missing
+    -> lease revocation, docs/RUNNER.md)."""
+    wd = str(tmp_path)
+    q2 = WorkQueue(os.path.join(wd, "ledger.2.jsonl"), union_dir=wd,
+                   owner="p2@5.1", lease_s=600.0, process_index=2)
+    q2.add(["a.fits", "b.fits", "c.fits"])
+    q2.claim("a.fits")
+    q2.claim("b.fits")
+    q2.close()
+    q0 = WorkQueue(os.path.join(wd, "ledger.0.jsonl"), union_dir=wd,
+                   owner="p0@6.1", lease_s=600.0, process_index=0)
+    revoked = q0.revoke_owner(2, "lease_revoked: barrier straggler p2")
+    assert len(revoked) == 2
+    assert all(r["state"] == PENDING for r in revoked)
+    assert all(r["prev_owner"] == "p2@5.1" for r in revoked)
+    # revoked leases are immediately claimable, tagged as takeovers
+    assert q0.ready("a.fits")
+    assert q0.claim("a.fits")["takeover_from"] == "p2@5.1"
+    # nothing of q0's own is revocable
+    assert q0.revoke_owner(0, "x") == []
+    q0.close()
+
+
+def test_own_stale_claims_recovered_on_open(tmp_path):
+    """A resumed process recovers ITS OWN previous incarnation's
+    running claims immediately (recovered_from_crash, prev_owner
+    recorded); other owners' claims are left to lease expiry."""
+    wd = str(tmp_path)
+    q_old = WorkQueue(os.path.join(wd, "ledger.0.jsonl"), union_dir=wd,
+                      owner="p0@1.1", lease_s=600.0, process_index=0)
+    q_old.add(["mine.fits"])
+    q_old.claim("mine.fits")
+    q_old.close()
+    q_other = WorkQueue(os.path.join(wd, "ledger.1.jsonl"),
+                        union_dir=wd, owner="p1@2.1", lease_s=600.0,
+                        process_index=1)
+    q_other.add(["theirs.fits"])
+    q_other.claim("theirs.fits")
+    q_other.close()
+
+    q_new = WorkQueue(os.path.join(wd, "ledger.0.jsonl"), union_dir=wd,
+                      owner="p0@3.1", lease_s=600.0, process_index=0)
+    rec = q_new.record("mine.fits")
+    assert rec["state"] == PENDING
+    assert rec["reason"] == "recovered_from_crash"
+    assert rec["prev_owner"] == "p0@1.1"
+    # the sibling's unexpired lease is untouched
+    assert q_new.record("theirs.fits")["state"] == RUNNING
+    assert not q_new.ready("theirs.fits")
+    q_new.close()
+
+
+# -- elastic survey execution ------------------------------------------
+
+def test_resume_with_different_process_count_takes_over_lease(
+        survey, tmp_path):
+    """Tentpole acceptance: a 2-process survey loses one process to a
+    hard death mid-claim; the resume runs with a DIFFERENT process
+    count (1), takes over the expired lease with a visible revocation,
+    and every archive ends done exactly once with exactly one
+    checkpoint block — the takeover auditable in ledger and obs."""
+    wd = str(tmp_path / "wd")
+    os.makedirs(wd)
+    plan = plan_survey(survey.files, modelfile=survey.gm)
+
+    # simulated process 1 of 2 dies holding a lease on its first
+    # preferred archive (hard death: ledger shows a bare running claim)
+    keys = [info.path for info, _ in plan.archives()]
+    dead = WorkQueue(os.path.join(wd, "ledger.1.jsonl"), union_dir=wd,
+                     owner="p1@4242.1", lease_s=0.2, process_index=1)
+    dead.add(keys)
+    dead.claim(keys[1])
+    dead.close()
+    time.sleep(0.25)  # the lease expires un-renewed
+
+    # resume with ONE process — a topology change, not a restart
+    s = run_survey(plan, wd, process_index=0, process_count=1,
+                   bary=False, backoff_s=0.0, merge=True)
+    assert s["counts"]["done"] == 4
+    assert s["counts"]["running"] == 0
+    assert s["merged_counts"]["done"] == 4
+
+    # the dead process's lease was visibly revoked and taken over
+    key1 = WorkQueue.key_for(keys[1])
+    recs = [r for r in _union_ledger(wd) if r["archive"] == key1]
+    revs = [r for r in recs if r.get("reason") == "lease_expired"]
+    assert len(revs) == 1 and revs[0]["prev_owner"] == "p1@4242.1"
+    takeovers = [r for r in recs
+                 if r.get("takeover_from") == "p1@4242.1"]
+    assert len(takeovers) == 1
+    done = [r for r in recs if r["state"] == "done"]
+    assert len(done) == 1 and done[0]["ckpt"] == 0
+
+    # exactly one block per archive across ALL checkpoints
+    per_arch = {}
+    for pid in (0, 1):
+        for ln in _toa_lines(os.path.join(wd, "toas.%d.tim" % pid)):
+            per_arch[ln.split()[0]] = per_arch.get(ln.split()[0], 0) + 1
+    assert per_arch == {f: 2 for f in survey.files}
+
+    # the obs audit trail accounts for the takeover
+    evs = _obs_events(s["obs_run"])
+    exp = [e for e in evs if e.get("name") == "lease_expired"]
+    assert len(exp) == 1 and exp[0]["prev_owner"] == "p1@4242.1"
+    to = [e for e in evs if e.get("name") == "lease_claimed"
+          and e.get("takeover_from")]
+    assert len(to) == 1 and to[0]["takeover_from"] == "p1@4242.1"
+    from tools.obs_report import summarize
+
+    text = summarize(s["obs_run"])
+    assert "## faults & robustness" in text
+    assert "lease_expired" in text and "takeover_from" in text
+
+
+def test_survivor_waits_out_dead_siblings_lease_in_run(survey,
+                                                       tmp_path):
+    """A live process whose remaining work is leased to a dead sibling
+    WAITS for the lease to expire and takes the work over in the same
+    run — no restart needed (the in-run elasticity claim)."""
+    wd = str(tmp_path / "wd")
+    os.makedirs(wd)
+    plan = plan_survey(survey.files[:1], modelfile=survey.gm)
+    key = plan.buckets[0].archives[0].path
+    dead = WorkQueue(os.path.join(wd, "ledger.1.jsonl"), union_dir=wd,
+                     owner="p1@4343.1", lease_s=1.2, process_index=1)
+    dead.add([key])
+    dead.claim(key)
+    dead.close()
+
+    t0 = time.monotonic()
+    s = run_survey(plan, wd, process_index=0, process_count=1,
+                   bary=False, backoff_s=0.0, merge=False)
+    assert s["counts"]["done"] == 1
+    assert time.monotonic() - t0 >= 0.5  # it genuinely waited
+    recs = [r for r in _union_ledger(wd)
+            if r.get("reason") == "lease_expired"]
+    assert len(recs) == 1 and recs[0]["prev_owner"] == "p1@4343.1"
+
+
+def test_midfit_takeover_abandons_without_transition(survey, tmp_path,
+                                                     monkeypatch):
+    """The double-claim/watchdog discipline under a lease loss: a fit
+    whose lease is taken over mid-flight makes NO ledger transition
+    and drops its own just-written block, so the archive still ends
+    with exactly one done record and one checkpoint block."""
+    from pulseportraiture_tpu.pipelines import toas as toas_mod
+
+    wd = str(tmp_path / "wd")
+    plan = plan_survey(survey.files[:1], modelfile=survey.gm)
+    key = plan.buckets[0].archives[0].path
+    real_fit = toas_mod.fit_portrait_full_batch
+    thief = {"q": None, "n": 0}
+
+    def stealing_fit(*a, **k):
+        thief["n"] += 1
+        if thief["n"] == 1:
+            # a sibling claims the archive mid-fit (as if our lease
+            # had expired under a long dispatch) with a SHORT lease,
+            # so the retry round can take it back after the abandon
+            q = WorkQueue(os.path.join(wd, "ledger.9.jsonl"),
+                          union_dir=wd, owner="p9@1.1", lease_s=0.05,
+                          process_index=9)
+            q.claim(key)
+            q.close()
+        return real_fit(*a, **k)
+
+    monkeypatch.setattr(toas_mod, "fit_portrait_full_batch",
+                        stealing_fit)
+    s = run_survey(plan, wd, process_index=0, process_count=1,
+                   bary=False, backoff_s=0.0, merge=False)
+    monkeypatch.setattr(toas_mod, "fit_portrait_full_batch", real_fit)
+    assert thief["n"] == 2  # first fit abandoned, second landed
+    assert s["counts"]["done"] == 1
+    # exactly one done record (the refit's) and one checkpoint block —
+    # the abandoned fit's block was dropped
+    kkey = WorkQueue.key_for(key)
+    done = [r for r in _union_ledger(wd)
+            if r["archive"] == kkey and r["state"] == "done"]
+    assert len(done) == 1
+    per_arch = {}
+    for ln in _toa_lines(s["checkpoint"]):
+        per_arch[ln.split()[0]] = per_arch.get(ln.split()[0], 0) + 1
+    assert per_arch == {key: 2}
+    evs = _obs_events(s["obs_run"])
+    lost = [e for e in evs if e.get("name") == "lease_lost"]
+    assert len(lost) == 1 and lost[0]["block_dropped"] is True
+    assert lost[0]["new_owner"] == "p9@1.1"
+
+
+def test_status_shows_owners_leases_and_expired(survey, tmp_path):
+    """ppsurvey status on a live multi-shard workdir: per-owner
+    counts, lease time-to-expiry, and expired-but-unreclaimed
+    archives via readonly union replay (satellite)."""
+    wd = str(tmp_path / "wd")
+    os.makedirs(wd)
+    q0 = WorkQueue(os.path.join(wd, "ledger.0.jsonl"), union_dir=wd,
+                   owner="p0@1.1", lease_s=600.0, process_index=0)
+    q0.add(["a.fits", "b.fits", "c.fits"])
+    q0.claim("a.fits")
+    q0.complete("b.fits", n_toas=2)
+    q1 = WorkQueue(os.path.join(wd, "ledger.1.jsonl"), union_dir=wd,
+                   owner="p1@2.1", lease_s=0.01, process_index=1)
+    q1.claim("c.fits")
+    time.sleep(0.02)
+
+    st = survey_status(wd)
+    assert st["counts"]["done"] == 1
+    assert st["counts"]["running"] == 2
+    assert st["owners"]["p0@1.1"] == {"running": 1, "done": 1}
+    assert st["owners"]["p1@2.1"] == {"running": 1}
+    by_arch = {x["archive"]: x for x in st["leases"]}
+    assert len(by_arch) == 2
+    live = by_arch[WorkQueue.key_for("a.fits")]
+    assert live["owner"] == "p0@1.1" and not live["expired"]
+    assert live["expires_in"] > 0
+    (exp,) = st["expired_unreclaimed"]
+    assert exp["archive"] == WorkQueue.key_for("c.fits")
+    assert exp["owner"] == "p1@2.1" and exp["expired"]
+    # status is readonly: the live queues still own their files
+    assert q0.owns("a.fits")
+    q0.close()
+    q1.close()
+
+    # the CLI renders it
+    from pulseportraiture_tpu.cli.ppsurvey import main
+
+    assert main(["status", "-w", wd]) == 0
+
+
+def test_sigkill_clause_parses_and_is_a_real_hard_kill(tmp_path):
+    """The sigkill chaos clause parses like the other signal clauses
+    (never fired in-process here — it would kill the test runner; the
+    end-to-end proof is the elastic stage of tools/chaos_smoke.py)."""
+    import signal as _signal
+
+    (c,) = faults._parse("sigkill@after=2,at=dispatch")
+    assert c.signal == "sigkill" and c.after == 2
+    assert faults._SIGNALS["sigkill"] == _signal.SIGKILL
+    with pytest.raises(ValueError):
+        faults._parse("sigkill@nth=1")  # signal clauses need after=

@@ -1,0 +1,115 @@
+"""Narrowband surveys through the runner (ISSUE 6 satellite / carried
+ROADMAP item): ``run_survey(narrowband=True)`` routes
+``get_narrowband_TOAs`` through the same bucket/ledger/lease/
+checkpoint machinery as the wideband driver — per-channel TOAs are
+checkpointed with the block + ``pp_done`` marker protocol, resume
+refits nothing, and the ledger carries the per-archive TOA counts.
+"""
+
+import json
+import os
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.io.archive import make_fake_pulsar
+from pulseportraiture_tpu.io.gmodel import write_model
+from pulseportraiture_tpu.pipelines.toas import GetTOAs
+from pulseportraiture_tpu.runner.execute import run_survey
+from pulseportraiture_tpu.runner.plan import plan_survey
+from pulseportraiture_tpu.runner.queue import WorkQueue
+
+MODEL_PARAMS = np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5])
+
+
+@pytest.fixture(scope="module")
+def survey(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("runner_nb")
+    gm = str(tmp / "n.gmodel")
+    write_model(gm, "n", "000", 1500.0, MODEL_PARAMS, np.ones(8, int),
+                -4.0, 0, quiet=True)
+    par = str(tmp / "n.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    files = []
+    for i in range(2):
+        out = str(tmp / f"n{i}.fits")
+        make_fake_pulsar(gm, par, out, nsub=2, nchan=8, nbin=128,
+                         nu0=1500.0, bw=400.0, tsub=60.0,
+                         phase=0.02 * (i + 1), dDM=5e-4,
+                         noise_stds=0.01, dedispersed=False,
+                         seed=150 + i, quiet=True)
+        files.append(out)
+    return SimpleNamespace(tmp=tmp, gm=gm, files=files)
+
+
+def _tim_blocks(ckpt):
+    """{archive: (n_toa_lines, n_markers)} per archive in a .tim."""
+    toas, markers = {}, {}
+    for ln in open(ckpt):
+        tok = ln.split()
+        if not tok:
+            continue
+        if tok[:2] == ["C", "pp_done"]:
+            markers[tok[2]] = markers.get(tok[2], 0) + 1
+        elif tok[0] not in ("FORMAT", "C", "#"):
+            toas[tok[0]] = toas.get(tok[0], 0) + 1
+    return toas, markers
+
+
+def test_narrowband_survey_runs_and_resumes(survey, tmp_path):
+    wd = str(tmp_path / "wd")
+    plan = plan_survey(survey.files, modelfile=survey.gm)
+    s1 = run_survey(plan, wd, process_index=0, process_count=1,
+                    backoff_s=0.0, merge=False, narrowband=True)
+    assert s1["counts"]["done"] == 2
+    assert s1["counts"]["failed"] == 0
+
+    # per-channel checkpoint blocks: nsub * nchan TOA lines + ONE
+    # pp_done marker per archive, same protocol as wideband
+    toas, markers = _tim_blocks(s1["checkpoint"])
+    assert toas == {f: 2 * 8 for f in survey.files}
+    assert markers == {f: 1 for f in survey.files}
+    # the ledger records the per-channel TOA count
+    for rec in json.load(open(os.path.join(
+            wd, "survey.0.json")))["archives"].values():
+        assert rec["state"] == "done" and rec["n_toas"] == 16
+
+    # resume refits nothing: still exactly one done record and one
+    # block per archive
+    s2 = run_survey(plan, wd, process_index=0, process_count=1,
+                    backoff_s=0.0, merge=False, narrowband=True)
+    assert s2["counts"]["done"] == 2
+    done = {}
+    with open(os.path.join(wd, "ledger.0.jsonl")) as fh:
+        for ln in fh:
+            rec = json.loads(ln)
+            if rec["state"] == "done":
+                done[rec["archive"]] = done.get(rec["archive"], 0) + 1
+    assert done == {WorkQueue.key_for(f): 1 for f in survey.files}
+    toas, markers = _tim_blocks(s2["checkpoint"])
+    assert toas == {f: 2 * 8 for f in survey.files}
+    assert markers == {f: 1 for f in survey.files}
+
+
+def test_narrowband_checkpoint_resume_skips_done_archive(survey,
+                                                         tmp_path):
+    """get_narrowband_TOAs honors the checkpoint directly (outside the
+    runner): a second call over the same checkpoint skips the archive
+    without appending a duplicate block."""
+    ckpt = str(tmp_path / "nb.tim")
+    gt = GetTOAs([survey.files[0]], survey.gm, quiet=True)
+    gt.get_narrowband_TOAs(checkpoint=ckpt, quiet=True)
+    assert len(gt.TOA_list) == 16
+    toas, markers = _tim_blocks(ckpt)
+    assert toas == {survey.files[0]: 16}
+    assert markers == {survey.files[0]: 1}
+
+    gt2 = GetTOAs([survey.files[0]], survey.gm, quiet=True)
+    gt2.get_narrowband_TOAs(checkpoint=ckpt, quiet=True)
+    assert len(gt2.TOA_list) == 0  # skipped, not refit
+    toas, markers = _tim_blocks(ckpt)
+    assert toas == {survey.files[0]: 16}
+    assert markers == {survey.files[0]: 1}

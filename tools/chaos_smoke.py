@@ -1,9 +1,11 @@
 """Chaos smoke gate: a survey under injected faults must drain and
-resume losslessly (wired into tools/check.sh).
+resume losslessly, and an elastically-resumed survey must survive a
+hard kill + topology change (wired into tools/check.sh).
 
-Builds 4 good archives (one shape bucket, so the fit order is the
-metafile order) plus one header-corrupt file, then runs the survey
-with the chaos harness active via the environment::
+**Stage 1 (drain/resume).**  Builds 4 good archives (one shape bucket,
+so the fit order is the metafile order) plus one header-corrupt file,
+then runs the survey with the chaos harness active via the
+environment::
 
     PPTPU_FAULTS="site:archive_read@nth=1;site:dispatch@nth=2;sigterm@after=3"
 
@@ -21,20 +23,36 @@ with the exact expected counts — 4 done + 1 quarantined — having refit
 nothing already done, with zero duplicated or lost ``.tim`` blocks,
 and with the injected faults + drain auditable in the obs run.
 
+**Stage 2 (elastic).**  A 2-process survey whose process 1 is a REAL
+subprocess hard-killed by ``PPTPU_FAULTS="sigkill@after=2"`` mid-run —
+no handler, no drain, a stranded ``running`` lease on the ledger.  The
+survey is then resumed with ONE process (capped, leaving work over)
+and finally with THREE (a second topology change), which must take
+over the dead process's expired lease.  Asserted (docs/RUNNER.md
+"Elasticity"): every archive ends done or quarantined exactly once,
+each done archive has exactly one checkpoint block across ALL
+``toas.*.tim`` files, the dead process's lease revocation + takeover
+are visible in the union ledger, and the merged obs report's
+"faults & robustness" section accounts for the takeover.
+
 Run:  env JAX_PLATFORMS=cpu python -m tools.chaos_smoke
 """
 
 import json
 import os
 import shutil
+import subprocess
 import sys
 import tempfile
+import time
 
 import numpy as np
 
 FAULT_SPEC = ("site:archive_read@nth=1;"
               "site:dispatch@nth=2;"
               "sigterm@after=3")
+
+ELASTIC_FAULT_SPEC = "sigkill@after=2"  # hard kill at the 2nd dispatch
 
 
 def _events(run_dir):
@@ -45,6 +63,147 @@ def _events(run_dir):
         with open(path, encoding="utf-8") as fh:
             out.extend(json.loads(ln) for ln in fh if ln.strip())
     return out
+
+
+def _union_ledger(workdir):
+    recs = []
+    for name in sorted(os.listdir(workdir)):
+        if name.startswith("ledger.") and name.endswith(".jsonl"):
+            with open(os.path.join(workdir, name)) as fh:
+                for ln in fh:
+                    ln = ln.strip()
+                    if ln:
+                        recs.append(json.loads(ln))
+    return recs
+
+
+def _tim_union(workdir):
+    """{archive: n_toa_lines} and {archive: n_markers} across ALL
+    per-process checkpoints."""
+    toas, markers = {}, {}
+    for name in sorted(os.listdir(workdir)):
+        if not (name.startswith("toas.") and name.endswith(".tim")):
+            continue
+        for ln in open(os.path.join(workdir, name)):
+            tok = ln.split()
+            if not tok:
+                continue
+            if tok[:2] == ["C", "pp_done"]:
+                markers[tok[2]] = markers.get(tok[2], 0) + 1
+            elif tok[0] not in ("FORMAT", "C", "#"):
+                toas[tok[0]] = toas.get(tok[0], 0) + 1
+    return toas, markers
+
+
+def _elastic_stage(workroot, gm, par):
+    """Stage 2: sigkill one of two processes mid-run, then resume with
+    1 and with 3 processes — zero lost, zero duplicated archives."""
+    from pulseportraiture_tpu.io.archive import make_fake_pulsar
+    from pulseportraiture_tpu.runner import plan_survey, run_survey
+    from pulseportraiture_tpu.runner.execute import survey_status
+
+    files = []
+    for i in range(5):
+        fits = os.path.join(workroot, "el%d.fits" % i)
+        make_fake_pulsar(gm, par, fits, nsub=2, nchan=8, nbin=64,
+                         nu0=1500.0, bw=800.0, tsub=60.0,
+                         phase=0.02 * (i + 1), dDM=5e-4,
+                         noise_stds=0.01, dedispersed=False,
+                         seed=61 + i, quiet=True)
+        files.append(fits)
+    corrupt = os.path.join(workroot, "el_corrupt.fits")
+    with open(corrupt, "wb") as f:
+        f.write(b"SIMPLE  =                    T" + b"\x00" * 64)
+    meta = os.path.join(workroot, "elastic.meta")
+    with open(meta, "w") as f:
+        f.write("\n".join(files + [corrupt]) + "\n")
+    wd = os.path.join(workroot, "wd_elastic")
+    os.makedirs(wd)
+    plan = plan_survey(meta, modelfile=gm)
+    assert plan.n_archives == 5 and len(plan.unreadable) == 1, \
+        plan.to_dict()
+    plan.save(os.path.join(wd, "plan.json"))
+
+    # -- process 1 of 2: a REAL subprocess, hard-killed at ~50% -------
+    # sigkill bypasses the SIGTERM drain entirely: no flush, no
+    # transition — exactly the failure lease expiry exists for.  Short
+    # --lease so the stranded claim expires quickly.
+    env = dict(os.environ)
+    env["PPTPU_FAULTS"] = ELASTIC_FAULT_SPEC
+    env["JAX_PLATFORMS"] = "cpu"
+    victim = subprocess.run(
+        [sys.executable, "-m", "pulseportraiture_tpu.cli.ppsurvey",
+         "run", "-w", wd, "--process", "1", "--processes", "2",
+         "--no_bary", "--quiet", "--backoff", "0", "--lease", "1"],
+        env=env, cwd=os.getcwd(), timeout=240,
+        capture_output=True)
+    assert victim.returncode == -9, (victim.returncode,
+                                     victim.stderr[-2000:])
+    st = survey_status(wd)
+    assert st["counts"]["running"] == 1, st["counts"]  # stranded lease
+    assert st["counts"]["done"] == 1, st["counts"]
+
+    # -- resume with ONE process (topology change #1), capped --------
+    time.sleep(1.1)  # let the dead lease expire
+    s1 = run_survey(plan, wd, process_index=0, process_count=1,
+                    bary=False, backoff_s=0.0, max_archives=2,
+                    merge=False, lease_s=30.0)
+    assert s1["counts"]["done"] == 3, s1["counts"]
+
+    # -- resume with THREE processes (topology change #2) ------------
+    # process 2 runs first so the dead p1 lease is taken over by a
+    # DIFFERENT process index through lease expiry (were p1-of-3 to
+    # reach it first, it would recover its own stale claim instead —
+    # the recovered_from_crash path, already covered by stage 1)
+    run_survey(plan, wd, process_index=2, process_count=3, bary=False,
+               backoff_s=0.0, merge=False, lease_s=30.0)
+    run_survey(plan, wd, process_index=1, process_count=3, bary=False,
+               backoff_s=0.0, merge=False, lease_s=30.0)
+    s0 = run_survey(plan, wd, process_index=0, process_count=3,
+                    bary=False, backoff_s=0.0, merge=True,
+                    lease_s=30.0)
+    assert s0["counts"]["done"] == 5, s0["counts"]
+    assert s0["counts"]["quarantined"] == 1, s0["counts"]
+    assert s0["counts"]["running"] == s0["counts"]["pending"] == 0
+    assert s0["merged_counts"]["done"] == 5
+
+    # zero lost, zero duplicated: exactly one done record per archive
+    # and one quarantine for the corrupt file across the UNION
+    recs = _union_ledger(wd)
+    done = {}
+    quar = {}
+    for rec in recs:
+        if rec["state"] == "done":
+            done[rec["archive"]] = done.get(rec["archive"], 0) + 1
+        elif rec["state"] == "quarantined":
+            quar[rec["archive"]] = quar.get(rec["archive"], 0) + 1
+    assert done == {os.path.realpath(f): 1 for f in files}, done
+    assert quar == {os.path.realpath(corrupt): 1}, quar
+
+    # exactly one checkpoint block per done archive across ALL
+    # toas.*.tim files (nsub=2 TOA lines + 1 marker each)
+    toas, markers = _tim_union(wd)
+    assert toas == {f: 2 for f in files}, toas
+    assert markers == {f: 1 for f in files}, markers
+
+    # the dead process's lease is visibly revoked in the ledger and
+    # taken over by a different-topology process
+    revs = [r for r in recs if r.get("reason") == "lease_expired"
+            and str(r.get("prev_owner", "")).startswith("p1@")]
+    assert len(revs) == 1, revs
+    takeovers = [r for r in recs if r.get("takeover_from")
+                 and str(r["takeover_from"]).startswith("p1@")]
+    assert len(takeovers) == 1, takeovers
+    assert takeovers[0]["archive"] == revs[0]["archive"]
+
+    # the merged obs report accounts for the takeover
+    from tools.obs_report import summarize
+
+    text = summarize(os.path.join(wd, "obs_merged"))
+    assert "## faults & robustness" in text, text
+    assert "lease_expired" in text, text
+    assert "takeover_from" in text, text
+    return len(takeovers)
 
 
 def main():
@@ -147,9 +306,17 @@ def main():
         assert "## faults & robustness" in text, text
         assert "fault_injected" in text and "sigterm_drain" in text
 
-        print("chaos smoke OK: drained at 50% under "
+        # -- stage 2: elastic resume across a hard kill + topology
+        # changes (sigkill a real subprocess, resume with 1 then 3
+        # processes; zero lost, zero duplicated archives) ------------
+        n_takeovers = _elastic_stage(workroot, gm, par)
+
+        print("chaos smoke OK: drained at 50%% under "
               "read+dispatch+SIGTERM faults, resumed to 4 done + "
-              "1 quarantined with no duplicated or lost blocks")
+              "1 quarantined with no duplicated or lost blocks; "
+              "elastic stage OK: sigkilled 1 of 2 processes, resumed "
+              "with 1 then 3 processes, %d lease takeover, zero "
+              "lost/duplicated archives" % n_takeovers)
         return 0
     finally:
         if prev_spec is None:

@@ -16,8 +16,12 @@
 #   6. chaos smoke — the same survey machinery under injected faults
 #                 (corrupt read, transient dispatch fault, SIGTERM at
 #                 ~50% progress): must drain, then resume to the exact
-#                 expected counts with no duplicated/lost .tim blocks
-#                 (docs/RUNNER.md, testing/faults.py)
+#                 expected counts with no duplicated/lost .tim blocks;
+#                 plus the elastic stage: one of two processes
+#                 sigkilled mid-run (a real subprocess), resumed with
+#                 1 and then 3 processes — zero lost and zero
+#                 duplicated archives (docs/RUNNER.md Elasticity,
+#                 testing/faults.py)
 #   7. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
 #
 # Exit status is non-zero when any stage fails.
@@ -76,8 +80,8 @@ else
 fi
 
 echo
-echo "== chaos smoke (fault injection + drain + resume, docs/RUNNER.md) =="
-timeout -k 10 300 env JAX_PLATFORMS=cpu PPTPU_OBS_DIR="" PPTPU_FAULTS="" \
+echo "== chaos smoke (faults + drain/resume + elastic sigkill, docs/RUNNER.md) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu PPTPU_OBS_DIR="" PPTPU_FAULTS="" \
     python -m tools.chaos_smoke >/tmp/_chaos_smoke.log 2>&1
 if [ $? -ne 0 ]; then
     tail -40 /tmp/_chaos_smoke.log

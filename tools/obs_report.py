@@ -293,24 +293,41 @@ def summarize_fits(events):
 
 _ROBUSTNESS_EVENTS = ("fault_injected", "watchdog_fired",
                       "sigterm_drain", "barrier_timeout",
-                      "nonfinite_guard")
+                      "nonfinite_guard", "lease_expired",
+                      "lease_revoked", "lease_lost",
+                      "lease_claim_lost")
+# lease events counted but not detailed by default: a claim per
+# archive and a renewal per heartbeat would drown the audit trail —
+# except takeover claims, which ARE the elasticity audit
+_LEASE_COUNT_ONLY = ("lease_claimed", "lease_renewed")
 
 
 def summarize_robustness(events):
     """Chaos/robustness audit trail: injected faults, watchdog
-    firings, preemption drains, barrier timeouts and non-finite-guard
-    decisions (docs/RUNNER.md failure-modes matrix) — a chaos run must
-    be reviewable from its report alone."""
+    firings, preemption drains, barrier timeouts, non-finite-guard
+    decisions, and the lease lifecycle — expiries, revocations and
+    every takeover claim — (docs/RUNNER.md failure-modes matrix): a
+    chaos run must be reviewable from its report alone."""
     evs = [e for e in events if e.get("kind") == "event"
-           and e.get("name") in _ROBUSTNESS_EVENTS]
+           and (e.get("name") in _ROBUSTNESS_EVENTS
+                or e.get("name") in _LEASE_COUNT_ONLY)]
     if not evs:
         return None
     counts = {}
+    n_takeovers = 0
     for e in evs:
         counts[e["name"]] = counts.get(e["name"], 0) + 1
+        if e["name"] == "lease_claimed" and e.get("takeover_from"):
+            n_takeovers += 1
+    if n_takeovers:
+        counts["lease_takeovers"] = n_takeovers
     lines = ["  ".join("%s: %d" % (k, v)
                        for k, v in sorted(counts.items()))]
-    for e in evs[:20]:
+    detailed = [e for e in evs
+                if e["name"] in _ROBUSTNESS_EVENTS
+                or (e["name"] == "lease_claimed"
+                    and e.get("takeover_from"))]
+    for e in detailed[:20]:
         detail = {k: v for k, v in e.items()
                   if k not in ("kind", "t", "name") and v is not None}
         try:
@@ -319,8 +336,8 @@ def summarize_robustness(events):
                                                  sort_keys=True)))
         except (TypeError, ValueError):
             lines.append("- %s" % e["name"])
-    if len(evs) > 20:
-        lines.append("- ... %d more" % (len(evs) - 20))
+    if len(detailed) > 20:
+        lines.append("- ... %d more" % (len(detailed) - 20))
     return "\n".join(lines)
 
 
