@@ -174,6 +174,34 @@ def host_array(x):
     return np.asarray(x)
 
 
+def set_compile_cache_dir(cache_dir):
+    """Point jax's persistent compilation cache at ``cache_dir``.
+
+    Deployment policy, so it lives here with the rest of the global
+    jax configuration (jaxlint J005).  Thresholds are dropped to zero
+    so every program qualifies: the cache exists to save multi-minute
+    survey/service compiles, but it must also prove itself on the tiny
+    smoke-test programs (service/warm.py, docs/SERVICE.md).
+    """
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs",
+                       0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(knob, val)
+        except AttributeError:  # older jax: defaults still cache
+            pass
+    try:
+        # the cache module latches a disabled state after the first
+        # compile that ran without a directory configured; reset so a
+        # mid-process enable (ppserve --compile-cache) takes effect
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:
+        pass
+
+
 __all__ = [
     "Dconst",
     "Dconst_exact",
@@ -197,4 +225,5 @@ __all__ = [
     "subint_scan_size",
     "profile_scan_threshold",
     "profile_scan_size",
+    "set_compile_cache_dir",
 ]

@@ -1180,7 +1180,7 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
                             polish_iter=None, seed=None,
                             scat_hint=None, coarse_kmax=None,
                             coarse_iter=None, data_spectra=None,
-                            pad_to=None):
+                            pad_to=None, aot=False):
     """vmapped+jitted fit over a batch of subints: data [B, nchan, nbin].
 
     model_ports/freqs broadcast over the batch; returns a DataBunch of
@@ -1221,6 +1221,16 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
     ``pad_to``: pad the batch up to this size (copies of the last
     subint, dropped from the outputs) so different batch sizes share
     one compiled program per bucket — see ``bucket_batch_size``.
+
+    ``aot=True`` compiles the batched-solver program ahead of time
+    (``jit(...).lower().compile()``) instead of executing it, and
+    returns the compiled executable.  All the argument canonicalization
+    above still runs, so the lowered program is byte-identical to what
+    the same call would execute — with ``jax_compilation_cache_dir``
+    configured, the XLA result lands in the persistent compile cache
+    and a later process (or this one's first real dispatch) retrieves
+    it instead of paying the cold compile (service/warm.py,
+    docs/SERVICE.md).
     """
     # static harmonic cutoff from the (concrete, pre-broadcast) model
     if kmax is None:
@@ -1336,18 +1346,21 @@ def fit_portrait_full_batch(data_ports, model_ports, init_params, Ps,
             else "exact"
     else:
         data_spectra_t = str(data_spectra)
-    out = _batch_impl(data_ports, model_ports, init_b, Ps_b, freqs_b,
-                      errs_b, weights_b, nu_fits_b, nu_outs_b,
-                      nu_outs_mask, flags_t, bounds_t, bool(log10_tau),
-                      int(max_iter), scat, pair, kmax, scan_size, cast_t,
-                      seed=seed,
-                      polish_iter=None if polish_iter is None
-                      else int(polish_iter),
-                      coarse_kmax=None if coarse_kmax is None
-                      else int(coarse_kmax),
-                      coarse_iter=None if coarse_iter is None
-                      else int(coarse_iter),
-                      data_spectra=data_spectra_t)
+    impl_kw = dict(seed=seed,
+                   polish_iter=None if polish_iter is None
+                   else int(polish_iter),
+                   coarse_kmax=None if coarse_kmax is None
+                   else int(coarse_kmax),
+                   coarse_iter=None if coarse_iter is None
+                   else int(coarse_iter),
+                   data_spectra=data_spectra_t)
+    impl_args = (data_ports, model_ports, init_b, Ps_b, freqs_b,
+                 errs_b, weights_b, nu_fits_b, nu_outs_b,
+                 nu_outs_mask, flags_t, bounds_t, bool(log10_tau),
+                 int(max_iter), scat, pair, kmax, scan_size, cast_t)
+    if aot:
+        return _batch_impl.lower(*impl_args, **impl_kw).compile()
+    out = _batch_impl(*impl_args, **impl_kw)
     if data_ports.shape[0] != B:  # drop scan padding
         out = jax.tree_util.tree_map(lambda a: a[:B], out)
     # opt-in NaN hook (PPTPU_SANITIZE): fail at the fit that produced a

@@ -233,6 +233,13 @@ class Recorder:
             stack = _span_stack()
             self.emit("compile", dur_s=round(duration, 6),
                       span="/".join(s.name for s in stack) or None)
+        elif evt == monitor.CACHE_HIT_EVENT:
+            # persistent-compile-cache outcome counters: a warm-started
+            # process proves its cold compiles were saved here
+            # (docs/SERVICE.md zero-cold-start)
+            self.bump("compile_cache_hits")
+        elif evt == monitor.CACHE_MISS_EVENT:
+            self.bump("compile_cache_misses")
 
     # -- manifest -------------------------------------------------------
 

@@ -24,19 +24,32 @@ _ENV_KEYS_PREFIX = "PPTPU_"
 _ENV_KEYS_EXTRA = ("JAX_PLATFORMS", "XLA_FLAGS")
 
 
+_GIT_SHA_CACHE = []  # [sha-or-None] once resolved
+
+
 def git_sha():
-    """HEAD commit of the repo this package lives in, or None."""
+    """HEAD commit of the repo this package lives in, or None.
+
+    Memoized after the first lookup: the TOA service opens one run
+    (and hence one manifest) per request, and a git subprocess per
+    request would dominate small-request latency.  HEAD moving under a
+    live process is not a case worth a stale-cache defense.
+    """
+    if _GIT_SHA_CACHE:
+        return _GIT_SHA_CACHE[0]
     root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+    sha = None
     try:
         out = subprocess.run(
             ["git", "-C", root, "rev-parse", "HEAD"],
             capture_output=True, text=True, timeout=10)
         if out.returncode == 0:
-            return out.stdout.strip()
+            sha = out.stdout.strip()
     except (OSError, subprocess.SubprocessError):
         pass
-    return None
+    _GIT_SHA_CACHE.append(sha)
+    return sha
 
 
 def _device_info():

@@ -19,16 +19,41 @@ poison the shared listener).
 
 import threading
 
-__all__ = ["TRACE_EVENT", "COMPILE_EVENT", "subscribe", "unsubscribe"]
+__all__ = ["TRACE_EVENT", "COMPILE_EVENT", "CACHE_HIT_EVENT",
+           "CACHE_MISS_EVENT", "subscribe", "unsubscribe"]
 
 # the two duration events the repo's telemetry is built on: one fires
 # per jaxpr trace, one per backend (XLA) compile
 TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
 COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
+# persistent-compilation-cache outcomes (plain events, no duration):
+# with jax_compilation_cache_dir configured every backend compile is
+# preceded by exactly one of these, so hit/miss counters answer "did
+# the warm stage actually save this process a cold compile?"
+# (docs/SERVICE.md zero-cold-start)
+CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
 _lock = threading.Lock()
 _subscribers = []
 _listener_installed = False
+
+
+def _fan_out(event, duration):
+    if not _subscribers:
+        return
+    with _lock:
+        subs = list(_subscribers)
+    for cb in subs:
+        try:
+            cb(event, float(duration))
+        except Exception:
+            # a broken subscriber must not take down the process's
+            # only listener; drop it
+            with _lock:
+                if cb in _subscribers:
+                    _subscribers.remove(cb)
 
 
 def _install_listener():
@@ -38,21 +63,19 @@ def _install_listener():
     import jax.monitoring
 
     def _on_duration(event, duration=0.0, **kwargs):
-        if not _subscribers:
-            return
-        with _lock:
-            subs = list(_subscribers)
-        for cb in subs:
-            try:
-                cb(event, float(duration))
-            except Exception:
-                # a broken subscriber must not take down the process's
-                # only listener; drop it
-                with _lock:
-                    if cb in _subscribers:
-                        _subscribers.remove(cb)
+        _fan_out(event, duration)
+
+    def _on_event(event, **kwargs):
+        _fan_out(event, 0.0)
 
     jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    try:
+        # plain (durationless) events carry the compilation-cache
+        # hit/miss stream; older jax without the API just loses those
+        # counters, never the duration telemetry
+        jax.monitoring.register_event_listener(_on_event)
+    except AttributeError:
+        pass
     _listener_installed = True
 
 

@@ -12,6 +12,7 @@ tim writing) carries over.
 
 import os
 import sys
+import threading
 import time
 
 import jax
@@ -35,6 +36,24 @@ from ..testing import faults
 from ..utils.databunch import DataBunch
 
 __all__ = ["GetTOAs", "drop_checkpoint_blocks"]
+
+# Per-checkpoint-file locks: the TOA service (service/daemon.py) runs
+# several requests of one tenant concurrently to micro-batch their
+# device dispatches, and those fits share the tenant's .tim checkpoint.
+# Block+marker appends, the entry-time resume validation (which may
+# REWRITE the file) and reconcile-time block drops must not interleave.
+# Single-threaded callers pay one uncontended lock acquire per archive.
+_CKPT_LOCKS = {}
+_CKPT_LOCKS_GUARD = threading.Lock()
+
+
+def _checkpoint_lock(checkpoint):
+    key = os.path.realpath(checkpoint)
+    with _CKPT_LOCKS_GUARD:
+        lock = _CKPT_LOCKS.get(key)
+        if lock is None:
+            lock = _CKPT_LOCKS[key] = threading.RLock()
+    return lock
 
 
 def _nonfinite_guard(ports, errs_b, weights_b):
@@ -89,6 +108,11 @@ def _resume_checkpoint(checkpoint, quiet=True):
     resumed run matches archives regardless of path spelling (relative
     vs absolute vs './'-prefixed).
     """
+    with _checkpoint_lock(checkpoint):
+        return _resume_checkpoint_locked(checkpoint, quiet)
+
+
+def _resume_checkpoint_locked(checkpoint, quiet):
     with open(checkpoint) as cf:
         lines = cf.readlines()
     has_markers = any(len(t) >= 4 and t[0] == "C" and t[1] == "pp_done"
@@ -187,25 +211,26 @@ def drop_checkpoint_blocks(checkpoint, archives):
     targets = {os.path.realpath(a) for a in archives}
     if not targets or not os.path.isfile(checkpoint):
         return 0
-    with open(checkpoint) as cf:
-        lines = cf.readlines()
-    kept, dropped = [], 0
-    for ln in lines:
-        tok = ln.split()
-        if len(tok) >= 4 and tok[0] == "C" and tok[1] == "pp_done":
-            if os.path.realpath(tok[2]) in targets:
-                dropped += 1
+    with _checkpoint_lock(checkpoint):
+        with open(checkpoint) as cf:
+            lines = cf.readlines()
+        kept, dropped = [], 0
+        for ln in lines:
+            tok = ln.split()
+            if len(tok) >= 4 and tok[0] == "C" and tok[1] == "pp_done":
+                if os.path.realpath(tok[2]) in targets:
+                    dropped += 1
+                    continue
+            elif tok and tok[0] not in ("FORMAT", "C", "#") and \
+                    os.path.realpath(tok[0]) in targets:
                 continue
-        elif tok and tok[0] not in ("FORMAT", "C", "#") and \
-                os.path.realpath(tok[0]) in targets:
-            continue
-        kept.append(ln)
-    if dropped or len(kept) != len(lines):
-        tmp = checkpoint + ".tmp"
-        with open(tmp, "w") as tf:
-            tf.writelines(kept)
-        os.replace(tmp, checkpoint)
-    return dropped
+            kept.append(ln)
+        if dropped or len(kept) != len(lines):
+            tmp = checkpoint + ".tmp"
+            with open(tmp, "w") as tf:
+                tf.writelines(kept)
+            os.replace(tmp, checkpoint)
+        return dropped
 
 
 def _detect_model_type(modelfile):
@@ -225,6 +250,22 @@ class GetTOAs:
     .gmodel, spline container, or FITS template.  API and result
     attributes follow /root/reference/pptoas.py:75-148.
     """
+
+    # per-archive result lists (names per the reference); the TOA
+    # service's fitter pool resets exactly these between requests so a
+    # long-lived instance cannot accumulate unbounded result state
+    # (service/daemon.py)
+    RESULT_ATTRS = (
+        "order", "obs", "doppler_fs", "nu0s", "nu_fits",
+        "nu_refs", "ok_idatafiles", "ok_isubs", "epochs",
+        "MJDs", "Ps", "phis", "phi_errs", "TOAs", "TOA_errs",
+        "DM0s", "DMs", "DM_errs", "DeltaDM_means",
+        "DeltaDM_errs", "GMs", "GM_errs", "taus", "tau_errs",
+        "alphas", "alpha_errs", "scales", "scale_errs",
+        "snrs", "channel_snrs", "profile_fluxes",
+        "profile_flux_errs", "fluxes", "flux_errs",
+        "flux_freqs", "covariances", "red_chi2s", "nfevals",
+        "rcs", "fit_durations", "n_nonfinite_zapped")
 
     def __init__(self, datafiles, modelfile, quiet=True):
         if isinstance(datafiles, str):
@@ -254,17 +295,7 @@ class GetTOAs:
         # monkeypatch the module attribute); the survey runner installs
         # a mesh-sharded fitter here (runner/execute.py)
         self.fit_batch = None
-        # per-archive result lists (names per the reference)
-        for attr in ["order", "obs", "doppler_fs", "nu0s", "nu_fits",
-                     "nu_refs", "ok_idatafiles", "ok_isubs", "epochs",
-                     "MJDs", "Ps", "phis", "phi_errs", "TOAs", "TOA_errs",
-                     "DM0s", "DMs", "DM_errs", "DeltaDM_means",
-                     "DeltaDM_errs", "GMs", "GM_errs", "taus", "tau_errs",
-                     "alphas", "alpha_errs", "scales", "scale_errs",
-                     "snrs", "channel_snrs", "profile_fluxes",
-                     "profile_flux_errs", "fluxes", "flux_errs",
-                     "flux_freqs", "covariances", "red_chi2s", "nfevals",
-                     "rcs", "fit_durations", "n_nonfinite_zapped"]:
+        for attr in self.RESULT_ATTRS:
             setattr(self, attr, [])
         self.TOA_list = []
 
@@ -926,8 +957,9 @@ class GetTOAs:
                     "snr", 0.0, ">=", pass_unflagged=False)
                 blk = [format_toa_line(t) for t in arch_toas]
                 blk.append("C pp_done %s %d" % (datafile, len(blk)))
-                with open(checkpoint, "a") as cf:
-                    cf.write("".join(line + "\n" for line in blk))
+                with _checkpoint_lock(checkpoint):
+                    with open(checkpoint, "a") as cf:
+                        cf.write("".join(line + "\n" for line in blk))
             ph.done(fit_duration_s=round(fit_duration, 6),
                     n_toas=len(ok), n_nonfinite_zapped=n_zap)
             if not quiet:
@@ -1327,8 +1359,9 @@ class GetTOAs:
                     "snr", 0.0, ">=", pass_unflagged=False)
                 blk = [format_toa_line(t) for t in arch_toas]
                 blk.append("C pp_done %s %d" % (datafile, len(blk)))
-                with open(checkpoint, "a") as cf:
-                    cf.write("".join(line + "\n" for line in blk))
+                with _checkpoint_lock(checkpoint):
+                    with open(checkpoint, "a") as cf:
+                        cf.write("".join(line + "\n" for line in blk))
             ph.done(fit_duration_s=round(fit_duration, 6), n_toas=M,
                     n_nonfinite_zapped=n_zap)
             if not quiet:

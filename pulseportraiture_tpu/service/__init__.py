@@ -1,0 +1,22 @@
+"""TOA-as-a-service: resident multi-tenant fitting daemon.
+
+Turns the batch-shaped survey pipeline into a long-lived service:
+per-tenant ledger-backed intake, warm per-bucket fitter pools with AOT
+program warm-up, cross-request micro-batching, fairness/backpressure
+between tenants, and per-request observability runs.  See
+docs/SERVICE.md and the ``ppserve`` CLI (cli/ppserve.py).
+
+Host-side orchestration by contract: no entry point here may be
+called inside jit (jaxlint J002 covers the ``service.*`` surface).
+"""
+
+from .batcher import MicroBatcher
+from .daemon import Request, TOAService
+from .server import DEFAULT_SOCKET_NAME, ServiceServer, client_request
+from .warm import (enable_persistent_cache, program_specs,
+                   synth_databunch, warm_plan)
+
+__all__ = ["TOAService", "Request", "MicroBatcher", "ServiceServer",
+           "client_request", "DEFAULT_SOCKET_NAME", "warm_plan",
+           "program_specs", "synth_databunch",
+           "enable_persistent_cache"]

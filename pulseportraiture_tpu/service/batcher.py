@@ -1,0 +1,294 @@
+"""Cross-request micro-batching: coalesce same-bucket device dispatches.
+
+The service fits each request of a micro-batch on its own worker
+thread, through an ordinary per-request ``GetTOAs`` whose ``fit_batch``
+hook points at one shared :class:`MicroBatcher` per shape bucket.  The
+hook is where the requests meet: each worker's batched-fit call parks
+with its argument set, and once every live worker of the cycle has
+either parked or finished, the last arriver becomes the *leader* — it
+concatenates the parked batches along the subint axis, issues ONE
+``fit_portrait_full_batch`` dispatch for the combined batch, splits the
+result rows back per caller and releases everyone.  K same-bucket
+single-archive submissions therefore execute as ``ceil(K / batch_max)``
+device dispatches instead of K (ISSUE 7 acceptance; the service's
+dispatcher sizes the cycles).
+
+Coalescing is correctness-transparent:
+
+* only calls with identical *static* fit configuration (fit flags,
+  bounds, iteration caps, ...) merge — a config mismatch degrades to
+  separate dispatches in the same cycle, never to a wrong program;
+* per-call arrays (data, models, init, errs, weights, nu columns)
+  concatenate on the batch axis and the result rows are sliced back,
+  so each request sees exactly the rows its own solo dispatch would
+  have produced (the solver is row-independent: vmap over subints);
+* the harmonic cutoff ``kmax`` is pinned to the max over the parked
+  calls' models — without it the combined dispatch would inherit the
+  first caller's cutoff (``model_kmax`` inspects one batch row);
+* the combined batch is padded to the power-of-two batch bucket
+  (``bucket_batch_size``), so coalesced programs stay O(log batch_max)
+  per shape bucket rather than one per distinct K.
+
+Failure semantics (docs/SERVICE.md failure matrix): a combined
+dispatch that raises fails every parked call of that group — each
+request then retries through its tenant ledger's backoff, and a retry
+may land in a different (possibly solo) cycle.  Injected ``dispatch``
+faults (testing/faults.py) fire per archive *before* the hook, so a
+chaos-faulted request never reaches the shared dispatch at all.
+
+Host-side only: the batcher is threading + numpy concatenation around
+the jit boundary (jaxlint J002 covers the ``service.*`` surface).
+"""
+
+import threading
+
+import numpy as np
+
+from .. import obs
+
+__all__ = ["MicroBatcher"]
+
+
+def _static_key(kw):
+    """Hashable static-configuration key; calls coalesce only within
+    one key (same compiled program family)."""
+    bounds = kw.get("bounds")
+    if bounds is not None:
+        bounds = tuple(tuple(b) for b in bounds)
+    nu_outs = kw.get("nu_outs")
+    nu_outs_shape = None if nu_outs is None else \
+        tuple(col is not None for col in nu_outs)
+    return (
+        tuple(kw.get("fit_flags", (1, 1, 0, 0, 0))),
+        bounds,
+        bool(kw.get("log10_tau", True)),
+        int(kw.get("max_iter", 50)),
+        kw.get("polish_iter"), kw.get("coarse_iter"),
+        kw.get("coarse_kmax"),
+        nu_outs_shape,
+        kw.get("errs") is None,
+        kw.get("weights") is None,
+    )
+
+
+class _Parked:
+    """One worker's fit call waiting for the cycle's leader."""
+
+    __slots__ = ("args", "kw", "n", "event", "result", "error")
+
+    def __init__(self, args, kw):
+        self.args = args
+        self.kw = kw
+        self.n = int(np.asarray(args[0]).shape[0])
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+
+
+class MicroBatcher:
+    """Per-bucket coalescing ``fit_batch`` hook (module docstring).
+
+    ``begin(n)`` opens a cycle expecting ``n`` worker threads;
+    each worker must call ``worker_done()`` exactly once (in a
+    ``finally``) so a request that never reaches a fit call — load
+    failure, injected read fault, quarantine — releases the barrier
+    instead of stalling the cycle until ``window_s``.
+    """
+
+    def __init__(self, bucket=None, window_s=2.0, fit=None):
+        self.bucket = tuple(bucket) if bucket else None
+        self.window_s = float(window_s)
+        self._fit = fit  # injectable for tests; default resolved lazily
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._parked = []
+        self._expected = 0
+        self._done = 0
+        # cumulative stats (service status / obs)
+        self.n_dispatches = 0
+        self.n_calls = 0
+        self.n_coalesced = 0  # calls that shared a dispatch
+
+    # -- cycle management ----------------------------------------------
+
+    def begin(self, n):
+        """Open a cycle of ``n`` workers (dispatcher thread)."""
+        with self._lock:
+            self._expected = int(n)
+            self._done = 0
+            self._parked = []
+
+    def worker_done(self):
+        """A worker of the cycle finished (fit call resolved, or it
+        never made one)."""
+        with self._lock:
+            self._done += 1
+            self._cond.notify_all()
+
+    # -- the fit_batch hook --------------------------------------------
+
+    def _resolve_fit(self):
+        if self._fit is None:
+            from ..fit.portrait import fit_portrait_full_batch
+
+            self._fit = fit_portrait_full_batch
+        return self._fit
+
+    def fit(self, *args, **kw):
+        """``fit_portrait_full_batch`` drop-in (GetTOAs.fit_batch)."""
+        slot = _Parked(args, kw)
+        with self._lock:
+            self.n_calls += 1
+            if self._expected <= 1:
+                # solo cycle: no one to wait for
+                return self._dispatch_alone(slot)
+            self._parked.append(slot)
+            if self._barrier_met():
+                self._fire_locked()
+            else:
+                deadline = threading.TIMEOUT_MAX if self.window_s <= 0 \
+                    else self.window_s
+                while not slot.event.is_set():
+                    if not self._cond.wait(timeout=deadline):
+                        # window expired: whoever notices first leads a
+                        # partial dispatch so one slow sibling cannot
+                        # hold the batch hostage
+                        if not slot.event.is_set():
+                            self._fire_locked()
+                        break
+                    if slot.event.is_set():
+                        break
+                    if self._barrier_met():
+                        self._fire_locked()
+        slot.event.wait()
+        if slot.error is not None:
+            raise slot.error
+        return slot.result
+
+    def _barrier_met(self):
+        # every expected worker is either parked here or fully done:
+        # nothing more can join this round (caller holds the lock)
+        return self._parked and \
+            len(self._parked) + self._done >= self._expected
+
+    # -- dispatching ---------------------------------------------------
+
+    def _dispatch_alone(self, slot):
+        fit = self._resolve_fit()
+        self.n_dispatches += 1
+        self._emit(1, slot.n)
+        return fit(*slot.args, **self._sized_kw(slot.kw, slot.n))
+
+    def _sized_kw(self, kw, total):
+        """Recompute the batch-shaping knobs for the (possibly
+        combined) batch size; per-call values were sized for solo
+        dispatch."""
+        from ..fit.portrait import auto_scan_size, bucket_batch_size
+
+        out = dict(kw)
+        scan = auto_scan_size(total)
+        out["scan_size"] = scan
+        out["pad_to"] = None if scan is not None \
+            else bucket_batch_size(total)
+        return out
+
+    def _fire_locked(self):
+        """Dispatch every parked call (caller holds the lock); the
+        current thread is the leader.  The actual device work runs
+        OUTSIDE the lock so late workers can park for the next round."""
+        parked, self._parked = self._parked, []
+        self._lock.release()
+        try:
+            groups = {}
+            for slot in parked:
+                groups.setdefault(_static_key(slot.kw),
+                                  []).append(slot)
+            for slots in groups.values():
+                self._dispatch_group(slots)
+        finally:
+            self._lock.acquire()
+        self._cond.notify_all()
+
+    def _dispatch_group(self, slots):
+        if len(slots) == 1:
+            slot = slots[0]
+            try:
+                slot.result = self._dispatch_alone(slot)
+            except BaseException as e:  # noqa: BLE001 — forwarded
+                slot.error = e
+            finally:
+                slot.event.set()
+            return
+        try:
+            self._dispatch_combined(slots)
+        except BaseException as e:  # noqa: BLE001 — forwarded to all
+            for slot in slots:
+                slot.error = e
+                slot.event.set()
+
+    def _dispatch_combined(self, slots):
+        from ..fit.portrait import model_kmax
+        from ..utils.databunch import DataBunch
+
+        fit = self._resolve_fit()
+        total = sum(s.n for s in slots)
+
+        def cat(pick):
+            return np.concatenate([np.asarray(pick(s)) for s in slots],
+                                  axis=0)
+
+        # positional contract (pipelines/toas.py): data, models, init,
+        # Ps, freqs; models may broadcast [B, nchan, nbin] per call
+        data = cat(lambda s: s.args[0])
+        models = np.concatenate(
+            [np.broadcast_to(np.asarray(s.args[1]),
+                             np.asarray(s.args[0]).shape)
+             for s in slots], axis=0)
+        init = cat(lambda s: s.args[2])
+        Ps = np.concatenate(
+            [np.broadcast_to(np.asarray(s.args[3]), (s.n,))
+             for s in slots], axis=0)
+        freqs = cat(lambda s: s.args[4])
+
+        kw0 = self._sized_kw(slots[0].kw, total)
+        for key in ("errs", "weights", "nu_fits"):
+            if slots[0].kw.get(key) is not None:
+                kw0[key] = cat(lambda s, k=key: s.kw[k])
+        nu_outs0 = slots[0].kw.get("nu_outs")
+        if nu_outs0 is not None:
+            kw0["nu_outs"] = tuple(
+                None if col is None else np.concatenate(
+                    [np.asarray(s.kw["nu_outs"][i]) for s in slots])
+                for i, col in enumerate(nu_outs0))
+        # pin the harmonic cutoff to the most demanding member —
+        # fit_portrait_full_batch would otherwise derive it from the
+        # FIRST batch row only (fit/portrait.model_kmax)
+        if kw0.get("kmax") is None:
+            kmaxes = [model_kmax(np.asarray(s.args[1])) for s in slots]
+            kmaxes = [k for k in kmaxes if k is not None]
+            if kmaxes:
+                kw0["kmax"] = max(kmaxes)
+
+        self.n_dispatches += 1
+        self.n_coalesced += len(slots)
+        self._emit(len(slots), total)
+        out = fit(data, models, init, Ps, freqs, **kw0)
+        out = {k: np.asarray(v) for k, v in dict(out).items()}
+        off = 0
+        for slot in slots:
+            slot.result = DataBunch(**{
+                k: (v[off:off + slot.n]
+                    if getattr(v, "ndim", 0) >= 1
+                    and v.shape[0] == total else v)
+                for k, v in out.items()})
+            off += slot.n
+            slot.event.set()
+
+    def _emit(self, n_requests, total):
+        obs.event("microbatch_dispatch",
+                  bucket=None if self.bucket is None
+                  else "%dx%d" % self.bucket,
+                  n_requests=n_requests, batch=int(total))
+        obs.counter("service_dispatches")
+        if n_requests > 1:
+            obs.counter("service_coalesced_requests", n_requests)

@@ -1,0 +1,780 @@
+"""TOA-as-a-service: the resident multi-tenant fitting daemon.
+
+``run_survey`` (runner/execute.py) is batch-shaped: every invocation
+pays archive load, bucket compile and process bring-up.
+:class:`TOAService` keeps all of that resident so every request after
+warm-up is *fit-bound*:
+
+* **Intake / tenancy** — each tenant owns a ledger-backed work queue
+  (``runner/queue.WorkQueue`` under ``<workdir>/tenants/<name>/``):
+  the same append-only / bounded-retry / quarantine semantics the
+  survey runner trusts, so a request's full lifecycle — attempts,
+  failure reasons, terminal state — is crash-safe on disk and a
+  restarted daemon resumes whatever was accepted but unfinished.
+  Fitted TOAs land in the tenant's own ``toas.tim`` through the
+  pipeline's exactly-once checkpoint protocol (block + ``pp_done``
+  marker per archive), which also makes duplicate submissions replay
+  the recorded result instead of refitting.
+* **Warm bucket pools** — per-(nchan, nbin)-bucket
+  ``_BucketedGetTOAs`` fitters are pooled and reused across requests
+  (result state reset between checkouts), and ``warm()`` AOT-compiles
+  + primes every program a plan enumerates (service/warm.py), so a
+  request on a planned bucket triggers zero new XLA compiles.
+* **Micro-batching** — the dispatcher coalesces same-bucket requests
+  that arrive within ``batch_window_s`` (up to ``batch_max``) into one
+  cycle; their device dispatches merge through the bucket's
+  :class:`~.batcher.MicroBatcher`, so K single-archive submissions
+  cost ~ceil(K/batch) dispatches on one compiled program.
+* **Fairness / backpressure** — cycles seed from the tenant whose
+  oldest ready request has waited longest, each tenant holds at most
+  ``tenant_max_inflight`` slots of a cycle, and a tenant whose open
+  requests reach ``tenant_max_queue`` gets ``backpressure`` rejections
+  instead of unbounded intake; no tenant can starve another.
+* **SLO under chaos** (testing/faults.py, docs/SERVICE.md failure
+  matrix) — injected ``archive_read``/``dispatch`` faults travel the
+  same per-archive isolation path as the survey runner
+  (``runner/execute._fit_one``): the affected request retries with
+  backoff and quarantines on exhaustion, concurrent requests —
+  including the rest of its own micro-batch cycle — complete.
+  SIGTERM (cli/ppserve.py) flips :meth:`request_drain`: intake starts
+  rejecting, everything already accepted finishes, state flushes, the
+  daemon exits 0.
+
+Observability: the daemon runs under one long-lived obs run
+(``<workdir>/obs``, events rotated via ``PPTPU_OBS_MAX_BYTES``), and
+every request additionally gets its own run directory under
+``<workdir>/obs_requests`` (manifest + lifecycle events + its compile
+counters — a warm request's manifest proves ``backend_compiles: 0``).
+Request run dirs are pruned to a count/byte budget
+(``run_dirs_max``/``run_bytes_max``, env
+``PPTPU_SERVE_MAX_RUNS``/``PPTPU_SERVE_MAX_RUN_BYTES``) so a resident
+process cannot grow obs state without bound.
+"""
+
+import contextlib
+import itertools
+import os
+import re
+import shutil
+import threading
+import time
+
+from .. import obs
+from ..io.timfile import format_toa_line
+from ..obs.core import Recorder
+from ..runner.execute import _BucketedGetTOAs, _fit_one
+from ..runner.plan import SurveyPlan, canonical_shape, \
+    scan_archive_header
+from ..runner.queue import DONE, FAILED, QUARANTINED, WorkQueue
+from ..testing import faults
+from .batcher import MicroBatcher
+
+__all__ = ["TOAService", "Request"]
+
+_TENANT_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.-]{0,63}$")
+
+# request-side states layered over the ledger's: "dispatching" marks a
+# request claimed by the current micro-batch cycle
+PENDING = "pending"
+DISPATCHING = "dispatching"
+
+_REQ_SEQ = itertools.count(1)
+
+
+def _env_int(name, default):
+    v = os.environ.get(name, "").strip()
+    try:
+        return int(v) if v else default
+    except ValueError:
+        return default
+
+
+class Request:
+    """One accepted TOA request (in-memory view; the tenant ledger is
+    the durable record)."""
+
+    __slots__ = ("id", "tenant", "path", "key", "config", "bucket",
+                 "nsub", "nchan", "nbin", "state", "reason", "attempts",
+                 "n_toas", "toa_lines", "t_submit", "t_done", "done_evt",
+                 "recorder", "recovered", "batch_id")
+
+    def __init__(self, req_id, tenant, path, key, config):
+        self.id = req_id
+        self.tenant = tenant
+        self.path = path
+        self.key = key
+        self.config = config or {}
+        self.bucket = None
+        self.nsub = self.nchan = self.nbin = 0
+        self.state = PENDING
+        self.reason = None
+        self.attempts = 0
+        self.n_toas = 0
+        self.toa_lines = None
+        self.t_submit = time.time()
+        self.t_done = None
+        self.done_evt = threading.Event()
+        self.recorder = None
+        self.recovered = False
+        self.batch_id = None
+
+    def payload(self, cached=False):
+        out = {"ok": True, "request_id": self.id, "tenant": self.tenant,
+               "archive": self.path, "state": self.state,
+               "attempts": self.attempts}
+        if self.bucket:
+            out["bucket"] = "%dx%d" % self.bucket
+        if self.reason:
+            out["reason"] = self.reason
+        if self.state == DONE:
+            out["n_toas"] = self.n_toas
+            if self.toa_lines is not None:
+                out["toa_lines"] = self.toa_lines
+        if self.t_done is not None:
+            out["wall_s"] = round(self.t_done - self.t_submit, 6)
+        if cached:
+            out["cached"] = True
+        return out
+
+
+class _Tenant:
+    """Per-tenant intake: ledger queue, checkpoint, open-request FIFO."""
+
+    def __init__(self, name, root, max_attempts, backoff_s):
+        self.name = name
+        self.dir = os.path.join(root, name)
+        os.makedirs(self.dir, exist_ok=True)
+        self.queue = WorkQueue(os.path.join(self.dir, "ledger.0.jsonl"),
+                               max_attempts=max_attempts,
+                               backoff_s=backoff_s)
+        self.checkpoint = os.path.join(self.dir, "toas.tim")
+        self.fifo = []        # open request ids, submit order
+        self.inflight = 0     # requests in the current cycle
+        self.n_submitted = 0
+        self.n_completed = 0
+        self.n_rejected = 0
+
+
+class _Bucket:
+    """Warm per-bucket state: the micro-batcher + a fitter pool."""
+
+    def __init__(self, key, modelfile, window_s):
+        self.key = tuple(key)
+        self.batcher = MicroBatcher(bucket=self.key, window_s=window_s)
+        self.modelfile = modelfile
+        self._pool = []
+        self._lock = threading.Lock()
+        self.n_requests = 0
+
+    def checkout(self):
+        with self._lock:
+            if self._pool:
+                return self._pool.pop()
+        gt = _BucketedGetTOAs([], self.modelfile, self.key, quiet=True)
+        return gt
+
+    def checkin(self, gt):
+        from ..pipelines.toas import GetTOAs
+
+        for attr in GetTOAs.RESULT_ATTRS:
+            setattr(gt, attr, [])
+        gt.TOA_list = []
+        gt.failed_datafiles = []
+        gt.poisoned_datafiles = []
+        gt.fit_batch = None
+        if hasattr(gt, "_data_cache"):
+            gt._data_cache = {}
+        with self._lock:
+            self._pool.append(gt)
+
+
+class _Info:
+    """Duck-typed ArchiveInfo for runner/execute._fit_one."""
+
+    __slots__ = ("path",)
+
+    def __init__(self, path):
+        self.path = path
+
+
+class TOAService:
+    """The resident fitting daemon (module docstring).
+
+    In-process API (the socket server in service/server.py is a thin
+    shell over it): :meth:`start`, :meth:`warm`, :meth:`submit`,
+    :meth:`wait`, :meth:`status`, :meth:`request_drain`,
+    :meth:`shutdown`.
+    """
+
+    def __init__(self, modelfile, workdir, plan=None, narrowband=False,
+                 batch_window_s=0.25, batch_max=8,
+                 tenant_max_inflight=4, tenant_max_queue=64,
+                 max_attempts=3, backoff_s=0.0, run_dirs_max=None,
+                 run_bytes_max=None, return_toa_lines=True,
+                 get_toas_kw=None, quiet=True):
+        self.modelfile = modelfile
+        self.workdir = workdir
+        if isinstance(plan, str):
+            plan = SurveyPlan.load(plan)
+        self.plan = plan
+        self.narrowband = bool(narrowband)
+        self.batch_window_s = float(batch_window_s)
+        self.batch_max = max(1, int(batch_max))
+        self.tenant_max_inflight = max(1, int(tenant_max_inflight))
+        self.tenant_max_queue = max(1, int(tenant_max_queue))
+        self.max_attempts = int(max_attempts)
+        self.backoff_s = float(backoff_s)
+        self.run_dirs_max = _env_int("PPTPU_SERVE_MAX_RUNS", 256) \
+            if run_dirs_max is None else int(run_dirs_max)
+        self.run_bytes_max = _env_int("PPTPU_SERVE_MAX_RUN_BYTES", 0) \
+            if run_bytes_max is None else int(run_bytes_max)
+        self.return_toa_lines = bool(return_toa_lines)
+        self.get_toas_kw = dict(get_toas_kw or {})
+        self.quiet = quiet
+
+        os.makedirs(workdir, exist_ok=True)
+        self._tenant_root = os.path.join(workdir, "tenants")
+        self._req_obs_dir = os.path.join(workdir, "obs_requests")
+        os.makedirs(self._tenant_root, exist_ok=True)
+        os.makedirs(self._req_obs_dir, exist_ok=True)
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._tenants = {}
+        self._requests = {}       # open requests by id
+        self._done_requests = {}  # terminal requests, bounded FIFO
+        self._done_order = []
+        self._done_keep = 4096
+        self._buckets = {}
+        self._draining = False
+        self._stopped = threading.Event()
+        self._drained = threading.Event()
+        self._thread = None
+        self._obs_stack = contextlib.ExitStack()
+        self._batch_seq = itertools.count(1)
+        self.t_start = None
+        self.warm_summary = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self):
+        """Open the daemon obs run, recover accepted-but-unfinished
+        requests from the tenant ledgers, start the dispatcher."""
+        if self._thread is not None:
+            raise RuntimeError("TOAService already started")
+        self.t_start = time.time()
+        self._obs_stack.enter_context(obs.run(
+            "ppserve", base_dir=os.path.join(self.workdir, "obs"),
+            config={"modelfile": self.modelfile,
+                    "narrowband": self.narrowband,
+                    "batch_window_s": self.batch_window_s,
+                    "batch_max": self.batch_max,
+                    "tenant_max_inflight": self.tenant_max_inflight,
+                    "tenant_max_queue": self.tenant_max_queue,
+                    "max_attempts": self.max_attempts,
+                    "run_dirs_max": self.run_dirs_max,
+                    "run_bytes_max": self.run_bytes_max}))
+        self._recover_tenants()
+        self._thread = threading.Thread(target=self._dispatcher,
+                                        name="ppserve-dispatcher",
+                                        daemon=True)
+        self._thread.start()
+        obs.event("service_started", workdir=self.workdir,
+                  n_tenants=len(self._tenants))
+        return self
+
+    def warm(self, coalesce=None, aot=True):
+        """Warm every program the startup plan enumerates
+        (service/warm.py); stores + returns the summary."""
+        from .warm import warm_plan
+
+        if self.plan is None:
+            return None
+        if coalesce is None:
+            # every cycle size a full-rate tenant mix can produce: the
+            # batch-glue programs key on the raw combined batch, so
+            # K=2..batch_max each warm their own total (warm.py)
+            coalesce = tuple(range(2, self.batch_max + 1))
+        self.warm_summary = warm_plan(
+            self.plan, self.modelfile, get_toas_kw=self.get_toas_kw,
+            coalesce=coalesce, aot=aot, narrowband=self.narrowband,
+            quiet=self.quiet)
+        rec = obs.current()
+        if rec is not None:
+            # the warm-path proof marker: everything compiled so far
+            # happened before the first request (docs/SERVICE.md)
+            obs.gauge("warm_backend_compiles",
+                      int(rec.counters.get("backend_compiles", 0)))
+        return self.warm_summary
+
+    def request_drain(self):
+        """Stop accepting; finish everything accepted; then stop."""
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+            self._cond.notify_all()
+        obs.event("service_drain")
+        obs.counter("service_drains")
+
+    def drained(self, timeout=None):
+        """Block until a drain completed; True when it has."""
+        return self._drained.wait(timeout)
+
+    def shutdown(self, timeout=60.0):
+        """Drain and stop the dispatcher; close obs state.  Returns
+        True when the drain completed in time."""
+        if self._thread is None:
+            self._drained.set()
+        self.request_drain()
+        ok = self._drained.wait(timeout)
+        self._stopped.set()
+        with self._lock:
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        with self._lock:
+            tenants = list(self._tenants.values())
+            requests = list(self._requests.values())
+        for rq in requests:
+            self._close_request_recorder(rq)
+        for t in tenants:
+            t.queue.close()
+        obs.event("service_stopped", drained=bool(ok))
+        self._obs_stack.close()
+        return ok
+
+    # -- intake ---------------------------------------------------------
+
+    def _tenant(self, name):
+        """Get-or-create a tenant (caller holds the lock)."""
+        t = self._tenants.get(name)
+        if t is None:
+            t = _Tenant(name, self._tenant_root, self.max_attempts,
+                        self.backoff_s)
+            self._tenants[name] = t
+        return t
+
+    def _recover_tenants(self):
+        """Re-enqueue ledger entries a previous daemon accepted but
+        never finished (crash/drain leftovers): the accepted-work
+        contract survives restarts."""
+        if not os.path.isdir(self._tenant_root):
+            return
+        recovered = []
+        for name in sorted(os.listdir(self._tenant_root)):
+            if not _TENANT_RE.match(name) or not os.path.isfile(
+                    os.path.join(self._tenant_root, name,
+                                 "ledger.0.jsonl")):
+                continue
+            with self._lock:
+                t = self._tenant(name)
+                for key in t.queue.outstanding():
+                    rq = self._new_request(t, key, key, {},
+                                           recovered=True)
+                    recovered.append(rq)
+        for rq in recovered:
+            # header scan outside the lock (file IO); unreadable
+            # leftovers quarantine exactly like a fresh submission's
+            if self._classify(rq):
+                self._emit_request(rq, "recovered")
+
+    def _new_request(self, tenant, path, key, config, recovered=False):
+        """Register an open request (caller holds the lock)."""
+        rq = Request("r%06d" % next(_REQ_SEQ), tenant.name, path, key,
+                     config)
+        rq.recovered = recovered
+        self._requests[rq.id] = rq
+        tenant.fifo.append(rq.id)
+        tenant.n_submitted += 1
+        self._open_request_recorder(rq)
+        self._cond.notify_all()
+        return rq
+
+    def submit(self, tenant, archive, config=None, wait=False,
+               timeout=None):
+        """Accept one TOA request; returns the response payload.
+
+        Replays: an archive this tenant's ledger already records as
+        done/quarantined responds with the recorded outcome instead of
+        refitting (the checkpoint holds its TOA block).  Rejections
+        (``ok: False``): bad tenant name, unreadable archive header
+        recorded as an immediate quarantine, ``backpressure`` beyond
+        the tenant's open-request budget, ``draining`` after a drain
+        began.
+        """
+        if not _TENANT_RE.match(str(tenant or "")):
+            return {"ok": False, "error": "bad_tenant",
+                    "detail": "tenant must match %s" % _TENANT_RE.pattern}
+        path = str(archive)
+        key = WorkQueue.key_for(path)
+        with self._lock:
+            if self._draining:
+                return {"ok": False, "error": "draining"}
+            t = self._tenant(tenant)
+            state = t.queue.state(key)
+            if state in (DONE, QUARANTINED):
+                rec = t.queue.record(key) or {}
+                obs.counter("service_replays")
+                return {"ok": True, "request_id": None, "cached": True,
+                        "tenant": tenant, "archive": path,
+                        "state": state,
+                        "n_toas": rec.get("n_toas"),
+                        "reason": rec.get("reason")}
+            for rid in t.fifo:
+                rq = self._requests[rid]
+                if rq.key == key:  # already accepted: attach to it
+                    break
+            else:
+                rq = None
+            if rq is None:
+                if len(t.fifo) >= self.tenant_max_queue:
+                    t.n_rejected += 1
+                    obs.event("service_backpressure", tenant=tenant,
+                              archive=path, open=len(t.fifo))
+                    obs.counter("service_backpressure_rejections")
+                    return {"ok": False, "error": "backpressure",
+                            "tenant": tenant, "open": len(t.fifo)}
+                rq = self._new_request(t, path, key, config)
+                obs.counter("service_requests")
+        if rq.bucket is None and not self._classify(rq):
+            # header scan failed: quarantined at intake, like the
+            # survey planner's unreadable-archive path
+            pass
+        self._emit_request(rq, "submitted")
+        if wait:
+            rq.done_evt.wait(timeout)
+        return rq.payload()
+
+    def _classify(self, rq):
+        """Header-scan the archive into its shape bucket; quarantine on
+        failure.  Returns True when the request is fittable."""
+        if rq.bucket is not None or rq.t_done is not None:
+            return rq.bucket is not None
+        try:
+            info = scan_archive_header(rq.path)
+        except (OSError, ValueError, KeyError,
+                faults.InjectedFault) as e:
+            with self._lock:
+                t = self._tenants[rq.tenant]
+                if t.queue.state(rq.key) is None:
+                    t.queue.add([rq.path])
+                t.queue.quarantine(rq.path,
+                                   "unreadable at intake: %s" % e)
+                self._finalize_locked(rq, QUARANTINED,
+                                      "unreadable at intake: %s" % e)
+            return False
+        with self._lock:
+            rq.nsub, rq.nchan, rq.nbin = info.nsub, info.nchan, info.nbin
+            rq.bucket = canonical_shape(info.nchan, info.nbin)
+            t = self._tenants[rq.tenant]
+            if t.queue.state(rq.key) is None:
+                t.queue.add([rq.path])
+            self._cond.notify_all()
+        return True
+
+    def wait(self, request_id, timeout=None):
+        with self._lock:
+            rq = self._requests.get(request_id) \
+                or self._done_requests.get(request_id)
+        if rq is None:
+            return {"ok": False, "error": "unknown_request",
+                    "request_id": request_id}
+        rq.done_evt.wait(timeout)
+        return rq.payload()
+
+    # -- scheduling -----------------------------------------------------
+
+    def _ready_locked(self, rq, now):
+        if rq.state != PENDING or rq.bucket is None:
+            return False
+        t = self._tenants[rq.tenant]
+        rec = t.queue.record(rq.key)
+        if rec is None:
+            return False
+        if rec["state"] == FAILED:
+            return now >= rec.get("retry_at", 0.0)
+        return rec["state"] not in (DONE, QUARANTINED)
+
+    def _collect_batch(self):
+        """Assemble the next micro-batch: seed from the tenant whose
+        oldest ready request waited longest, fill with same-bucket
+        ready requests (oldest first, per-tenant inflight cap), and
+        hold the cycle open until the seed has aged ``batch_window_s``
+        or the batch is full."""
+        with self._lock:
+            while True:
+                if self._stopped.is_set():
+                    return None
+                now = time.time()
+                ready = [rq for rid, rq in self._requests.items()
+                         if self._ready_locked(rq, now)]
+                if not ready:
+                    if self._draining and not self._requests:
+                        return None
+                    # wake for the earliest backoff expiry, a new
+                    # submission, or a drain
+                    self._cond.wait(timeout=0.1)
+                    continue
+                seed = min(ready, key=lambda rq: rq.t_submit)
+                age = now - seed.t_submit
+                batch = self._fill_batch_locked(ready, seed)
+                if len(batch) >= self.batch_max \
+                        or age >= self.batch_window_s:
+                    for rq in batch:
+                        rq.state = DISPATCHING
+                        self._tenants[rq.tenant].inflight += 1
+                    return batch
+                self._cond.wait(timeout=max(0.01,
+                                            self.batch_window_s - age))
+
+    def _fill_batch_locked(self, ready, seed):
+        per_tenant = {}
+        batch = []
+        for rq in sorted(ready, key=lambda r: r.t_submit):
+            if rq.bucket != seed.bucket:
+                continue
+            n = per_tenant.get(rq.tenant, 0)
+            if n >= self.tenant_max_inflight:
+                continue
+            per_tenant[rq.tenant] = n + 1
+            batch.append(rq)
+            if len(batch) >= self.batch_max:
+                break
+        return batch
+
+    def _dispatcher(self):
+        try:
+            while True:
+                batch = self._collect_batch()
+                if batch is None:
+                    break
+                self._dispatch(batch)
+        finally:
+            self._drained.set()
+            with self._lock:
+                self._cond.notify_all()
+
+    def _dispatch(self, batch):
+        batch_id = "b%05d" % next(self._batch_seq)
+        bucket = self._bucket(batch[0].bucket)
+        bucket.n_requests += len(batch)
+        n_disp0 = bucket.batcher.n_dispatches
+        with self._lock:
+            for rq in batch:
+                rq.batch_id = batch_id
+                t = self._tenants[rq.tenant]
+                claim = t.queue.claim(rq.path)
+                rq.attempts = claim.get("attempts", 0)
+        for rq in batch:
+            self._emit_request(rq, "dispatching")
+        bucket.batcher.begin(len(batch))
+        workers = []
+        for rq in batch:
+            w = threading.Thread(target=self._run_one,
+                                 args=(rq, bucket),
+                                 name="ppserve-fit-%s" % rq.id,
+                                 daemon=True)
+            workers.append(w)
+            w.start()
+        for w in workers:
+            w.join()
+        obs.event("service_batch", batch=batch_id,
+                  bucket="%dx%d" % bucket.key, n_requests=len(batch),
+                  tenants=sorted({rq.tenant for rq in batch}),
+                  dispatches=bucket.batcher.n_dispatches - n_disp0)
+
+    def _bucket(self, key):
+        with self._lock:
+            b = self._buckets.get(tuple(key))
+            if b is None:
+                b = _Bucket(key, self.modelfile, self.batch_window_s)
+                self._buckets[tuple(key)] = b
+            return b
+
+    def _run_one(self, rq, bucket):
+        t = self._tenants[rq.tenant]
+        gt = bucket.checkout()
+        gt.fit_batch = bucket.batcher.fit
+        kw = dict(self.get_toas_kw)
+        kw.update(rq.config or {})
+        flags = dict(kw.get("addtnl_toa_flags") or {})
+        flags.setdefault("pp_tenant", rq.tenant)
+        kw["addtnl_toa_flags"] = flags
+        padded = (rq.nchan, rq.nbin) != tuple(bucket.key)
+        state = None
+        try:
+            state = _fit_one(gt, t.queue, _Info(rq.path), t.checkpoint,
+                             padded, kw, self.quiet,
+                             narrowband=self.narrowband)
+        except Exception as e:  # noqa: BLE001 — total per-request guard
+            rec = t.queue.fail(rq.path, "%s: %s" % (type(e).__name__, e))
+            state = rec["state"]
+        finally:
+            bucket.batcher.worker_done()
+            n_toas = len(gt.TOA_list)
+            lines = [format_toa_line(toa) for toa in gt.TOA_list] \
+                if self.return_toa_lines else None
+            bucket.checkin(gt)
+        self._settle(rq, state, n_toas, lines)
+
+    def _settle(self, rq, state, n_toas, toa_lines):
+        with self._lock:
+            t = self._tenants[rq.tenant]
+            t.inflight = max(0, t.inflight - 1)
+            rec = t.queue.record(rq.key) or {}
+            state = rec.get("state", state)
+            rq.attempts = rec.get("attempts", rq.attempts)
+            if state in (DONE, QUARANTINED):
+                if state == DONE:
+                    rq.n_toas = n_toas
+                    rq.toa_lines = toa_lines
+                self._finalize_locked(rq, state, rec.get("reason"))
+            else:
+                rq.state = PENDING  # failed: backoff, then retried
+                rq.reason = rec.get("reason")
+                obs.counter("service_retries")
+                self._emit_request(rq, "retrying")
+            self._cond.notify_all()
+
+    def _finalize_locked(self, rq, state, reason):
+        if rq.t_done is not None:
+            return  # already finalized (racing duplicate settle)
+        rq.state = state
+        rq.reason = reason
+        rq.t_done = time.time()
+        t = self._tenants[rq.tenant]
+        if rq.id in t.fifo:
+            t.fifo.remove(rq.id)
+        t.n_completed += 1
+        self._requests.pop(rq.id, None)
+        # keep the terminal view queryable (wait/replay) under a
+        # bounded budget — a resident process must not grow this map
+        self._done_requests[rq.id] = rq
+        self._done_order.append(rq.id)
+        while len(self._done_order) > self._done_keep:
+            self._done_requests.pop(self._done_order.pop(0), None)
+        obs.counter("service_done" if state == DONE
+                    else "service_quarantined")
+        self._emit_request(rq, "terminal")
+        self._close_request_recorder(rq)
+        rq.done_evt.set()
+
+    # -- per-request obs runs ------------------------------------------
+
+    def _open_request_recorder(self, rq):
+        try:
+            rq.recorder = Recorder(
+                "req-%s" % rq.id, self._req_obs_dir,
+                config={"request": rq.id, "tenant": rq.tenant,
+                        "archive": rq.path})
+        except OSError:
+            rq.recorder = None
+
+    def _emit_request(self, rq, phase, **extra):
+        fields = dict(request=rq.id, tenant=rq.tenant, archive=rq.path,
+                      phase=phase, state=rq.state,
+                      attempts=rq.attempts,
+                      bucket=None if rq.bucket is None
+                      else "%dx%d" % rq.bucket,
+                      batch=rq.batch_id, reason=rq.reason, **extra)
+        if rq.state == DONE:
+            fields["n_toas"] = rq.n_toas
+        if rq.t_done is not None:
+            fields["wall_s"] = round(rq.t_done - rq.t_submit, 6)
+        fields = {k: v for k, v in fields.items() if v is not None}
+        obs.event("service_request", **fields)
+        if rq.recorder is not None:
+            rq.recorder.emit("event", name="service_request", **fields)
+
+    def _close_request_recorder(self, rq):
+        rec, rq.recorder = rq.recorder, None
+        if rec is None:
+            return
+        rec.close()
+        self._prune_request_runs()
+
+    def _prune_request_runs(self):
+        """Bound the retained per-request run dirs by count and bytes
+        (oldest pruned first); open requests' runs are kept."""
+        keep = {os.path.basename(rq.recorder.dir)
+                for rq in self._requests.values()
+                if rq.recorder is not None}
+        try:
+            names = os.listdir(self._req_obs_dir)
+        except OSError:
+            return
+        entries = []
+        total = 0
+        for name in names:
+            if name in keep:
+                continue
+            path = os.path.join(self._req_obs_dir, name)
+            if not os.path.isdir(path):
+                continue
+            size = mtime = 0
+            for root, _, files in os.walk(path):
+                for f in files:
+                    try:
+                        st = os.stat(os.path.join(root, f))
+                    except OSError:
+                        continue
+                    size += st.st_size
+                    mtime = max(mtime, st.st_mtime)
+            entries.append((mtime, path, size))
+            total += size
+        entries.sort()
+        budget_dirs = self.run_dirs_max or 0
+        budget_bytes = self.run_bytes_max or 0
+        n_pruned = 0
+        while entries and (
+                (budget_dirs and len(entries) > budget_dirs)
+                or (budget_bytes and total > budget_bytes)):
+            _, path, size = entries.pop(0)
+            shutil.rmtree(path, ignore_errors=True)
+            total -= size
+            n_pruned += 1
+        if n_pruned:
+            obs.counter("service_runs_pruned", n_pruned)
+
+    # -- introspection --------------------------------------------------
+
+    def status(self):
+        with self._lock:
+            tenants = {}
+            for name, t in self._tenants.items():
+                tenants[name] = {
+                    "counts": t.queue.counts(),
+                    "open": len(t.fifo), "inflight": t.inflight,
+                    "submitted": t.n_submitted,
+                    "completed": t.n_completed,
+                    "rejected": t.n_rejected}
+            buckets = {}
+            for key, b in self._buckets.items():
+                buckets["%dx%d" % key] = {
+                    "requests": b.n_requests,
+                    "dispatches": b.batcher.n_dispatches,
+                    "coalesced": b.batcher.n_coalesced,
+                    "fit_calls": b.batcher.n_calls,
+                    "pool": len(b._pool)}
+            out = {"ok": True,
+                   "uptime_s": round(time.time() - (self.t_start
+                                                    or time.time()), 3),
+                   "draining": self._draining,
+                   "open_requests": len(self._requests),
+                   "tenants": tenants, "buckets": buckets,
+                   "narrowband": self.narrowband,
+                   "batch_window_s": self.batch_window_s,
+                   "batch_max": self.batch_max}
+        rec = obs.current()
+        if rec is not None:
+            out["counters"] = dict(rec.counters)
+            out["obs_run"] = rec.dir
+        if self.warm_summary is not None:
+            out["warm"] = {k: self.warm_summary[k]
+                           for k in ("n_programs", "wall_s",
+                                     "backend_compiles",
+                                     "compile_cache_hits",
+                                     "compile_cache_misses")}
+        return out

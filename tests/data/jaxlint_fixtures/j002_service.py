@@ -1,0 +1,73 @@
+"""J002 fixtures: TOA-service API misuse inside jit.
+
+The service (pulseportraiture_tpu.service) is host-side daemon
+orchestration by contract — socket IO, per-tenant ledger intake,
+micro-batch thread barriers and program warm-up all drive the jit
+boundary from OUTSIDE; under jit each call would fire once at trace
+time and its threading/file IO cannot exist in compiled code.  This
+corpus proves no service entry point is reachable inside a jit trace
+without the linter firing.  docs/SERVICE.md.
+"""
+
+import jax
+
+from pulseportraiture_tpu import service
+from pulseportraiture_tpu.service import TOAService, client_request, \
+    warm_plan
+
+
+@jax.jit
+def bad_service_ctor_in_jit(x):
+    svc = service.TOAService("m.gmodel", "/tmp/wd")  # EXPECT: J002
+    return x + len(svc.status())
+
+
+@jax.jit
+def bad_warm_in_jit(x):
+    service.warm_plan("plan.json", "m.gmodel")  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_bare_warm(x):
+    # the ``from ..service import warm_plan`` idiom
+    warm_plan("plan.json", "m.gmodel")  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_bare_ctor(x):
+    TOAService("m.gmodel", "/tmp/wd")  # EXPECT: J002
+    return x + 1.0
+
+
+@jax.jit
+def bad_client_in_jit(x):
+    client_request("/tmp/s.sock", {"op": "ping"})  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_batcher_in_jit(x):
+    b = service.MicroBatcher(bucket=(8, 64))  # EXPECT: J002
+    return x + b.n_dispatches
+
+
+@jax.jit
+def ok_suppressed(x):
+    service.program_specs("plan.json")  # jaxlint: disable=J002
+    return x
+
+
+def ok_host_side(plan, archives):
+    # outside jit: exactly how the ppserve CLI drives the service
+    svc = TOAService("m.gmodel", "/tmp/wd", plan=plan).start()
+    for a in archives:
+        svc.submit("tenant", a, wait=True)
+    return svc.shutdown()
+
+
+@jax.jit
+def ok_unrelated_attr(x, service_level):
+    # an array merely NAMED service-ish must not trip the rule
+    return service_level.sum() + x

@@ -1,0 +1,531 @@
+"""TOA-service tests (the ISSUE 7 acceptance scenarios).
+
+Covers the resident daemon end to end in-process: submit/complete with
+checkpointed TOAs and replay, micro-batching (N same-bucket requests
+from two tenants → one device dispatch, ≤1 program per bucket),
+fairness under a tenant flood, backpressure rejections, the warm-path
+proof (zero new XLA compiles after ``warm()``), SLO under injected
+chaos (exactly the faulted request quarantines, everyone else
+completes), drain semantics, per-request obs run pruning, restart
+recovery of accepted work, micro-batcher correctness (combined
+dispatch == solo dispatch, config-mismatch isolation), and the socket
+protocol.  The real-SIGTERM/subprocess path is tools/service_smoke.py.
+"""
+
+import json
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu import obs
+from pulseportraiture_tpu.fit import portrait as fp
+from pulseportraiture_tpu.io.archive import make_fake_pulsar
+from pulseportraiture_tpu.io.gmodel import write_model
+from pulseportraiture_tpu.runner.plan import plan_survey
+from pulseportraiture_tpu.runner.queue import WorkQueue
+from pulseportraiture_tpu.service import (MicroBatcher, ServiceServer,
+                                          TOAService, client_request,
+                                          program_specs, warm_plan)
+from pulseportraiture_tpu.testing import faults
+
+MODEL_PARAMS = np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5])
+
+
+def _make_archives(tmp, gm, par, n, nchan=8, nbin=64, nsub=2, seed0=90,
+                   prefix="s"):
+    files = []
+    for i in range(n):
+        out = str(tmp / f"{prefix}{i}.fits")
+        make_fake_pulsar(gm, par, out, nsub=nsub, nchan=nchan,
+                         nbin=nbin, nu0=1500.0, bw=800.0, tsub=60.0,
+                         phase=0.02 * (i + 1), dDM=5e-4,
+                         noise_stds=0.01, dedispersed=False,
+                         seed=seed0 + i, quiet=True)
+        files.append(out)
+    return files
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("service")
+    gm = str(tmp / "s.gmodel")
+    write_model(gm, "s", "000", 1500.0, MODEL_PARAMS,
+                np.ones(8, int), -4.0, 0, quiet=True)
+    par = str(tmp / "s.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    files = _make_archives(tmp, gm, par, 6)
+    return SimpleNamespace(tmp=tmp, gm=gm, par=par, files=files,
+                           plan=plan_survey(files, modelfile=gm))
+
+
+def _service(corpus, workdir, **kw):
+    kw.setdefault("batch_window_s", 0.2)
+    kw.setdefault("batch_max", 4)
+    kw.setdefault("backoff_s", 0.0)
+    kw.setdefault("get_toas_kw", {"bary": False})
+    kw.setdefault("quiet", True)
+    return TOAService(corpus.gm, str(workdir), **kw)
+
+
+def _events(run_dir):
+    out = []
+    for path in obs.list_event_files(run_dir):
+        with open(path, encoding="utf-8") as fh:
+            out.extend(json.loads(ln) for ln in fh if ln.strip())
+    return out
+
+
+# -- end-to-end lifecycle ----------------------------------------------
+
+
+def test_submit_complete_replay_and_checkpoint(corpus, tmp_path):
+    svc = _service(corpus, tmp_path / "wd").start()
+    try:
+        run_dir = obs.current().dir
+        r = svc.submit("alice", corpus.files[0], wait=True,
+                       timeout=300)
+        assert r["state"] == "done", r
+        assert r["n_toas"] == 2
+        assert len(r["toa_lines"]) == 2
+        # TOA lines carry the tenant audit flag
+        assert all("-pp_tenant alice" in ln for ln in r["toa_lines"])
+        # checkpointed block in the tenant's own .tim
+        tim = tmp_path / "wd" / "tenants" / "alice" / "toas.tim"
+        lines = tim.read_text().splitlines()
+        assert sum(1 for ln in lines
+                   if ln.split()[:2] == ["C", "pp_done"]) == 1
+        # ledger records the terminal state
+        led = tmp_path / "wd" / "tenants" / "alice" / "ledger.0.jsonl"
+        states = [json.loads(ln)["state"]
+                  for ln in led.read_text().splitlines()]
+        assert states[-1] == "done"
+        # duplicate submission replays the recorded outcome: no refit
+        n_calls0 = sum(b.batcher.n_calls
+                       for b in svc._buckets.values())
+        rp = svc.submit("alice", corpus.files[0], wait=True)
+        assert rp.get("cached") and rp["state"] == "done", rp
+        assert sum(b.batcher.n_calls
+                   for b in svc._buckets.values()) == n_calls0
+        # per-request obs run dir exists with the lifecycle trail
+        req_runs = os.listdir(tmp_path / "wd" / "obs_requests")
+        assert len(req_runs) == 1
+    finally:
+        assert svc.shutdown(timeout=120)
+    evs = _events(run_dir)
+    phases = [e.get("phase") for e in evs
+              if e.get("name") == "service_request"]
+    assert "submitted" in phases and "terminal" in phases
+
+
+def test_microbatch_two_tenants_one_dispatch(corpus, tmp_path):
+    """The acceptance scenario: 4 same-bucket single-archive requests
+    from two tenants batch into ONE device dispatch on at most one new
+    solver program."""
+    svc = _service(corpus, tmp_path / "wd", batch_window_s=0.5,
+                   batch_max=4).start()
+    try:
+        run_dir = obs.current().dir
+        n_prog0 = fp._batch_impl._cache_size()
+        ids = []
+        for tenant, path in zip(["alice", "bob", "alice", "bob"],
+                                corpus.files[:4]):
+            r = svc.submit(tenant, path)
+            assert r["ok"], r
+            ids.append(r["request_id"])
+        res = [svc.wait(i, timeout=300) for i in ids]
+        assert [r["state"] for r in res] == ["done"] * 4, res
+        # ≤ ceil(K / batch_max) == 1 dispatch, and at most one program
+        b = svc._buckets[(8, 64)]
+        assert b.batcher.n_dispatches == 1, b.batcher.n_dispatches
+        assert b.batcher.n_coalesced == 4
+        assert fp._batch_impl._cache_size() - n_prog0 <= 1
+    finally:
+        assert svc.shutdown(timeout=120)
+    evs = _events(run_dir)
+    mb = [e for e in evs if e.get("name") == "microbatch_dispatch"]
+    assert len(mb) == 1 and mb[0]["n_requests"] == 4, mb
+    batches = [e for e in evs if e.get("name") == "service_batch"]
+    assert batches and batches[0]["tenants"] == ["alice", "bob"]
+
+
+def test_warm_zero_new_compiles(corpus, tmp_path_factory):
+    """Warm-path acceptance: after warm(), a request on a planned
+    bucket triggers zero new XLA compiles — asserted via the obs
+    backend_compiles counter, on a bucket shape this test session has
+    never fit before."""
+    tmp = tmp_path_factory.mktemp("service_warm")
+    files = _make_archives(tmp, corpus.gm, corpus.par, 2, nchan=16,
+                           nbin=64, seed0=120, prefix="w")
+    plan = plan_survey(files, modelfile=corpus.gm)
+    svc = _service(corpus, tmp / "wd", plan=plan,
+                   batch_window_s=0.4, batch_max=2).start()
+    try:
+        summary = svc.warm(coalesce=(2,))
+        assert summary["n_programs"] >= 1
+        rec = obs.current()
+        c0 = int(rec.counters.get("backend_compiles", 0))
+        ids = [svc.submit(t, f)["request_id"]
+               for t, f in zip(["alice", "bob"], files)]
+        res = [svc.wait(i, timeout=300) for i in ids]
+        assert [r["state"] for r in res] == ["done", "done"], res
+        assert int(rec.counters.get("backend_compiles", 0)) == c0, \
+            "request on a warmed bucket compiled something new"
+    finally:
+        assert svc.shutdown(timeout=120)
+
+
+def test_program_specs_enumeration(corpus):
+    specs = program_specs(corpus.plan, coalesce=(4,))
+    kinds = {s.kind for s in specs}
+    assert "archive" in kinds
+    arch = [s for s in specs if s.kind == "archive"]
+    assert len(arch) == 1  # one bucket, one native shape, one nsub
+    assert arch[0].bucket == (8, 64) and arch[0].nsub == 2
+    assert arch[0].batch == 4  # bucket_batch_size(2)
+    co = [s for s in specs if s.kind == "coalesced"]
+    assert len(co) == 1 and co[0].batch == 8  # 4 archives x 2 subints
+
+
+def test_warm_populates_persistent_compile_cache(corpus, tmp_path):
+    """The AOT stage writes the persistent compilation cache and the
+    obs counters record the misses (first fill) — the zero-cold-start
+    slice of the ROADMAP item."""
+    from pulseportraiture_tpu.config import set_compile_cache_dir
+
+    cache = tmp_path / "xla_cache"
+    set_compile_cache_dir(str(cache))
+    try:
+        with obs.run("warmtest", base_dir=str(tmp_path / "obs")) as rec:
+            summary = warm_plan(corpus.plan, corpus.gm,
+                                get_toas_kw={"bary": False},
+                                quiet=True)
+            assert summary["n_programs"] == 1
+            # cache entries exist and at least one miss was counted
+            assert any(cache.iterdir())
+            assert int(rec.counters.get("compile_cache_misses",
+                                        0)) >= 1
+    finally:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", None)
+
+
+# -- tenancy: fairness + backpressure ----------------------------------
+
+
+def test_backpressure_rejects_beyond_budget(corpus, tmp_path):
+    svc = _service(corpus, tmp_path / "wd", tenant_max_queue=2,
+                   batch_window_s=5.0).start()  # hold dispatch open
+    try:
+        r1 = svc.submit("alice", corpus.files[0])
+        r2 = svc.submit("alice", corpus.files[1])
+        assert r1["ok"] and r2["ok"]
+        r3 = svc.submit("alice", corpus.files[2])
+        assert not r3["ok"] and r3["error"] == "backpressure", r3
+        # another tenant is unaffected by alice's full queue
+        r4 = svc.submit("bob", corpus.files[3])
+        assert r4["ok"], r4
+    finally:
+        svc.shutdown(timeout=300)
+
+
+def test_fairness_flooding_tenant_does_not_starve(corpus, tmp_path):
+    """alice floods 4 requests; bob's single later request must ride
+    the first cycle (per-tenant inflight cap + oldest-first fill), not
+    wait behind the flood."""
+    svc = _service(corpus, tmp_path / "wd", batch_window_s=0.6,
+                   batch_max=2, tenant_max_inflight=1).start()
+    try:
+        a_ids = [svc.submit("alice", f)["request_id"]
+                 for f in corpus.files[:4]]
+        b_id = svc.submit("bob", corpus.files[4])["request_id"]
+        res_b = svc.wait(b_id, timeout=300)
+        assert res_b["state"] == "done"
+        res_a = [svc.wait(i, timeout=300) for i in a_ids]
+        assert all(r["state"] == "done" for r in res_a)
+        # bob finished no later than alice's last flood request
+        assert res_b["wall_s"] is not None
+        last_a = max(r["wall_s"] for r in res_a)
+        assert res_b["wall_s"] <= last_a + 1e-6, (res_b, res_a)
+    finally:
+        assert svc.shutdown(timeout=300)
+
+
+# -- chaos / SLO --------------------------------------------------------
+
+
+def _fault_seed_for(path_fault, path_ok, p=0.5):
+    """Seed under which the keyed-probability hash fires for exactly
+    ``path_fault`` (persistent corruption) and never ``path_ok``."""
+    for seed in range(200):
+        c = SimpleNamespace(p=p, seed=seed)
+        fire = faults._Harness._hash_fires
+        if fire(c, "archive_read", WorkQueue.key_for(path_fault), 1) \
+                and not fire(c, "archive_read",
+                             WorkQueue.key_for(path_ok), 1):
+            return seed
+    raise AssertionError("no discriminating seed found")
+
+
+def test_chaos_fault_isolated_to_one_request(corpus, tmp_path):
+    """SLO: with an injected persistent archive-read fault on one
+    archive, exactly that request quarantines (retries exhausted, on
+    the record) and the concurrent request from the other tenant —
+    sharing the SAME micro-batch cycle — completes."""
+    bad, good = corpus.files[0], corpus.files[1]
+    seed = _fault_seed_for(bad, good)
+    svc = _service(corpus, tmp_path / "wd", max_attempts=2,
+                   batch_window_s=0.4).start()
+    faults.configure("site:archive_read@0.5,seed=%d" % seed)
+    try:
+        rb = svc.submit("alice", bad)
+        rg = svc.submit("bob", good)
+        wb = svc.wait(rb["request_id"], timeout=300)
+        wg = svc.wait(rg["request_id"], timeout=300)
+        assert wg["state"] == "done", wg
+        assert wb["state"] == "quarantined", wb
+        assert "retries exhausted" in wb["reason"], wb
+        assert any(f["site"] == "archive_read" for f in faults.fired())
+    finally:
+        faults.reset()
+        assert svc.shutdown(timeout=300)
+
+
+def test_chaos_transient_dispatch_fault_retries(corpus, tmp_path):
+    """A one-shot dispatch fault fails the request once; the retry
+    (bounded, ledger-audited) completes it — the daemon never dies."""
+    svc = _service(corpus, tmp_path / "wd", max_attempts=3,
+                   batch_window_s=0.1).start()
+    faults.configure("site:dispatch@nth=1")
+    try:
+        r = svc.submit("alice", corpus.files[2], wait=True,
+                       timeout=300)
+        assert r["state"] == "done", r
+        assert r["attempts"] == 1, r
+    finally:
+        faults.reset()
+        assert svc.shutdown(timeout=300)
+
+
+def test_drain_rejects_new_finishes_accepted(corpus, tmp_path):
+    svc = _service(corpus, tmp_path / "wd",
+                   batch_window_s=0.5).start()
+    r = svc.submit("alice", corpus.files[3])
+    assert r["ok"]
+    svc.request_drain()
+    rejected = svc.submit("alice", corpus.files[4])
+    assert not rejected["ok"] and rejected["error"] == "draining"
+    w = svc.wait(r["request_id"], timeout=300)
+    assert w["state"] == "done", w  # accepted work finished
+    assert svc.drained(timeout=60)
+    assert svc.shutdown(timeout=60)
+
+
+def test_intake_quarantine_and_restart_recovery(corpus, tmp_path):
+    """A corrupt file quarantines at intake; accepted-but-undone work
+    in a tenant ledger is picked up by a restarted daemon with no
+    resubmission."""
+    wd = tmp_path / "wd"
+    corrupt = tmp_path / "corrupt.fits"
+    corrupt.write_bytes(b"SIMPLE  =                    T" + b"\x00" * 64)
+    svc = _service(corpus, wd).start()
+    try:
+        r = svc.submit("alice", str(corrupt), wait=True, timeout=60)
+        assert r["state"] == "quarantined", r
+        assert "unreadable at intake" in r["reason"]
+    finally:
+        assert svc.shutdown(timeout=120)
+    # seed a pending entry as if a previous daemon died post-accept
+    os.makedirs(wd / "tenants" / "bob", exist_ok=True)
+    q = WorkQueue(str(wd / "tenants" / "bob" / "ledger.0.jsonl"))
+    q.add([corpus.files[5]])
+    q.close()
+    svc2 = _service(corpus, wd).start()
+    try:
+        deadline = time.time() + 300
+        key = WorkQueue.key_for(corpus.files[5])
+        while time.time() < deadline:
+            with svc2._lock:
+                t = svc2._tenants.get("bob")
+                state = t.queue.state(key) if t else None
+            if state == "done":
+                break
+            time.sleep(0.2)
+        assert state == "done", state
+    finally:
+        assert svc2.shutdown(timeout=120)
+
+
+def test_request_run_dir_budget(corpus, tmp_path):
+    svc = _service(corpus, tmp_path / "wd", run_dirs_max=2,
+                   batch_window_s=0.05).start()
+    try:
+        for f in corpus.files[:4]:
+            r = svc.submit("alice", f, wait=True, timeout=300)
+            assert r["state"] == "done", r
+    finally:
+        assert svc.shutdown(timeout=120)
+    kept = os.listdir(tmp_path / "wd" / "obs_requests")
+    assert len(kept) <= 2, kept
+
+
+# -- micro-batcher unit behavior ---------------------------------------
+
+
+def _stub_fit_calls():
+    calls = []
+
+    def fit(*args, **kw):
+        calls.append((args, kw))
+        from pulseportraiture_tpu.utils.databunch import DataBunch
+
+        B = np.asarray(args[0]).shape[0]
+        return DataBunch(phi=np.arange(B, dtype=float),
+                         scalar=np.float64(1.0))
+    return calls, fit
+
+
+def _run_cycle(batcher, arg_sets):
+    """Drive N worker threads through one batcher cycle; returns each
+    worker's result (or exception)."""
+    out = [None] * len(arg_sets)
+
+    def work(i):
+        args, kw = arg_sets[i]
+        try:
+            out[i] = batcher.fit(*args, **kw)
+        except Exception as e:  # noqa: BLE001 — assertion payload
+            out[i] = e
+        finally:
+            batcher.worker_done()
+
+    batcher.begin(len(arg_sets))
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(len(arg_sets))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    return out
+
+
+def _fake_args(B, nchan=4, nbin=16, **kw):
+    args = (np.random.default_rng(B).normal(size=(B, nchan, nbin)),
+            np.ones((B, nchan, nbin)), np.zeros((B, 5)), np.ones(B),
+            np.broadcast_to(np.linspace(1.0, 2.0, nchan),
+                            (B, nchan)).copy())
+    base = dict(errs=np.ones((B, nchan)), weights=np.ones((B, nchan)),
+                nu_fits=np.full((B, 3), 1.5), nu_outs=None,
+                bounds=None, log10_tau=False, max_iter=50,
+                fit_flags=(1, 1, 0, 0, 0), scan_size=None, pad_to=4)
+    base.update(kw)
+    return args, base
+
+
+def test_batcher_coalesces_same_config_and_splits_rows():
+    calls, fit = _stub_fit_calls()
+    b = MicroBatcher(bucket=(4, 16), window_s=5.0, fit=fit)
+    out = _run_cycle(b, [_fake_args(2), _fake_args(3)])
+    assert len(calls) == 1, "same-config calls must share a dispatch"
+    (args, kw) = calls[0]
+    assert np.asarray(args[0]).shape[0] == 5  # concatenated batch
+    assert kw["pad_to"] == 8  # resized for the combined batch
+    assert out[0].phi.shape == (2,) and out[1].phi.shape == (3,)
+    # rows split back in parking order, scalars shared
+    np.testing.assert_array_equal(np.concatenate([out[0].phi,
+                                                  out[1].phi]),
+                                  np.arange(5, dtype=float))
+    assert out[0].scalar == out[1].scalar == 1.0
+
+
+def test_batcher_config_mismatch_isolates_dispatches():
+    calls, fit = _stub_fit_calls()
+    b = MicroBatcher(bucket=(4, 16), window_s=5.0, fit=fit)
+    out = _run_cycle(b, [_fake_args(2),
+                         _fake_args(2, fit_flags=(1, 0, 0, 0, 0))])
+    assert len(calls) == 2, "config mismatch must not share a program"
+    assert all(o.phi.shape == (2,) for o in out)
+
+
+def test_batcher_error_propagates_to_group():
+    def fit(*args, **kw):
+        raise RuntimeError("device fell over")
+
+    b = MicroBatcher(bucket=(4, 16), window_s=5.0, fit=fit)
+    out = _run_cycle(b, [_fake_args(2), _fake_args(2)])
+    assert all(isinstance(o, RuntimeError) for o in out)
+
+
+def test_batcher_combined_matches_solo_real_fit(corpus):
+    """Numeric parity: a coalesced dispatch returns exactly the rows
+    each solo dispatch would have produced (row-independent solver)."""
+    from pulseportraiture_tpu.service.warm import (WarmSpec,
+                                                   synth_databunch)
+
+    spec = WarmSpec((8, 64), 2)
+    from pulseportraiture_tpu.runner.execute import _BucketedGetTOAs
+
+    gt = _BucketedGetTOAs([], corpus.gm, (8, 64), quiet=True)
+    freqs = 1500.0 + 100.0 * (np.arange(8) - 3.5)
+    model = np.asarray(gt._build_model(
+        freqs, (np.arange(64) + 0.5) / 64, 0.005, fit_scat=False))
+    sets = []
+    for seed in (1, 2):
+        d = synth_databunch(model, freqs, 2, seed=seed)
+        args = (d.subints[:, 0], np.broadcast_to(model,
+                                                 (2, 8, 64)),
+                np.stack([np.zeros(2), np.zeros(2), np.zeros(2),
+                          np.zeros(2), np.zeros(2)], axis=1),
+                d.Ps, d.freqs)
+        kw = dict(errs=d.noise_stds[:, 0], weights=d.weights,
+                  nu_fits=np.full((2, 3), 1500.0), nu_outs=None,
+                  bounds=None, log10_tau=False, max_iter=50,
+                  fit_flags=(1, 1, 0, 0, 0), scan_size=None,
+                  pad_to=4)
+        sets.append((args, kw))
+    from pulseportraiture_tpu.fit.portrait import \
+        fit_portrait_full_batch
+
+    solo = [fit_portrait_full_batch(*a, **k) for a, k in sets]
+    b = MicroBatcher(bucket=(8, 64), window_s=5.0)
+    combined = _run_cycle(b, sets)
+    assert b.n_dispatches == 1
+    for s, c in zip(solo, combined):
+        np.testing.assert_allclose(np.asarray(c.phi),
+                                   np.asarray(s["phi"]), atol=1e-8)
+        np.testing.assert_allclose(np.asarray(c.DM),
+                                   np.asarray(s["DM"]), atol=1e-8)
+
+
+# -- socket protocol ----------------------------------------------------
+
+
+def test_socket_server_roundtrip(corpus, tmp_path):
+    svc = _service(corpus, tmp_path / "wd").start()
+    sock = str(tmp_path / "wd" / "t.sock")
+    server = ServiceServer(svc, sock).start()
+    try:
+        assert client_request(sock, {"op": "ping"})["ok"]
+        r = client_request(sock, {"op": "submit", "tenant": "alice",
+                                  "archive": corpus.files[0],
+                                  "wait": True, "timeout_s": 300},
+                           timeout=330)
+        assert r["state"] == "done", r
+        st = client_request(sock, {"op": "status"})
+        assert st["ok"] and "alice" in st["tenants"], st
+        assert st["tenants"]["alice"]["counts"]["done"] == 1
+        bad = client_request(sock, {"op": "frobnicate"})
+        assert not bad["ok"] and bad["error"] == "unknown_op"
+        sh = client_request(sock, {"op": "shutdown"})
+        assert sh["ok"] and sh["draining"]
+        assert svc.drained(timeout=60)
+    finally:
+        server.stop()
+        svc.shutdown(timeout=60)
+    assert not os.path.exists(sock)

@@ -22,7 +22,12 @@
 #                 1 and then 3 processes — zero lost and zero
 #                 duplicated archives (docs/RUNNER.md Elasticity,
 #                 testing/faults.py)
-#   7. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
+#   7. service smoke — a real warmed ppserve daemon under an injected
+#                 read fault + mid-request SIGTERM: 2 done + 1
+#                 quarantined across 2 tenants, drain exits 0, zero
+#                 post-warm compiles, per-request audit trail
+#                 (docs/SERVICE.md)
+#   8. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
 #
 # Exit status is non-zero when any stage fails.
 set -u
@@ -88,6 +93,17 @@ if [ $? -ne 0 ]; then
     fail=1
 else
     tail -1 /tmp/_chaos_smoke.log
+fi
+
+echo
+echo "== service smoke (warmed ppserve daemon under chaos, docs/SERVICE.md) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu PPTPU_OBS_DIR="" PPTPU_FAULTS="" \
+    python -m tools.service_smoke >/tmp/_service_smoke.log 2>&1
+if [ $? -ne 0 ]; then
+    tail -40 /tmp/_service_smoke.log
+    fail=1
+else
+    tail -1 /tmp/_service_smoke.log
 fi
 
 echo

@@ -71,6 +71,16 @@ _RUNNER_API_NAMES = {"plan_survey", "run_survey", "scan_archive_header",
 _FAULTS_API_NAMES = {"check", "configure", "reset", "fired", "active",
                      "spec_string"}
 
+# TOA service (pulseportraiture_tpu.service): host-side daemon
+# orchestration by contract — socket IO, ledger intake, thread
+# barriers and warm-up drive the jit boundary from OUTSIDE; under jit
+# each call would fire once at trace time and its threading/file IO
+# cannot exist in compiled code.  Matched as ``service.<name>`` or the
+# bare exported entry points.
+_SERVICE_API_NAMES = {"TOAService", "MicroBatcher", "ServiceServer",
+                      "warm_plan", "program_specs", "client_request",
+                      "synth_databunch", "enable_persistent_cache"}
+
 _JNP_PREFIXES = ("jnp.", "jax.numpy.")
 
 
@@ -411,6 +421,18 @@ class RuleVisitor(ast.NodeVisitor):
                           "rewrites); under jit it would run once at "
                           "trace time and its file IO is unreachable "
                           "from compiled code (docs/RUNNER.md)")
+            elif fname is not None and (
+                    (fname.startswith("service.")
+                     and fname.split(".", 1)[1] in _SERVICE_API_NAMES)
+                    or fname in _SERVICE_API_NAMES):
+                self._add("J002", node,
+                          "TOA-service call inside a jitted function "
+                          "— the service is host-side daemon "
+                          "orchestration (socket IO, ledger intake, "
+                          "micro-batch barriers, warm-up); under jit "
+                          "it would run once at trace time and its "
+                          "threading/file IO cannot exist in compiled "
+                          "code (docs/SERVICE.md)")
             elif fname is not None and "." in fname:
                 head, attr = fname.rsplit(".", 1)
                 if attr in _HOST_SYNC_METHODS and \

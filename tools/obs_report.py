@@ -341,6 +341,73 @@ def summarize_robustness(events):
     return "\n".join(lines)
 
 
+def summarize_service(events):
+    """TOA-service audit trail (docs/SERVICE.md): per-tenant request
+    outcomes, the per-request lifecycle tail, micro-batch dispatch
+    efficiency, and the warm-up program table — a daemon's report must
+    answer "who asked for what, what happened, and was it warm?"."""
+    reqs = [e for e in events if e.get("kind") == "event"
+            and e.get("name") == "service_request"]
+    disp = [e for e in events if e.get("kind") == "event"
+            and e.get("name") == "microbatch_dispatch"]
+    warm = [e for e in events if e.get("kind") == "event"
+            and e.get("name") == "warm_program"]
+    if not reqs and not disp and not warm:
+        return None
+    lines = []
+    terminal = [e for e in reqs if e.get("phase") == "terminal"]
+    if reqs:
+        tenants = {}
+        for e in terminal:
+            per = tenants.setdefault(e.get("tenant", "?"), {})
+            st = e.get("state", "?")
+            per[st] = per.get(st, 0) + 1
+        for tenant in sorted(tenants):
+            lines.append("- tenant %s: %s" % (
+                tenant, "  ".join("%s: %d" % (k, v) for k, v in
+                                  sorted(tenants[tenant].items()))))
+        rows = []
+        for e in terminal[-20:]:
+            rows.append([
+                str(e.get("request", "?")), str(e.get("tenant", "?")),
+                os.path.basename(str(e.get("archive", "?"))),
+                str(e.get("bucket", "-")), str(e.get("state", "?")),
+                str(e.get("attempts", 0)),
+                _fmt_s(_num(e.get("wall_s"))),
+                str(e.get("n_toas", "-"))])
+        if rows:
+            lines.append(_table(
+                ["request", "tenant", "archive", "bucket", "state",
+                 "att", "wall_s", "toas"], rows))
+        if len(terminal) > 20:
+            lines.append("... %d more terminal request(s)"
+                         % (len(terminal) - 20))
+    if disp:
+        n_req = sum(int(_num(e.get("n_requests"), 1)) for e in disp)
+        n_multi = sum(1 for e in disp
+                      if int(_num(e.get("n_requests"), 1)) > 1)
+        lines.append("micro-batch: %d dispatch(es) for %d fit "
+                     "call(s); %d coalesced cycle(s)"
+                     % (len(disp), n_req, n_multi))
+    if warm:
+        n_comp = sum(int(_num(e.get("backend_compiles"))) for e in warm)
+        n_hit = sum(int(_num(e.get("compile_cache_hits")))
+                    for e in warm)
+        n_miss = sum(int(_num(e.get("compile_cache_misses")))
+                     for e in warm)
+        lines.append("warm-up: %d program(s), %d compile(s), "
+                     "persistent cache %d hit(s) / %d miss(es)"
+                     % (len(warm), n_comp, n_hit, n_miss))
+        for e in warm:
+            lines.append("- warm %s nsub=%s batch=%s %s: "
+                         "compiles=%d"
+                         % (e.get("bucket"), e.get("nsub"),
+                            e.get("batch"),
+                            e.get("program_kind", "archive"),
+                            int(_num(e.get("backend_compiles")))))
+    return "\n".join(lines)
+
+
 def summarize(run_dir):
     """Full human-readable report for one run directory."""
     manifest, events = load_run(run_dir)
@@ -393,6 +460,11 @@ def summarize(run_dir):
         out.append("")
         out.append("## fit telemetry (per-subint convergence)")
         out.append(fits)
+    svc = summarize_service(events)
+    if svc:
+        out.append("")
+        out.append("## service requests")
+        out.append(svc)
     rob = summarize_robustness(events)
     if rob:
         out.append("")

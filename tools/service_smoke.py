@@ -1,0 +1,223 @@
+"""Service smoke gate: a real ppserve daemon under injected faults and
+a mid-request SIGTERM must fail exactly the poisoned request, finish
+everything else, and exit 0 (wired into tools/check.sh).
+
+The scenario (ISSUE 7 / docs/SERVICE.md):
+
+* a daemon subprocess starts with ``--warm`` over a one-bucket plan
+  and the chaos harness active via the environment::
+
+      PPTPU_FAULTS="site:archive_read@nth=1;sigterm@after=2"
+
+  The warm stage makes exactly one ``dispatch``-site check (one
+  archive class), so the SIGTERM lands at dispatch check #2 — the
+  FIRST real request's device dispatch, i.e. mid-request — and the
+  read fault hits the first real ``load_data`` (warm synthesizes its
+  own archive without touching the ``archive_read`` site).
+* two tenants submit 3 archives: 2 good (same bucket) + 1 corrupt.
+* asserted: the corrupt file is quarantined at intake with a reason;
+  the read-faulted request retries and completes; the SIGTERM drains —
+  both good requests finish, ledgers/checkpoints flush — and the
+  daemon exits 0.  Per-tenant ledgers and ``toas.tim`` checkpoints
+  agree (2 done + 1 quarantined, one marked block per done archive).
+* the obs report renders the per-request audit trail ("## service
+  requests"), the micro-batch dispatch line, the warm table, and the
+  injected faults; after warm-up the whole request phase compiled
+  NOTHING (backend_compiles == the warm gauge), and each request's own
+  run dir manifest shows zero compiles.
+
+Run:  env JAX_PLATFORMS=cpu python -m tools.service_smoke
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+# archive_read check #1 and dispatch check #1 belong to the WARM
+# stage's own synthetic archive (service/warm.py loads a real FITS),
+# so nth=2 / after=2 target the first REAL request's load and
+# dispatch
+FAULT_SPEC = "site:archive_read@nth=2;sigterm@after=2"
+
+
+def _wait_ready(proc, timeout=420.0):
+    """Read the daemon's stdout until the PPSERVE_READY marker."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise AssertionError(
+                "daemon exited before ready: rc=%s" % proc.poll())
+        line = line.decode("utf-8", "replace").strip()
+        if line.startswith("PPSERVE_READY "):
+            return json.loads(line[len("PPSERVE_READY "):])
+    raise AssertionError("daemon never became ready")
+
+
+def _ledger(path):
+    with open(path, encoding="utf-8") as fh:
+        return [json.loads(ln) for ln in fh if ln.strip()]
+
+
+def main():
+    workroot = tempfile.mkdtemp(prefix="pptpu_service_smoke_")
+    proc = None
+    try:
+        from pulseportraiture_tpu.io.archive import make_fake_pulsar
+        from pulseportraiture_tpu.io.gmodel import write_model
+        from pulseportraiture_tpu.runner.plan import plan_survey
+        from pulseportraiture_tpu.service import client_request
+
+        gm = os.path.join(workroot, "serve.gmodel")
+        write_model(gm, "serve", "000", 1500.0,
+                    np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0,
+                              -0.5]),
+                    np.ones(8, int), -4.0, 0, quiet=True)
+        par = os.path.join(workroot, "serve.par")
+        with open(par, "w") as f:
+            f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                    "PEPOCH 56000.0\nDM 30.0\n")
+        good = []
+        for i in range(2):
+            fits = os.path.join(workroot, "req%d.fits" % i)
+            make_fake_pulsar(gm, par, fits, nsub=2, nchan=8, nbin=64,
+                             nu0=1500.0, bw=800.0, tsub=60.0,
+                             phase=0.03 * (i + 1), dDM=5e-4,
+                             noise_stds=0.01, dedispersed=False,
+                             seed=71 + i, quiet=True)
+            good.append(fits)
+        corrupt = os.path.join(workroot, "corrupt.fits")
+        with open(corrupt, "wb") as f:
+            f.write(b"SIMPLE  =                    T" + b"\x00" * 64)
+
+        wd = os.path.join(workroot, "wd")
+        plan = plan_survey(good, modelfile=gm)
+        assert plan.n_archives == 2 and len(plan.buckets) == 1, \
+            plan.to_dict()
+        os.makedirs(wd)
+        plan.save(os.path.join(wd, "plan.json"))
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PPTPU_FAULTS"] = FAULT_SPEC
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "pulseportraiture_tpu.cli.ppserve",
+             "start", "-w", wd, "-m", gm,
+             "--plan", os.path.join(wd, "plan.json"), "--warm",
+             "--window", "1.0", "--batch", "4", "--backoff", "0",
+             "--no_bary", "--quiet"],
+            env=env, cwd=os.getcwd(), stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE)
+        ready = _wait_ready(proc)
+        sock = ready["socket"]
+        assert ready["warmed"], ready
+
+        # 3 submissions from 2 tenants; the daemon's micro-batch
+        # window (1 s) collects both good same-bucket requests into
+        # one cycle.  The SIGTERM fires inside that cycle's dispatch
+        # — mid-request — and must drain, not kill.
+        r0 = client_request(sock, {"op": "submit", "tenant": "alice",
+                                   "archive": good[0]})
+        r1 = client_request(sock, {"op": "submit", "tenant": "bob",
+                                   "archive": good[1]})
+        rc = client_request(sock, {"op": "submit", "tenant": "alice",
+                                   "archive": corrupt})
+        assert r0["ok"] and r1["ok"], (r0, r1)
+        assert rc["ok"] and rc["state"] == "quarantined", rc
+        assert "unreadable at intake" in rc.get("reason", ""), rc
+
+        w0 = client_request(sock, {"op": "wait",
+                                   "request_id": r0["request_id"],
+                                   "timeout_s": 300}, timeout=330)
+        w1 = client_request(sock, {"op": "wait",
+                                   "request_id": r1["request_id"],
+                                   "timeout_s": 300}, timeout=330)
+        # the read-faulted request retried (attempt 2 succeeded)
+        assert w0["state"] == "done", w0
+        assert w1["state"] == "done", w1
+
+        # the SIGTERM was delivered mid-dispatch: the daemon must now
+        # drain on its own and exit 0
+        rc_daemon = proc.wait(timeout=300)
+        assert rc_daemon == 0, (rc_daemon, proc.stderr.read()[-2000:])
+
+        # -- durable state: per-tenant ledgers + checkpoints ---------
+        done, quar, attempts = {}, {}, {}
+        for tenant in ("alice", "bob"):
+            led = os.path.join(wd, "tenants", tenant, "ledger.0.jsonl")
+            for rec in _ledger(led):
+                if rec["state"] == "done":
+                    done[rec["archive"]] = done.get(rec["archive"],
+                                                    0) + 1
+                    attempts[rec["archive"]] = rec.get("attempts", 0)
+                elif rec["state"] == "quarantined":
+                    quar[rec["archive"]] = quar.get(rec["archive"],
+                                                    0) + 1
+        assert done == {os.path.realpath(f): 1 for f in good}, done
+        assert quar == {os.path.realpath(corrupt): 1}, quar
+        # exactly one request retried past the injected read fault
+        assert sorted(attempts.values()) == [0, 1], attempts
+        for tenant, fits in (("alice", good[0]), ("bob", good[1])):
+            tim = os.path.join(wd, "tenants", tenant, "toas.tim")
+            lines = open(tim).readlines()
+            toa = [ln for ln in lines if ln.split()
+                   and ln.split()[0] not in ("FORMAT", "C", "#")]
+            mark = [ln for ln in lines
+                    if ln.split()[:2] == ["C", "pp_done"]]
+            assert len(toa) == 2 and len(mark) == 1, (tenant, lines)
+
+        # -- obs: audit trail + warm-path proof ----------------------
+        obs_base = os.path.join(wd, "obs")
+        runs = sorted(os.path.join(obs_base, d)
+                      for d in os.listdir(obs_base))
+        assert runs, "no daemon obs run recorded"
+        run = runs[-1]
+        manifest = json.load(open(os.path.join(run, "manifest.json")))
+        counters = manifest.get("counters") or {}
+        gauges = manifest.get("gauges") or {}
+        assert counters.get("service_done") == 2, counters
+        assert counters.get("service_quarantined") == 1, counters
+        assert counters.get("service_retries", 0) >= 1, counters
+        # zero-cold-request proof: every backend compile of the
+        # daemon's life happened during warm-up
+        assert counters.get("backend_compiles") == \
+            gauges.get("warm_backend_compiles"), (counters, gauges)
+
+        from tools.obs_report import summarize
+
+        text = summarize(run)
+        assert "## service requests" in text, text
+        assert "tenant alice" in text and "tenant bob" in text, text
+        assert "micro-batch:" in text and "warm-up:" in text, text
+        assert "## faults & robustness" in text, text
+        assert "fault_injected" in text, text
+
+        # per-request run dirs: one per accepted request, each proving
+        # zero compiles in its window
+        req_runs = sorted(os.listdir(os.path.join(wd, "obs_requests")))
+        assert len(req_runs) == 3, req_runs
+        for d in req_runs:
+            man = json.load(open(os.path.join(wd, "obs_requests", d,
+                                              "manifest.json")))
+            assert (man.get("counters") or {}).get(
+                "backend_compiles", 0) == 0, (d, man.get("counters"))
+
+        print("service smoke OK: corrupt intake quarantined, read "
+              "fault retried, SIGTERM mid-dispatch drained 2 done + "
+              "1 quarantined with exit 0, zero post-warm compiles, "
+              "per-request audit in %s" % run)
+        return 0
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
