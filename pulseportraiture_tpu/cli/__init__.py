@@ -3,11 +3,15 @@
 Each module exposes ``main(argv=None)`` and runs as
 ``python -m pulseportraiture_tpu.cli.<tool>``:
 
-- pptoas   — measure wideband/narrowband TOAs (+DM, GM, scattering)
-- ppalign  — align and average archives
-- ppgauss  — build Gaussian-component portrait models
-- ppspline — build PCA/B-spline portrait models
-- ppzap    — identify bad channels to zap
+- pptoas    — measure wideband/narrowband TOAs (+DM, GM, scattering)
+- ppalign   — align and average archives
+- ppgauss   — build Gaussian-component portrait models
+- ppspline  — build PCA/B-spline portrait models
+- ppzap     — identify bad channels to zap
+- ppsurvey  — shape-bucketed survey runner (docs/RUNNER.md)
+- ppserve   — resident TOA-fitting daemon (docs/SERVICE.md)
+- pploadgen — load generator + SLO gate for ppserve
 """
 
-TOOLS = ("pptoas", "ppalign", "ppgauss", "ppspline", "ppzap")
+TOOLS = ("pptoas", "ppalign", "ppgauss", "ppspline", "ppzap",
+         "ppsurvey", "ppserve", "pploadgen")

@@ -120,6 +120,18 @@ def build_parser():
         c = sub.add_parser(name, help=help_text)
         c.add_argument("-w", "--workdir", required=True)
         c.add_argument("--socket", default=None)
+        if name == "status":
+            c.add_argument("--watch", action="store_true",
+                           help="pptop-style live view: refresh from "
+                                "the daemon's streaming-metrics "
+                                "snapshots (the 'metrics' socket "
+                                "verb) until interrupted.")
+            c.add_argument("--interval", type=float, default=2.0,
+                           metavar="S",
+                           help="--watch refresh interval [s].")
+            c.add_argument("--ticks", type=int, default=0,
+                           help="Stop --watch after N frames "
+                                "(0 = until interrupted).")
     return p
 
 
@@ -261,13 +273,59 @@ def _cmd_simple(op):
     return run
 
 
+def watch_loop(fetch, interval, ticks, title):
+    """Shared --watch driver (ppserve/ppsurvey): render one frame per
+    tick from ``fetch()``'s metrics snapshot, rates from the previous
+    tick's — no ledger scans, just snapshot reads.  Bounded by
+    ``ticks`` when nonzero; Ctrl-C exits 0."""
+    import time
+
+    from ..obs import metrics
+
+    prev = None
+    tick = 0
+    try:
+        while True:
+            snap = fetch()
+            frame = metrics.render_watch(snap, prev, title=title)
+            if sys.stdout.isatty():
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            print(frame)
+            sys.stdout.flush()
+            prev = snap
+            tick += 1
+            if ticks and tick >= ticks:
+                return 0
+            time.sleep(max(0.05, interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+def _cmd_status(args):
+    if not getattr(args, "watch", False):
+        return _cmd_simple("status")(args)
+    from ..service import client_request
+
+    sock = _socket_path(args)
+
+    def fetch():
+        try:
+            return client_request(sock, {"op": "metrics"},
+                                  timeout=30.0).get("snapshot")
+        except (OSError, ValueError):
+            return None
+
+    return watch_loop(fetch, args.interval, args.ticks,
+                      title="ppserve %s" % args.workdir)
+
+
 def main(argv=None):
     args = build_parser().parse_args(argv)
     if args.command is None:
         build_parser().print_help()
         return 1
     return {"start": _cmd_start, "warm": _cmd_warm,
-            "submit": _cmd_submit, "status": _cmd_simple("status"),
+            "submit": _cmd_submit, "status": _cmd_status,
             "shutdown": _cmd_simple("shutdown"),
             "ping": _cmd_simple("ping")}[args.command](args)
 

@@ -110,6 +110,17 @@ def build_parser():
 
     st = sub.add_parser("status", help="Aggregate ledger state.")
     st.add_argument("-w", "--workdir", required=True)
+    st.add_argument("--watch", action="store_true",
+                    help="Live view refreshed from the newest obs "
+                         "run's metrics.jsonl snapshots (the running "
+                         "survey exports them every "
+                         "$PPTPU_METRICS_INTERVAL seconds) — no "
+                         "union-ledger replay per tick.")
+    st.add_argument("--interval", type=float, default=2.0, metavar="S",
+                    help="--watch refresh interval [s].")
+    st.add_argument("--ticks", type=int, default=0,
+                    help="Stop --watch after N frames (0 = until "
+                         "interrupted).")
 
     rp = sub.add_parser("report",
                         help="Merge obs shards + print the obs report "
@@ -189,6 +200,22 @@ def _cmd_run(args):
 def _cmd_status(args):
     from ..runner.execute import survey_status
 
+    if getattr(args, "watch", False):
+        # snapshot-driven live view: each tick reads the newest obs
+        # run's last metrics.jsonl line — a file tail, not a union
+        # replay of every ledger shard (which a large live survey
+        # would pay per refresh)
+        from ..obs import metrics
+        from .ppserve import watch_loop
+
+        base = os.path.join(args.workdir, "obs")
+
+        def fetch():
+            run_dir = metrics.latest_run_dir(base)
+            return metrics.last_snapshot(run_dir) if run_dir else None
+
+        return watch_loop(fetch, args.interval, args.ticks,
+                          title="ppsurvey %s" % args.workdir)
     try:
         status = survey_status(args.workdir)
     except FileNotFoundError as e:

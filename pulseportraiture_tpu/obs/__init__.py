@@ -20,6 +20,10 @@ Layout:
   parsing, self-time reduction, ``jax.named_scope`` (``pp_*``) stage
   attribution, the per-region ``devtime`` events the phase table's
   device column is built from
+* :mod:`.metrics`  — live telemetry plane: label-keyed counters/
+  gauges + log-bucketed latency histograms with exact deterministic
+  merge, periodic ``metrics.jsonl`` snapshots, Prometheus text
+  rendering, SLO evaluation (``pploadgen``), the ``--watch`` frames
 * :mod:`.merge`    — multihost shard merge: per-process
   ``events.<proc>.jsonl`` + ``manifest.<proc>.json`` shards into one
   run (span paths prefixed by process, counters summed)
@@ -29,7 +33,7 @@ contract (jaxlint J002 enforces it statically; ``fit_telemetry``
 additionally passes tracers through untouched at runtime).
 """
 
-from . import devtime, monitor  # noqa: F401
+from . import devtime, metrics, monitor  # noqa: F401
 from .core import (Recorder, configure, counter, current, enabled,
                    event, fit_telemetry, gauge, list_event_files,
                    obs_dir, obs_max_bytes, phases, run, scoped_run,
@@ -39,6 +43,6 @@ from .trace import trace_capture, trace_dir
 
 __all__ = ["Recorder", "configure", "counter", "current", "devtime",
            "enabled", "event", "fit_telemetry", "gauge",
-           "list_event_files", "merge_obs_shards", "obs_dir",
-           "obs_max_bytes", "phases", "run", "scoped_run", "span",
-           "trace_capture", "trace_dir", "monitor"]
+           "list_event_files", "merge_obs_shards", "metrics",
+           "obs_dir", "obs_max_bytes", "phases", "run", "scoped_run",
+           "span", "trace_capture", "trace_dir", "monitor"]

@@ -160,7 +160,28 @@ class Recorder:
         self.manifest = build_manifest(name, self.run_id, config=config)
         self._write_manifest()
         self._mon_cb = monitor.subscribe(self._on_monitoring)
+        # streaming metrics (obs/metrics.py): registry + snapshot
+        # exporter created lazily on the first metrics.* call, so a
+        # run that records no metrics costs neither a thread nor a
+        # metrics.jsonl
+        self._metrics = None
+        self._metrics_exporter = None
         self._closed = False
+
+    def metrics_registry(self):
+        """The run's MetricsRegistry (created on first use, together
+        with the periodic ``metrics.jsonl`` exporter)."""
+        reg = self._metrics
+        if reg is not None:
+            return reg
+        from .metrics import MetricsExporter, MetricsRegistry
+
+        with self._lock:
+            if self._metrics is None:
+                self._metrics = MetricsRegistry()
+                self._metrics_exporter = MetricsExporter(
+                    self._metrics, self.dir)
+            return self._metrics
 
     # -- event stream ---------------------------------------------------
 
@@ -277,6 +298,10 @@ class Recorder:
                 return
             self._closed = True
         monitor.unsubscribe(self._mon_cb)
+        if self._metrics_exporter is not None:
+            # final cumulative snapshot: even a run closed before the
+            # first periodic tick leaves one metrics.jsonl line
+            self._metrics_exporter.stop()
         self.manifest.update(
             t_end=time.time(),
             wall_s=round(time.perf_counter() - self._perf0, 6),

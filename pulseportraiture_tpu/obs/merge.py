@@ -51,12 +51,14 @@ def write_shard(run_dir, shards_dir, proc):
         with open(src, "rb") as sf, open(dst, "wb") as df:
             df.write(sf.read())
         written.append(dst)
-    man_src = os.path.join(run_dir, "manifest.json")
-    if os.path.isfile(man_src):
-        dst = os.path.join(shards_dir, "manifest.%d.json" % proc)
-        with open(man_src, "rb") as sf, open(dst, "wb") as df:
-            df.write(sf.read())
-        written.append(dst)
+    for base, pattern in (("manifest.json", "manifest.%d.json"),
+                          ("metrics.jsonl", "metrics.%d.jsonl")):
+        src = os.path.join(run_dir, base)
+        if os.path.isfile(src):
+            dst = os.path.join(shards_dir, pattern % proc)
+            with open(src, "rb") as sf, open(dst, "wb") as df:
+                df.write(sf.read())
+            written.append(dst)
     return written
 
 
@@ -134,6 +136,28 @@ def merge_obs_shards(shards_dir, out_dir):
               encoding="utf-8") as fh:
         for ev in merged:
             fh.write(json.dumps(ev) + "\n")
+
+    # metrics snapshots (obs/metrics.py): the LAST parseable snapshot
+    # of every shard's metrics.<proc>.jsonl merges exactly — integer
+    # bucket sums over identical log-bucket edges, shard-order
+    # independent — into one metrics.jsonl line the report's latency
+    # section reads like any single-process run's
+    from . import metrics as _metrics
+
+    shard_snaps = {}
+    for proc in sorted(shards):
+        mpath = os.path.join(shards_dir, "metrics.%d.jsonl" % proc)
+        snaps = [s for s in _read_events(mpath)
+                 if isinstance(s, dict)
+                 and (s.get("histograms") is not None
+                      or s.get("counters") is not None)]
+        if snaps:
+            shard_snaps[proc] = snaps[-1]
+    if shard_snaps:
+        merged_snap = _metrics.merge_snapshots(shard_snaps)
+        with open(os.path.join(out_dir, "metrics.jsonl"), "w",
+                  encoding="utf-8") as fh:
+            fh.write(json.dumps(merged_snap) + "\n")
 
     manifests = {}
     for proc in sorted(shards):

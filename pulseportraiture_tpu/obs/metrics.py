@@ -1,0 +1,745 @@
+"""Streaming metrics: counters, gauges, log-bucketed latency histograms.
+
+The event stream (:mod:`.core`) answers "what happened"; this module
+answers "how is it doing *right now*" — the live telemetry plane the
+TOA service (docs/SERVICE.md) and the survey runner are operated and
+SLO-gated on:
+
+* **Counters / gauges with labels** — monotonically increasing totals
+  (``pps_requests_total{outcome="done",tenant="alice"}``) and
+  last-value-wins gauges (``pps_queue_depth{tenant="alice"}``), keyed
+  by a Prometheus-style series string so snapshots render to both JSON
+  and the Prometheus text exposition format without a schema change.
+* **Log-bucketed latency histograms** (HDR-style) — bucket ``i``
+  covers ``[lo·2^(i/per_octave), lo·2^((i+1)/per_octave))``; the
+  boundaries are *fixed by construction* from ``(lo, hi,
+  per_octave)``, so any two histograms of one series merge **exactly**
+  by summing sparse bucket counts — across threads, snapshots, shards
+  and processes, in any order, with the same result
+  (:func:`merge_snapshots`, used by ``obs/merge.py``).  Quantiles are
+  read from the bucket upper edge clamped to the exactly-tracked
+  min/max, so ``quantile(h, q)`` is within one bucket's relative
+  resolution (``2^(1/per_octave) - 1``) of the true percentile — the
+  NumPy-oracle contract tests/test_metrics.py enforces.
+* **Periodic snapshot exporter** — a daemon thread appends the full
+  registry snapshot to ``<run-dir>/metrics.jsonl`` every
+  ``PPTPU_METRICS_INTERVAL`` seconds (default 2.0; 0 disables the
+  thread), plus one final snapshot at recorder close.  Each line is a
+  complete cumulative snapshot, so readers (``tools/obs_report.py``,
+  the ``--watch`` views, ``pploadgen``'s SLO gate) take the **last
+  parseable line** — a crash mid-append leaves a torn tail that is
+  simply skipped, never a corrupted series.
+
+Activation follows the obs run lifecycle: the module-level helpers
+(:func:`inc`, :func:`set_gauge`, :func:`observe`, :func:`timed`)
+no-op at one attribute read + ``None`` check when no run is active —
+the same "disabled = free" contract as ``obs.span`` (the <2% budget in
+``tools/span_overhead.py`` now prices these too).  With a run active
+they record into the run's lazily-created :class:`MetricsRegistry`
+(one per :class:`~.core.Recorder`).
+
+Host-side only, like everything in ``obs``: jaxlint J002 statically
+rejects ``metrics.*`` calls inside ``jax.jit`` — under jit an
+``observe`` would record the trace-time value once and never again.
+"""
+
+import bisect
+import contextlib
+import json
+import math
+import os
+import re
+import threading
+import time
+
+from . import core as _core
+
+__all__ = ["Histogram", "MetricsRegistry", "MetricsExporter",
+           "series_key", "parse_series", "quantile", "percentiles",
+           "inc", "set_gauge", "observe", "timed", "snapshot",
+           "metrics_interval", "render_prometheus", "merge_snapshots",
+           "load_snapshots", "last_snapshot", "latest_run_dir",
+           "evaluate_slo", "render_watch",
+           "PHASE_HISTOGRAM", "SNAPSHOT_SCHEMA"]
+
+SNAPSHOT_SCHEMA = "pptpu-metrics-v1"
+
+# the one histogram family the request/survey lifecycles share; phases
+# are distinguished by the ``phase`` label (docs/OBSERVABILITY.md):
+# service requests: queue_wait / checkout / park / dispatch / fit /
+# checkpoint / total; survey archives: claim / fit / checkpoint /
+# archive
+PHASE_HISTOGRAM = "pps_phase_seconds"
+
+# default bucket geometry: 1 us .. ~4096 s at 8 buckets per octave
+# (~9% relative resolution, 256 buckets); chosen so a socket RTT and a
+# cold multi-minute compile land in the same instrument
+DEFAULT_LO = 1e-6
+DEFAULT_HI = 4096.0
+DEFAULT_PER_OCTAVE = 8
+
+
+def metrics_interval():
+    """$PPTPU_METRICS_INTERVAL: snapshot cadence in seconds (default
+    2.0; 0 / unparsable-as-positive disables the periodic thread — the
+    close-time final snapshot is always written)."""
+    v = os.environ.get("PPTPU_METRICS_INTERVAL", "").strip()
+    try:
+        return max(0.0, float(v)) if v else 2.0
+    except ValueError:
+        return 2.0
+
+
+def series_key(name, labels=None):
+    """Prometheus-style series key: ``name{k="v",...}`` with labels
+    sorted (deterministic across processes), or bare ``name``."""
+    if not labels:
+        return name
+    inner = ",".join('%s="%s"' % (k, labels[k]) for k in sorted(labels))
+    return "%s{%s}" % (name, inner)
+
+
+_SERIES_RE = re.compile(r'^([^{]+)(?:\{(.*)\})?$')
+_LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
+
+
+def parse_series(key):
+    """Inverse of :func:`series_key`: ``(name, {label: value})``."""
+    m = _SERIES_RE.match(key)
+    if not m:
+        return key, {}
+    name, inner = m.group(1), m.group(2)
+    if not inner:
+        return name, {}
+    return name, dict(_LABEL_RE.findall(inner))
+
+
+class Histogram:
+    """Log-bucketed latency histogram with exact deterministic merge.
+
+    Bucket boundaries are a pure function of ``(lo, hi, per_octave)``
+    — precomputed edges, indexed by bisection (no per-observation
+    ``log`` call, no float-rounding ambiguity at the boundaries):
+    ``edges[i] = lo * 2**(i / per_octave)``.  Values below ``lo`` land
+    in ``under``, at/above ``hi`` in ``over``; exact ``count``,
+    ``sum``, ``min`` and ``max`` ride along.
+    """
+
+    __slots__ = ("lo", "hi", "per_octave", "n_buckets", "edges",
+                 "counts", "under", "over", "count", "sum", "min",
+                 "max", "_lock")
+
+    def __init__(self, lo=DEFAULT_LO, hi=DEFAULT_HI,
+                 per_octave=DEFAULT_PER_OCTAVE):
+        if not (lo > 0 and hi > lo and per_octave >= 1):
+            raise ValueError("need 0 < lo < hi and per_octave >= 1")
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.per_octave = int(per_octave)
+        self.n_buckets = int(math.ceil(
+            math.log(self.hi / self.lo, 2.0) * self.per_octave))
+        self.edges = [self.lo * 2.0 ** (i / self.per_octave)
+                      for i in range(self.n_buckets + 1)]
+        self.counts = {}          # sparse: bucket index -> count
+        self.under = 0
+        self.over = 0
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+        self._lock = threading.Lock()
+
+    def bucket_index(self, value):
+        """Bucket index for ``value`` (-1 = under, n_buckets = over)."""
+        v = float(value)
+        if v < self.lo:
+            return -1
+        if v >= self.edges[-1]:
+            return self.n_buckets
+        return bisect.bisect_right(self.edges, v) - 1
+
+    def observe(self, value):
+        v = float(value)
+        if v != v:          # NaN: drop rather than poison the stats
+            return
+        i = self.bucket_index(v)
+        with self._lock:
+            if i < 0:
+                self.under += 1
+            elif i >= self.n_buckets:
+                self.over += 1
+            else:
+                self.counts[i] = self.counts.get(i, 0) + 1
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+
+    def merge(self, other):
+        """Fold ``other`` in; exact (integer bucket sums) and
+        commutative, provided the geometries match."""
+        if (self.lo, self.hi, self.per_octave) != \
+                (other.lo, other.hi, other.per_octave):
+            raise ValueError(
+                "histogram geometry mismatch: (%g,%g,%d) vs (%g,%g,%d)"
+                % (self.lo, self.hi, self.per_octave,
+                   other.lo, other.hi, other.per_octave))
+        with self._lock:
+            for i, c in other.counts.items():
+                i = int(i)
+                self.counts[i] = self.counts.get(i, 0) + int(c)
+            self.under += other.under
+            self.over += other.over
+            self.count += other.count
+            self.sum += other.sum
+            for attr, pick in (("min", min), ("max", max)):
+                ov = getattr(other, attr)
+                if ov is not None:
+                    sv = getattr(self, attr)
+                    setattr(self, attr,
+                            ov if sv is None else pick(sv, ov))
+        return self
+
+    def to_snapshot(self):
+        with self._lock:
+            return {"lo": self.lo, "hi": self.hi,
+                    "per_octave": self.per_octave,
+                    "count": self.count,
+                    "sum": round(self.sum, 9),
+                    "min": self.min, "max": self.max,
+                    "under": self.under, "over": self.over,
+                    "counts": {str(i): c
+                               for i, c in sorted(self.counts.items())}}
+
+    @classmethod
+    def from_snapshot(cls, snap):
+        h = cls(lo=snap.get("lo", DEFAULT_LO),
+                hi=snap.get("hi", DEFAULT_HI),
+                per_octave=snap.get("per_octave", DEFAULT_PER_OCTAVE))
+        h.counts = {int(i): int(c)
+                    for i, c in (snap.get("counts") or {}).items()}
+        h.under = int(snap.get("under", 0))
+        h.over = int(snap.get("over", 0))
+        h.count = int(snap.get("count", 0))
+        h.sum = float(snap.get("sum", 0.0))
+        h.min = snap.get("min")
+        h.max = snap.get("max")
+        return h
+
+    def quantile(self, q):
+        """Value at quantile ``q`` in [0, 1], within one bucket's
+        relative resolution; None on an empty histogram.
+
+        Walks the cumulative counts to the covering bucket and returns
+        its upper edge clamped into the exactly-tracked [min, max], so
+        q=0 is the true min and q=1 the true max.
+        """
+        with self._lock:
+            total = self.count
+            if not total:
+                return None
+            if q <= 0.0:
+                return self.min
+            if q >= 1.0:
+                return self.max
+            rank = q * total
+            cum = self.under
+            if cum >= rank and cum:
+                return self.min if self.min is not None else self.lo
+            val = None
+            for i in sorted(self.counts):
+                cum += self.counts[i]
+                if cum >= rank:
+                    val = self.edges[i + 1]
+                    break
+            if val is None:      # rank beyond all buckets: overflow
+                val = self.max if self.max is not None else self.hi
+            if self.min is not None:
+                val = max(val, self.min)
+            if self.max is not None:
+                val = min(val, self.max)
+            return val
+
+
+def quantile(hist_snapshot, q):
+    """Quantile from a histogram *snapshot dict* (see
+    :meth:`Histogram.to_snapshot`); None when empty/absent."""
+    if not hist_snapshot:
+        return None
+    return Histogram.from_snapshot(hist_snapshot).quantile(q)
+
+
+def percentiles(hist_snapshot, qs=(0.5, 0.9, 0.99)):
+    """{q: value} for a snapshot dict (empty dict when no samples)."""
+    if not hist_snapshot or not hist_snapshot.get("count"):
+        return {}
+    h = Histogram.from_snapshot(hist_snapshot)
+    return {q: h.quantile(q) for q in qs}
+
+
+class MetricsRegistry:
+    """Label-keyed counters, gauges and histograms for one run.
+
+    Series creation takes the registry lock once; increments take only
+    the per-histogram lock (counters/gauges update under the registry
+    lock — they are single dict stores, far from any hot path's
+    budget).  ``snapshot()`` is safe against concurrent writers and
+    returns plain JSON-ready dicts.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = {}
+        self._gauges = {}
+        self._hists = {}
+        self._t0 = time.time()
+        self._seq = 0
+
+    # -- write side -----------------------------------------------------
+
+    def inc(self, name, value=1, **labels):
+        key = series_key(name, labels)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + value
+
+    def set_gauge(self, name, value, **labels):
+        key = series_key(name, labels)
+        with self._lock:
+            self._gauges[key] = value
+
+    def histogram(self, name, lo=DEFAULT_LO, hi=DEFAULT_HI,
+                  per_octave=DEFAULT_PER_OCTAVE, **labels):
+        key = series_key(name, labels)
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = Histogram(
+                    lo=lo, hi=hi, per_octave=per_octave)
+            return h
+
+    def observe(self, name, value, **labels):
+        self.histogram(name, **labels).observe(value)
+
+    # -- read side ------------------------------------------------------
+
+    def snapshot(self):
+        """One cumulative snapshot dict (a ``metrics.jsonl`` line)."""
+        with self._lock:
+            self._seq += 1
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = dict(self._hists)
+            seq = self._seq
+        return {"schema": SNAPSHOT_SCHEMA,
+                "t": round(time.time(), 6),
+                "uptime_s": round(time.time() - self._t0, 6),
+                "seq": seq,
+                "counters": counters,
+                "gauges": gauges,
+                "histograms": {k: h.to_snapshot()
+                               for k, h in sorted(hists.items())}}
+
+
+class MetricsExporter:
+    """Periodic + final snapshot writer for one registry.
+
+    Appends one snapshot line to ``<run_dir>/metrics.jsonl`` every
+    ``interval_s`` (daemon thread; 0 disables it) and once at
+    :meth:`stop`.  Write failures are dropped, never fatal — the
+    ``obs`` "never fatal" contract.
+    """
+
+    def __init__(self, registry, run_dir, interval_s=None):
+        self.registry = registry
+        self.path = os.path.join(run_dir, "metrics.jsonl")
+        self.interval_s = metrics_interval() if interval_s is None \
+            else float(interval_s)
+        self.dropped = 0
+        self._stop = threading.Event()
+        self._thread = None
+        if self.interval_s > 0:
+            self._thread = threading.Thread(
+                target=self._loop, name="pptpu-metrics-exporter",
+                daemon=True)
+            self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            self.write_snapshot()
+
+    def write_snapshot(self):
+        try:
+            line = json.dumps(self.registry.snapshot(),
+                              default=_core._json_default)
+            with open(self.path, "a", encoding="utf-8") as fh:
+                fh.write(line + "\n")
+                fh.flush()
+        except (OSError, TypeError, ValueError):
+            self.dropped += 1
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(2.0)
+            self._thread = None
+        self.write_snapshot()
+
+
+# -- module-level helpers (the instrumented-code API) -------------------
+
+
+def _registry():
+    rec = _core._active
+    if rec is None:
+        return None
+    return rec.metrics_registry()
+
+
+def inc(name, value=1, **labels):
+    """Bump a counter series; no-op when no obs run is active."""
+    reg = _registry()
+    if reg is not None:
+        reg.inc(name, value, **labels)
+
+
+def set_gauge(name, value, **labels):
+    """Set a gauge series (last value wins); no-op when inactive."""
+    reg = _registry()
+    if reg is not None:
+        reg.set_gauge(name, value, **labels)
+
+
+def observe(name, seconds, **labels):
+    """Record one latency observation; no-op when inactive."""
+    reg = _registry()
+    if reg is not None:
+        reg.observe(name, seconds, **labels)
+
+
+@contextlib.contextmanager
+def timed(name, **labels):
+    """Time a with-block into a histogram series; no-op when
+    inactive.  Records on every exit path (including raises) — a
+    failed dispatch's latency is exactly the one an SLO cares about."""
+    reg = _registry()
+    if reg is None:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        reg.observe(name, time.perf_counter() - t0, **labels)
+
+
+def snapshot():
+    """The active run's current snapshot, or None when inactive."""
+    reg = _registry()
+    return None if reg is None else reg.snapshot()
+
+
+# -- rendering ----------------------------------------------------------
+
+
+def _prom_name(key):
+    name, labels = parse_series(key)
+    return name, labels
+
+
+def render_prometheus(snap):
+    """Prometheus text exposition of one snapshot dict.
+
+    Counters/gauges render directly; histograms render as cumulative
+    ``_bucket{le=...}`` series (per-octave edges), ``_sum`` and
+    ``_count`` — scrape-compatible with any Prometheus-style
+    collector without this repo growing a dependency.
+    """
+    if not snap:
+        return ""
+    out = []
+    typed = set()
+
+    def type_line(name, kind):
+        if name not in typed:
+            typed.add(name)
+            out.append("# TYPE %s %s" % (name, kind))
+
+    for key in sorted(snap.get("counters") or {}):
+        name, _ = _prom_name(key)
+        type_line(name, "counter")
+        out.append("%s %s" % (key, (snap["counters"][key])))
+    for key in sorted(snap.get("gauges") or {}):
+        name, _ = _prom_name(key)
+        type_line(name, "gauge")
+        out.append("%s %s" % (key, snap["gauges"][key]))
+    for key in sorted(snap.get("histograms") or {}):
+        h = snap["histograms"][key]
+        name, labels = _prom_name(key)
+        type_line(name, "histogram")
+        edges = Histogram(lo=h.get("lo", DEFAULT_LO),
+                          hi=h.get("hi", DEFAULT_HI),
+                          per_octave=h.get("per_octave",
+                                           DEFAULT_PER_OCTAVE)).edges
+        cum = int(h.get("under", 0))
+        counts = {int(i): int(c)
+                  for i, c in (h.get("counts") or {}).items()}
+        # only edges that close a non-empty bucket, to keep the
+        # exposition proportional to the data, plus +Inf
+        for i in sorted(counts):
+            cum += counts[i]
+            lab = dict(labels)
+            lab["le"] = "%.9g" % edges[i + 1]
+            out.append("%s %d" % (series_key(name + "_bucket", lab),
+                                  cum))
+        lab = dict(labels)
+        lab["le"] = "+Inf"
+        out.append("%s %d" % (series_key(name + "_bucket", lab),
+                              int(h.get("count", 0))))
+        out.append("%s %s" % (series_key(name + "_sum", labels),
+                              h.get("sum", 0.0)))
+        out.append("%s %d" % (series_key(name + "_count", labels),
+                              int(h.get("count", 0))))
+    return "\n".join(out) + ("\n" if out else "")
+
+
+# -- snapshot files -----------------------------------------------------
+
+
+def load_snapshots(run_dir):
+    """Every parseable snapshot of a run's ``metrics.jsonl``, oldest
+    first.  Torn tail lines (crash mid-append) are skipped."""
+    path = os.path.join(run_dir, "metrics.jsonl")
+    out = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    snap = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(snap, dict):
+                    out.append(snap)
+    except OSError:
+        pass
+    return out
+
+
+def last_snapshot(run_dir):
+    """The newest parseable snapshot of a run, or None."""
+    snaps = load_snapshots(run_dir)
+    return snaps[-1] if snaps else None
+
+
+def latest_run_dir(base):
+    """Newest run directory under an obs base dir (mtime order), or
+    ``base`` itself when it already is a run dir; None when nothing
+    qualifies.  The ``--watch`` views poll this instead of replaying
+    ledgers."""
+    if not base:
+        return None
+    for probe in ("metrics.jsonl", "events.jsonl", "manifest.json"):
+        if os.path.isfile(os.path.join(base, probe)):
+            return base
+    try:
+        names = os.listdir(base)
+    except OSError:
+        return None
+    runs = []
+    for name in names:
+        d = os.path.join(base, name)
+        if any(os.path.isfile(os.path.join(d, p))
+               for p in ("metrics.jsonl", "events.jsonl",
+                         "manifest.json")):
+            runs.append(d)
+    return max(runs, key=os.path.getmtime) if runs else None
+
+
+def merge_snapshots(snaps):
+    """Merge per-process snapshots into one (``obs/merge.py`` path).
+
+    ``snaps`` is ``{proc: snapshot}``.  Counters and histograms sum
+    across shards **by identical series key** — histogram merges are
+    integer bucket sums over identical edges, so the result is exact
+    and independent of shard order; gauges keep a ``p<proc>/`` prefix
+    (a queue depth summed across hosts would be a lie).
+    """
+    counters = {}
+    gauges = {}
+    hists = {}
+    t = 0.0
+    for proc in sorted(snaps):
+        s = snaps[proc] or {}
+        t = max(t, float(s.get("t", 0.0) or 0.0))
+        for k, v in (s.get("counters") or {}).items():
+            if isinstance(v, (int, float)):
+                counters[k] = counters.get(k, 0) + v
+        for k, v in (s.get("gauges") or {}).items():
+            gauges["p%s/%s" % (proc, k)] = v
+        for k, h in (s.get("histograms") or {}).items():
+            cur = hists.get(k)
+            if cur is None:
+                hists[k] = Histogram.from_snapshot(h)
+            else:
+                cur.merge(Histogram.from_snapshot(h))
+    return {"schema": SNAPSHOT_SCHEMA,
+            "t": t,
+            "seq": 1,
+            "merged_from": sorted(snaps),
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": {k: h.to_snapshot()
+                           for k, h in sorted(hists.items())}}
+
+
+# -- SLO evaluation (pploadgen gate) ------------------------------------
+
+
+def evaluate_slo(spec, hist_snapshot, n_ok, n_err, wall_s):
+    """Evaluate an SLO spec against a latency-histogram snapshot plus
+    outcome counts; returns ``{"ok", "breaches", "measured"}``.
+
+    Spec fields (all optional — absent means not gated):
+
+    * ``p50_s`` / ``p90_s`` / ``p99_s`` — latency ceilings [s]
+    * ``max_error_rate``      — errors / (ok + errors) ceiling
+    * ``min_throughput_rps``  — ok / wall floor [requests/s]
+    * ``min_requests``        — sample-size floor (guards the gate
+      against vacuously passing on an empty run)
+    """
+    n_ok = int(n_ok)
+    n_err = int(n_err)
+    total = n_ok + n_err
+    wall_s = float(wall_s)
+    measured = {
+        "n_ok": n_ok, "n_err": n_err,
+        "error_rate": round(n_err / total, 6) if total else None,
+        "throughput_rps": round(n_ok / wall_s, 6)
+        if wall_s > 0 else None,
+        "wall_s": round(wall_s, 6),
+    }
+    for q in (0.5, 0.9, 0.99):
+        v = quantile(hist_snapshot, q)
+        measured["p%g_s" % (100 * q)] = None if v is None \
+            else round(v, 6)
+    if hist_snapshot:
+        measured["max_s"] = hist_snapshot.get("max")
+    breaches = []
+
+    def breach(field, got, limit, cmp):
+        breaches.append({"slo": field, "measured": got, "limit": limit,
+                         "detail": "%s %s (limit %s)" % (field, got,
+                                                         cmp + str(
+                                                             limit))})
+
+    for field, mkey in (("p50_s", "p50_s"), ("p90_s", "p90_s"),
+                        ("p99_s", "p99_s")):
+        limit = spec.get(field)
+        if limit is None:
+            continue
+        got = measured.get(mkey)
+        if got is None or got > float(limit):
+            breach(field, got, limit, "<=")
+    if spec.get("max_error_rate") is not None:
+        got = measured["error_rate"]
+        if got is None or got > float(spec["max_error_rate"]):
+            breach("max_error_rate", got, spec["max_error_rate"], "<=")
+    if spec.get("min_throughput_rps") is not None:
+        got = measured["throughput_rps"]
+        if got is None or got < float(spec["min_throughput_rps"]):
+            breach("min_throughput_rps", got,
+                   spec["min_throughput_rps"], ">=")
+    if spec.get("min_requests") is not None \
+            and total < int(spec["min_requests"]):
+        breach("min_requests", total, spec["min_requests"], ">=")
+    return {"ok": not breaches, "breaches": breaches,
+            "measured": measured}
+
+
+# -- watch rendering (pptop-style) --------------------------------------
+
+
+def _fmt_lat(v):
+    if v is None:
+        return "-"
+    if v < 1e-3:
+        return "%.0fus" % (v * 1e6)
+    if v < 1.0:
+        return "%.1fms" % (v * 1e3)
+    return "%.2fs" % v
+
+
+def render_watch(snap, prev=None, title=""):
+    """A terminal dashboard frame from one snapshot (pptop-style).
+
+    ``prev`` (the previous tick's snapshot) turns cumulative counters
+    and histogram counts into per-second rates; per-phase latency
+    p50/p90/p99/max come from the cumulative histograms.  Pure
+    string-building: the ``--watch`` loops own the screen control.
+    """
+    if not snap:
+        return "(no metrics snapshot yet)"
+    lines = []
+    head = "%s  t=%s  seq=%s  uptime=%.1fs" % (
+        title or "metrics", time.strftime(
+            "%H:%M:%S", time.localtime(snap.get("t", 0.0))),
+        snap.get("seq"), float(snap.get("uptime_s", 0.0) or 0.0))
+    lines.append(head.strip())
+    dt = None
+    if prev and snap.get("t") and prev.get("t"):
+        dt = max(1e-9, float(snap["t"]) - float(prev["t"]))
+
+    hists = snap.get("histograms") or {}
+    by_phase = {}
+    for key, h in hists.items():
+        name, labels = parse_series(key)
+        if name != PHASE_HISTOGRAM:
+            continue
+        phase = labels.get("phase", "?")
+        cur = by_phase.get(phase)
+        if cur is None:
+            by_phase[phase] = Histogram.from_snapshot(h)
+        else:
+            cur.merge(Histogram.from_snapshot(h))
+    if by_phase:
+        lines.append("")
+        lines.append("%-12s %8s %8s %9s %9s %9s %9s" %
+                     ("phase", "n", "n/s", "p50", "p90", "p99", "max"))
+        prev_counts = {}
+        if prev:
+            for key, h in (prev.get("histograms") or {}).items():
+                name, labels = parse_series(key)
+                if name == PHASE_HISTOGRAM:
+                    ph = labels.get("phase", "?")
+                    prev_counts[ph] = prev_counts.get(ph, 0) \
+                        + int(h.get("count", 0))
+        for phase in sorted(by_phase):
+            h = by_phase[phase]
+            rate = "-"
+            if dt:
+                rate = "%.2f" % ((h.count - prev_counts.get(phase, 0))
+                                 / dt)
+            lines.append("%-12s %8d %8s %9s %9s %9s %9s" % (
+                phase, h.count, rate,
+                _fmt_lat(h.quantile(0.5)), _fmt_lat(h.quantile(0.9)),
+                _fmt_lat(h.quantile(0.99)), _fmt_lat(h.max)))
+
+    gauges = snap.get("gauges") or {}
+    if gauges:
+        lines.append("")
+        lines.append("gauges: " + "  ".join(
+            "%s=%s" % (k, v) for k, v in sorted(gauges.items())))
+    counters = snap.get("counters") or {}
+    if counters:
+        prev_c = (prev or {}).get("counters") or {}
+        lines.append("")
+        lines.append("counters:")
+        for k in sorted(counters):
+            rate = ""
+            if dt:
+                rate = "  (+%.2f/s)" % ((counters[k]
+                                         - prev_c.get(k, 0)) / dt)
+            lines.append("  %s: %s%s" % (k, counters[k], rate))
+    return "\n".join(lines)

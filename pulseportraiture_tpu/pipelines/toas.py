@@ -20,6 +20,8 @@ import numpy as np
 
 from .. import obs
 from ..config import host_array, host_stats_device, scattering_alpha
+from ..obs import metrics
+from ..obs.metrics import PHASE_HISTOGRAM
 from ..fit.phase_shift import fit_phase_shift
 from ..fit.portrait import (auto_scan_size, bucket_batch_size,
                             fit_portrait_full_batch)
@@ -957,7 +959,9 @@ class GetTOAs:
                     "snr", 0.0, ">=", pass_unflagged=False)
                 blk = [format_toa_line(t) for t in arch_toas]
                 blk.append("C pp_done %s %d" % (datafile, len(blk)))
-                with _checkpoint_lock(checkpoint):
+                with metrics.timed(PHASE_HISTOGRAM,
+                                   phase="checkpoint"), \
+                        _checkpoint_lock(checkpoint):
                     with open(checkpoint, "a") as cf:
                         cf.write("".join(line + "\n" for line in blk))
             ph.done(fit_duration_s=round(fit_duration, 6),
@@ -1359,7 +1363,9 @@ class GetTOAs:
                     "snr", 0.0, ">=", pass_unflagged=False)
                 blk = [format_toa_line(t) for t in arch_toas]
                 blk.append("C pp_done %s %d" % (datafile, len(blk)))
-                with _checkpoint_lock(checkpoint):
+                with metrics.timed(PHASE_HISTOGRAM,
+                                   phase="checkpoint"), \
+                        _checkpoint_lock(checkpoint):
                     with open(checkpoint, "a") as cf:
                         cf.write("".join(line + "\n" for line in blk))
             ph.done(fit_duration_s=round(fit_duration, 6), n_toas=M,

@@ -50,7 +50,9 @@ import time
 import numpy as np
 
 from .. import obs
+from ..obs import metrics
 from ..obs.merge import merge_obs_shards, write_shard
+from ..obs.metrics import PHASE_HISTOGRAM
 from ..pipelines.toas import (GetTOAs, _resume_checkpoint,
                               drop_checkpoint_blocks)
 from .plan import SurveyPlan, pad_databunch
@@ -723,6 +725,8 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                         # the last refresh, and a claim layered on top
                         # of an unseen ``done`` would win the (t, owner)
                         # order and refit it
+                        blabel = "%dx%d" % bucket.key
+                        t_arch0 = time.perf_counter()
                         queue.refresh()
                         if queue.state(info.path) in \
                                 (DONE, QUARANTINED) \
@@ -770,6 +774,11 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                                   blocks_scrubbed=n_scrubbed or None,
                                   attempts=claim.get("attempts", 0))
                         obs.counter("leases_claimed")
+                        # claim latency: union refresh + ledger append
+                        # + takeover scrub for this archive
+                        metrics.observe(PHASE_HISTOGRAM,
+                                        time.perf_counter() - t_arch0,
+                                        phase="claim", bucket=blabel)
                         # -- bucketed fit ----------------------------
                         gt = gts.get(bucket.key)
                         if gt is None:
@@ -791,10 +800,17 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                         hold = hb.hold(info.path) if hb is not None \
                             else contextlib.nullcontext()
                         with hold:
-                            _, gt_poisoned = _fit_one_guarded(
-                                gt, queue, info, paths["checkpoint"],
-                                padded, get_toas_kw, quiet, watchdog_s,
-                                narrowband=narrowband)
+                            with metrics.timed(PHASE_HISTOGRAM,
+                                               phase="fit",
+                                               bucket=blabel):
+                                _, gt_poisoned = _fit_one_guarded(
+                                    gt, queue, info,
+                                    paths["checkpoint"], padded,
+                                    get_toas_kw, quiet, watchdog_s,
+                                    narrowband=narrowband)
+                        metrics.observe(PHASE_HISTOGRAM,
+                                        time.perf_counter() - t_arch0,
+                                        phase="archive", bucket=blabel)
                         if gt_poisoned:
                             # the abandoned worker may still touch this
                             # instance; retries get a fresh one
@@ -805,6 +821,8 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                                 and n_fit >= max_archives:
                             stop = True
                     outstanding = queue.outstanding()
+                    metrics.set_gauge("pps_outstanding",
+                                      len(outstanding))
                     if stop or drain["sig"] or not outstanding:
                         break
                     if ran:

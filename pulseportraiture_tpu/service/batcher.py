@@ -41,10 +41,13 @@ the jit boundary (jaxlint J002 covers the ``service.*`` surface).
 """
 
 import threading
+import time
 
 import numpy as np
 
 from .. import obs
+from ..obs import metrics
+from ..obs.metrics import PHASE_HISTOGRAM
 
 __all__ = ["MicroBatcher"]
 
@@ -74,7 +77,7 @@ def _static_key(kw):
 class _Parked:
     """One worker's fit call waiting for the cycle's leader."""
 
-    __slots__ = ("args", "kw", "n", "event", "result", "error")
+    __slots__ = ("args", "kw", "n", "event", "result", "error", "t0")
 
     def __init__(self, args, kw):
         self.args = args
@@ -83,6 +86,7 @@ class _Parked:
         self.event = threading.Event()
         self.result = None
         self.error = None
+        self.t0 = time.perf_counter()  # park time (metrics)
 
 
 class MicroBatcher:
@@ -177,7 +181,10 @@ class MicroBatcher:
         fit = self._resolve_fit()
         self.n_dispatches += 1
         self._emit(1, slot.n)
-        return fit(*slot.args, **self._sized_kw(slot.kw, slot.n))
+        with metrics.timed(PHASE_HISTOGRAM, phase="dispatch",
+                           bucket="-" if self.bucket is None
+                           else "%dx%d" % self.bucket):
+            return fit(*slot.args, **self._sized_kw(slot.kw, slot.n))
 
     def _sized_kw(self, kw, total):
         """Recompute the batch-shaping knobs for the (possibly
@@ -210,6 +217,13 @@ class MicroBatcher:
         self._cond.notify_all()
 
     def _dispatch_group(self, slots):
+        # micro-batch park: how long each call waited for its leader
+        t_fire = time.perf_counter()
+        blabel = "-" if self.bucket is None else "%dx%d" % self.bucket
+        for slot in slots:
+            metrics.observe(PHASE_HISTOGRAM,
+                            max(0.0, t_fire - slot.t0),
+                            phase="park", bucket=blabel)
         if len(slots) == 1:
             slot = slots[0]
             try:
@@ -272,7 +286,10 @@ class MicroBatcher:
         self.n_dispatches += 1
         self.n_coalesced += len(slots)
         self._emit(len(slots), total)
-        out = fit(data, models, init, Ps, freqs, **kw0)
+        with metrics.timed(PHASE_HISTOGRAM, phase="dispatch",
+                           bucket="-" if self.bucket is None
+                           else "%dx%d" % self.bucket):
+            out = fit(data, models, init, Ps, freqs, **kw0)
         out = {k: np.asarray(v) for k, v in dict(out).items()}
         off = 0
         for slot in slots:

@@ -61,6 +61,8 @@ import time
 
 from .. import obs
 from ..io.timfile import format_toa_line
+from ..obs import metrics
+from ..obs.metrics import PHASE_HISTOGRAM
 from ..obs.core import Recorder
 from ..runner.execute import _BucketedGetTOAs, _fit_one
 from ..runner.plan import SurveyPlan, canonical_shape, \
@@ -79,6 +81,11 @@ PENDING = "pending"
 DISPATCHING = "dispatching"
 
 _REQ_SEQ = itertools.count(1)
+
+
+def _blabel(key):
+    """Metrics label for a shape bucket ('-' before classification)."""
+    return "-" if key is None else "%dx%d" % tuple(key)
 
 
 def _env_int(name, default):
@@ -316,6 +323,7 @@ class TOAService:
             self._cond.notify_all()
         obs.event("service_drain")
         obs.counter("service_drains")
+        metrics.set_gauge("pps_draining", 1)
 
     def drained(self, timeout=None):
         """Block until a drain completed; True when it has."""
@@ -388,6 +396,11 @@ class TOAService:
         self._requests[rq.id] = rq
         tenant.fifo.append(rq.id)
         tenant.n_submitted += 1
+        metrics.inc("pps_requests_total", tenant=tenant.name,
+                    outcome="accepted")
+        metrics.set_gauge("pps_queue_depth", len(tenant.fifo),
+                          tenant=tenant.name)
+        metrics.set_gauge("pps_open_requests", len(self._requests))
         self._open_request_recorder(rq)
         self._cond.notify_all()
         return rq
@@ -411,12 +424,16 @@ class TOAService:
         key = WorkQueue.key_for(path)
         with self._lock:
             if self._draining:
+                metrics.inc("pps_requests_total", tenant=tenant,
+                            outcome="rejected_draining")
                 return {"ok": False, "error": "draining"}
             t = self._tenant(tenant)
             state = t.queue.state(key)
             if state in (DONE, QUARANTINED):
                 rec = t.queue.record(key) or {}
                 obs.counter("service_replays")
+                metrics.inc("pps_requests_total", tenant=tenant,
+                            outcome="replayed")
                 return {"ok": True, "request_id": None, "cached": True,
                         "tenant": tenant, "archive": path,
                         "state": state,
@@ -434,6 +451,10 @@ class TOAService:
                     obs.event("service_backpressure", tenant=tenant,
                               archive=path, open=len(t.fifo))
                     obs.counter("service_backpressure_rejections")
+                    metrics.inc("pps_requests_total", tenant=tenant,
+                                outcome="rejected_backpressure")
+                    metrics.inc("pps_backpressure_total",
+                                tenant=tenant)
                     return {"ok": False, "error": "backpressure",
                             "tenant": tenant, "open": len(t.fifo)}
                 rq = self._new_request(t, path, key, config)
@@ -525,6 +546,10 @@ class TOAService:
                     for rq in batch:
                         rq.state = DISPATCHING
                         self._tenants[rq.tenant].inflight += 1
+                    for name in {rq.tenant for rq in batch}:
+                        metrics.set_gauge(
+                            "pps_inflight",
+                            self._tenants[name].inflight, tenant=name)
                     return batch
                 self._cond.wait(timeout=max(0.01,
                                             self.batch_window_s - age))
@@ -567,7 +592,14 @@ class TOAService:
                 t = self._tenants[rq.tenant]
                 claim = t.queue.claim(rq.path)
                 rq.attempts = claim.get("attempts", 0)
+        now = time.time()
         for rq in batch:
+            # queue-wait: submission (or last retry release) to the
+            # cycle that finally claimed the request
+            metrics.observe(PHASE_HISTOGRAM,
+                            max(0.0, now - rq.t_submit),
+                            phase="queue_wait", tenant=rq.tenant,
+                            bucket=_blabel(rq.bucket))
             self._emit_request(rq, "dispatching")
         bucket.batcher.begin(len(batch))
         workers = []
@@ -595,7 +627,11 @@ class TOAService:
 
     def _run_one(self, rq, bucket):
         t = self._tenants[rq.tenant]
+        blabel = _blabel(bucket.key)
+        t0 = time.perf_counter()
         gt = bucket.checkout()
+        metrics.observe(PHASE_HISTOGRAM, time.perf_counter() - t0,
+                        phase="checkout", bucket=blabel)
         gt.fit_batch = bucket.batcher.fit
         kw = dict(self.get_toas_kw)
         kw.update(rq.config or {})
@@ -605,9 +641,11 @@ class TOAService:
         padded = (rq.nchan, rq.nbin) != tuple(bucket.key)
         state = None
         try:
-            state = _fit_one(gt, t.queue, _Info(rq.path), t.checkpoint,
-                             padded, kw, self.quiet,
-                             narrowband=self.narrowband)
+            with metrics.timed(PHASE_HISTOGRAM, phase="fit",
+                               tenant=rq.tenant, bucket=blabel):
+                state = _fit_one(gt, t.queue, _Info(rq.path),
+                                 t.checkpoint, padded, kw, self.quiet,
+                                 narrowband=self.narrowband)
         except Exception as e:  # noqa: BLE001 — total per-request guard
             rec = t.queue.fail(rq.path, "%s: %s" % (type(e).__name__, e))
             state = rec["state"]
@@ -623,6 +661,8 @@ class TOAService:
         with self._lock:
             t = self._tenants[rq.tenant]
             t.inflight = max(0, t.inflight - 1)
+            metrics.set_gauge("pps_inflight", t.inflight,
+                              tenant=rq.tenant)
             rec = t.queue.record(rq.key) or {}
             state = rec.get("state", state)
             rq.attempts = rec.get("attempts", rq.attempts)
@@ -635,6 +675,7 @@ class TOAService:
                 rq.state = PENDING  # failed: backoff, then retried
                 rq.reason = rec.get("reason")
                 obs.counter("service_retries")
+                metrics.inc("pps_retries_total", tenant=rq.tenant)
                 self._emit_request(rq, "retrying")
             self._cond.notify_all()
 
@@ -657,6 +698,15 @@ class TOAService:
             self._done_requests.pop(self._done_order.pop(0), None)
         obs.counter("service_done" if state == DONE
                     else "service_quarantined")
+        metrics.inc("pps_requests_total", tenant=rq.tenant,
+                    outcome=state)
+        metrics.observe(PHASE_HISTOGRAM,
+                        max(0.0, rq.t_done - rq.t_submit),
+                        phase="total", tenant=rq.tenant,
+                        bucket=_blabel(rq.bucket))
+        metrics.set_gauge("pps_queue_depth", len(t.fifo),
+                          tenant=rq.tenant)
+        metrics.set_gauge("pps_open_requests", len(self._requests))
         self._emit_request(rq, "terminal")
         self._close_request_recorder(rq)
         rq.done_evt.set()
@@ -739,6 +789,12 @@ class TOAService:
             obs.counter("service_runs_pruned", n_pruned)
 
     # -- introspection --------------------------------------------------
+
+    def metrics_snapshot(self):
+        """Current streaming-metrics snapshot of the daemon's obs run
+        (obs/metrics.py) — the ``metrics`` socket verb's payload; None
+        when no run is active."""
+        return metrics.snapshot()
 
     def status(self):
         with self._lock:

@@ -15,7 +15,14 @@ Ops (all responses carry ``ok``)::
      "config": {...}, "wait": true, "timeout_s": 300}
     {"op": "wait", "request_id": "r000001", "timeout_s": 300}
     {"op": "status"}
+    {"op": "metrics"}           # live streaming-metrics snapshot
+    {"op": "metrics", "format": "prometheus"}   # + text exposition
     {"op": "shutdown"}          # begins a drain; daemon exits 0 after
+
+The ``metrics`` payload is the daemon run's cumulative snapshot
+(obs/metrics.py): counters, gauges and the request-lifecycle latency
+histograms ``pploadgen``'s SLO gate and the ``ppserve status --watch``
+view are driven by.
 """
 
 import json
@@ -24,6 +31,7 @@ import socket
 import threading
 
 from .. import obs
+from ..obs import metrics as _metrics
 
 __all__ = ["ServiceServer", "client_request", "DEFAULT_SOCKET_NAME"]
 
@@ -131,6 +139,12 @@ class ServiceServer:
                             timeout=req.get("timeout_s"))
         if op == "status":
             return svc.status()
+        if op == "metrics":
+            snap = svc.metrics_snapshot()
+            resp = {"ok": True, "snapshot": snap}
+            if req.get("format") == "prometheus":
+                resp["text"] = _metrics.render_prometheus(snap)
+            return resp
         if op == "shutdown":
             obs.event("service_shutdown_requested", via="socket")
             svc.request_drain()

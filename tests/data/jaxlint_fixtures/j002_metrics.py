@@ -1,0 +1,90 @@
+"""J002 fixtures: streaming-metrics API misuse inside jit.
+
+obs.metrics (the live telemetry plane, docs/OBSERVABILITY.md) is
+host-side by contract: under jit an ``observe()`` records the
+trace-time value once and never again, ``timed()`` times TRACING (the
+body runs once, at trace time), and the registry locks / snapshot
+file IO cannot exist in compiled code.  This corpus proves the
+``metrics.*`` / ``obs.metrics.*`` surface is unreachable inside a jit
+trace without the linter firing.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from pulseportraiture_tpu import obs
+from pulseportraiture_tpu.obs import metrics
+
+
+@jax.jit
+def bad_observe_in_jit(x):
+    metrics.observe("pps_phase_seconds", 0.1, phase="fit")  # EXPECT: J002
+    return x + 1.0
+
+
+@jax.jit
+def bad_timed_in_jit(x):
+    with metrics.timed("pps_phase_seconds", phase="solve"):  # EXPECT: J002
+        y = x * 2.0
+    return y
+
+
+@jax.jit
+def bad_inc_in_jit(x):
+    metrics.inc("pps_requests_total", tenant="t")  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_gauge_in_jit(x):
+    metrics.set_gauge("pps_queue_depth", 3)  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_qualified_in_jit(x):
+    obs.metrics.observe("pps_phase_seconds", 0.1)  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_snapshot_in_jit(x):
+    snap = obs.metrics.snapshot()  # EXPECT: J002
+    return x + len(snap or {})
+
+
+@jax.jit
+def bad_histogram_ctor_in_jit(x):
+    h = metrics.Histogram()  # EXPECT: J002
+    return x + h.count
+
+
+@jax.jit
+def ok_suppressed(x):
+    metrics.inc("pps_probe_total")  # jaxlint: disable=J002
+    return x
+
+
+def ok_host_side(latencies):
+    # outside jit: exactly how the daemon/runner instrument their
+    # claim/fit/checkpoint loops
+    h = metrics.Histogram()
+    for v in latencies:
+        h.observe(v)
+        metrics.observe("pps_phase_seconds", v, phase="fit")
+    return h.quantile(0.99)
+
+
+@jax.jit
+def ok_unrelated_names(x, observe, snapshot):
+    # traced values merely NAMED like the API must not trip the rule
+    return x + observe.sum() + snapshot.mean()
+
+
+def ok_after_boundary(data):
+    # the documented pattern: time around the jit boundary, record
+    # after block_until_ready
+    y = jnp.square(data)
+    jax.block_until_ready(y)
+    metrics.observe("pps_phase_seconds", 0.0, phase="dispatch")
+    return y

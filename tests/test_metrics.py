@@ -1,0 +1,345 @@
+"""Streaming-metrics tests (ISSUE 8, obs/metrics.py).
+
+The tentpole contracts: histogram quantiles within one log-bucket of a
+NumPy percentile oracle with exact bucket-boundary behavior; exact
+deterministic merge across shards/processes through the
+``obs/merge.py`` path (any shard order, same result); torn-tail
+tolerance of ``metrics.jsonl`` after a crash; the disabled-= -free
+no-op contract; Prometheus rendering; SLO evaluation; the run
+lifecycle (lazy registry, periodic exporter, final snapshot at
+close).
+"""
+
+import json
+import math
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu import obs
+from pulseportraiture_tpu.obs import metrics as M
+from pulseportraiture_tpu.obs.merge import merge_obs_shards, \
+    write_shard
+
+RES = 2.0 ** (1.0 / M.DEFAULT_PER_OCTAVE) - 1.0  # bucket resolution
+
+
+# -- histogram correctness ---------------------------------------------
+
+
+@pytest.mark.parametrize("dist", ["lognormal", "uniform", "bimodal"])
+def test_histogram_quantiles_vs_numpy_oracle(dist):
+    rng = np.random.default_rng(7)
+    if dist == "lognormal":
+        vals = rng.lognormal(-3.0, 1.5, 20000)
+    elif dist == "uniform":
+        vals = rng.uniform(1e-4, 2.0, 20000)
+    else:
+        vals = np.concatenate([rng.normal(0.01, 0.001, 10000),
+                               rng.normal(5.0, 0.5, 10000)])
+        vals = np.clip(vals, 1e-6, None)
+    h = M.Histogram()
+    for v in vals:
+        h.observe(v)
+    s = np.sort(vals)
+    n = len(s)
+    for q in (0.1, 0.5, 0.9, 0.99, 0.999):
+        est = h.quantile(q)
+        # the estimator's rank convention: smallest value whose
+        # cumulative count reaches ceil(q*n); its bucket's upper edge
+        # bounds it from above by one bucket width — exact bracketing
+        # against the sorted-sample oracle
+        true = float(s[min(n - 1, max(0, math.ceil(q * n) - 1))])
+        assert true <= est * (1 + 1e-12), (q, est, true)
+        assert est <= true * (1 + RES) + 1e-12, (q, est, true)
+        if dist != "bimodal":
+            # on smooth dense samples the convention gap is far below
+            # bucket width, so plain linear np.percentile agrees too
+            lin = float(np.percentile(vals, 100 * q))
+            assert abs(est - lin) / lin <= 2 * RES + 1e-9, \
+                (q, est, lin)
+    # the exactly-tracked extremes are exact, not bucket-resolved
+    assert h.quantile(0.0) == vals.min()
+    assert h.quantile(1.0) == vals.max()
+    assert h.count == len(vals)
+    assert h.sum == pytest.approx(vals.sum())
+
+
+def test_bucket_boundary_exactness():
+    h = M.Histogram(lo=1e-3, hi=8.0, per_octave=4)
+    # a value AT edge i belongs to bucket i (half-open buckets); one
+    # ulp below belongs to i-1 — no float-log ambiguity at boundaries
+    for i in (0, 1, 5, h.n_buckets - 1):
+        assert h.bucket_index(h.edges[i]) == i
+        below = np.nextafter(h.edges[i], 0.0)
+        assert h.bucket_index(below) == (i - 1 if i else -1)
+    assert h.bucket_index(h.edges[-1]) == h.n_buckets  # overflow
+    assert h.bucket_index(0.0) == -1                   # underflow
+    h.observe(0.0)
+    h.observe(1e9)
+    assert h.under == 1 and h.over == 1 and h.count == 2
+    assert h.quantile(1.0) == 1e9  # overflow reads the exact max
+
+
+def test_nan_observations_dropped():
+    h = M.Histogram()
+    h.observe(float("nan"))
+    assert h.count == 0
+
+
+# -- exact deterministic merge -----------------------------------------
+
+
+def test_merge_exact_and_shard_order_independent():
+    rng = np.random.default_rng(11)
+    vals = rng.lognormal(-2.0, 1.0, 9000)
+    whole = M.Histogram()
+    shards = [M.Histogram() for _ in range(3)]
+    for i, v in enumerate(vals):
+        whole.observe(v)
+        shards[i % 3].observe(v)
+    snaps = [h.to_snapshot() for h in shards]
+    merged_a = M.Histogram.from_snapshot(snaps[0])
+    merged_a.merge(M.Histogram.from_snapshot(snaps[1]))
+    merged_a.merge(M.Histogram.from_snapshot(snaps[2]))
+    merged_b = M.Histogram.from_snapshot(snaps[2])
+    merged_b.merge(M.Histogram.from_snapshot(snaps[0]))
+    merged_b.merge(M.Histogram.from_snapshot(snaps[1]))
+
+    def exact(h):
+        # bucket counts / count / min / max are integer-or-exact and
+        # must be bit-identical in any merge order; the float ``sum``
+        # accumulates in merge order and is compared approximately
+        s = h.to_snapshot()
+        return {k: v for k, v in s.items() if k != "sum"}
+
+    assert exact(merged_a) == exact(merged_b)
+    assert exact(merged_a) == exact(whole)
+    assert merged_a.sum == pytest.approx(whole.sum)
+    assert merged_b.sum == pytest.approx(whole.sum)
+    # quantiles from any merge order are identical (counts drive them)
+    for q in (0.5, 0.99):
+        assert merged_a.quantile(q) == merged_b.quantile(q) \
+            == whole.quantile(q)
+
+
+def test_merge_geometry_mismatch_raises():
+    with pytest.raises(ValueError, match="geometry"):
+        M.Histogram(per_octave=8).merge(M.Histogram(per_octave=4))
+
+
+def test_merge_snapshots_sums_counters_prefixes_gauges():
+    def snap(latency, n):
+        reg = M.MetricsRegistry()
+        for _ in range(n):
+            reg.inc("pps_requests_total", tenant="a", outcome="done")
+            reg.observe("pps_phase_seconds", latency, phase="fit")
+        reg.set_gauge("pps_queue_depth", n, tenant="a")
+        return reg.snapshot()
+
+    merged = M.merge_snapshots({0: snap(0.1, 3), 1: snap(0.5, 2)})
+    key = 'pps_requests_total{outcome="done",tenant="a"}'
+    assert merged["counters"][key] == 5
+    h = merged["histograms"]['pps_phase_seconds{phase="fit"}']
+    assert h["count"] == 5
+    assert merged["gauges"]['p0/pps_queue_depth{tenant="a"}'] == 3
+    assert merged["gauges"]['p1/pps_queue_depth{tenant="a"}'] == 2
+    # shard-order independence at the snapshot level too
+    again = M.merge_snapshots({1: snap(0.5, 2), 0: snap(0.1, 3)})
+    assert again["histograms"] == merged["histograms"]
+    assert again["counters"] == merged["counters"]
+
+
+def _fake_run(tmp_path, name, latencies, n_done):
+    """A closed per-process run dir: one event + one metrics line."""
+    run = tmp_path / name
+    run.mkdir()
+    (run / "events.jsonl").write_text(json.dumps(
+        {"t": 1.0, "kind": "event", "name": "x"}) + "\n")
+    reg = M.MetricsRegistry()
+    for v in latencies:
+        reg.observe("pps_phase_seconds", v, phase="total", tenant="a")
+    for _ in range(n_done):
+        reg.inc("pps_requests_total", tenant="a", outcome="done")
+    (run / "metrics.jsonl").write_text(
+        json.dumps(reg.snapshot()) + "\n")
+    return str(run)
+
+
+def test_merge_obs_shards_carries_metrics(tmp_path):
+    """The obs/merge.py path: per-process metrics.jsonl shards merge
+    into ONE exact snapshot the report reads like a single run's."""
+    r0 = _fake_run(tmp_path, "p0", [0.1, 0.2, 0.4], 3)
+    r1 = _fake_run(tmp_path, "p1", [0.8, 1.6], 2)
+    shards = str(tmp_path / "shards")
+    write_shard(r0, shards, 0)
+    write_shard(r1, shards, 1)
+    out = str(tmp_path / "merged")
+    merge_obs_shards(shards, out)
+    snap = M.last_snapshot(out)
+    assert snap is not None
+    key = 'pps_phase_seconds{phase="total",tenant="a"}'
+    h = snap["histograms"][key]
+    assert h["count"] == 5
+    assert h["min"] == 0.1 and h["max"] == 1.6
+    # exact: equals a direct merge of the five observations
+    direct = M.Histogram()
+    for v in (0.1, 0.2, 0.4, 0.8, 1.6):
+        direct.observe(v)
+    assert h["counts"] == direct.to_snapshot()["counts"]
+    assert snap["counters"][
+        'pps_requests_total{outcome="done",tenant="a"}'] == 5
+    # and the report's latency section renders from the merged run
+    from tools.obs_report import summarize
+
+    text = summarize(out)
+    assert "## latency" in text
+    assert "| total |" in text
+
+
+def test_merge_obs_shards_tolerates_torn_metrics_tail(tmp_path):
+    r0 = _fake_run(tmp_path, "p0", [0.1], 1)
+    # crash mid-append: a second, torn snapshot line
+    with open(os.path.join(r0, "metrics.jsonl"), "a") as fh:
+        fh.write('{"schema": "pptpu-metrics-v1", "counters": {"x')
+    shards = str(tmp_path / "shards")
+    write_shard(r0, shards, 0)
+    out = str(tmp_path / "merged")
+    merge_obs_shards(shards, out)
+    snap = M.last_snapshot(out)
+    assert snap["counters"][
+        'pps_requests_total{outcome="done",tenant="a"}'] == 1
+
+
+# -- snapshot files: torn tails, run lifecycle -------------------------
+
+
+def test_last_snapshot_skips_torn_tail(tmp_path):
+    run = tmp_path / "run"
+    run.mkdir()
+    good = {"schema": M.SNAPSHOT_SCHEMA, "seq": 1,
+            "counters": {"a": 1}, "histograms": {}}
+    with open(run / "metrics.jsonl", "w") as fh:
+        fh.write(json.dumps(good) + "\n")
+        fh.write('{"schema": "pptpu-metrics-v1", "seq": 2, "coun')
+    snap = M.last_snapshot(str(run))
+    assert snap["seq"] == 1 and snap["counters"] == {"a": 1}
+    assert M.last_snapshot(str(tmp_path / "missing")) is None
+
+
+def test_run_lifecycle_writes_final_snapshot(tmp_path, monkeypatch):
+    monkeypatch.delenv("PPTPU_OBS_DIR", raising=False)
+    # no active run: every helper is a no-op
+    assert M.snapshot() is None
+    M.inc("pps_noop_total")
+    M.observe("pps_phase_seconds", 0.1, phase="x")
+    with M.timed("pps_phase_seconds", phase="x"):
+        pass
+    with obs.run("mtest", base_dir=str(tmp_path)) as rec:
+        M.inc("pps_requests_total", tenant="t", outcome="done")
+        M.observe("pps_phase_seconds", 0.25, phase="fit", tenant="t")
+        with M.timed("pps_phase_seconds", phase="total", tenant="t"):
+            time.sleep(0.01)
+        live = M.snapshot()
+        assert live["counters"][
+            'pps_requests_total{outcome="done",tenant="t"}'] == 1
+        run_dir = rec.dir
+    # recorder close wrote the final snapshot
+    snap = M.last_snapshot(run_dir)
+    assert snap is not None
+    h = snap["histograms"]['pps_phase_seconds{phase="total",tenant="t"}']
+    assert h["count"] == 1 and h["min"] >= 0.01
+
+
+def test_exporter_periodic_snapshots(tmp_path):
+    reg = M.MetricsRegistry()
+    exp = M.MetricsExporter(reg, str(tmp_path), interval_s=0.05)
+    try:
+        reg.inc("pps_ticks_total")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if len(M.load_snapshots(str(tmp_path))) >= 2:
+                break
+            time.sleep(0.02)
+    finally:
+        exp.stop()
+    snaps = M.load_snapshots(str(tmp_path))
+    assert len(snaps) >= 3  # >=2 periodic + the final stop() one
+    seqs = [s["seq"] for s in snaps]
+    assert seqs == sorted(seqs)
+    assert snaps[-1]["counters"]["pps_ticks_total"] == 1
+
+
+# -- series keys, rendering, SLO ---------------------------------------
+
+
+def test_series_key_roundtrip_and_label_sorting():
+    key = M.series_key("pps_x", {"b": "2", "a": "1"})
+    assert key == 'pps_x{a="1",b="2"}'
+    assert M.parse_series(key) == ("pps_x", {"a": "1", "b": "2"})
+    assert M.parse_series("bare") == ("bare", {})
+
+
+def test_render_prometheus_cumulative_buckets():
+    reg = M.MetricsRegistry()
+    for v in (0.1, 0.2, 3.0):
+        reg.observe("pps_phase_seconds", v, phase="fit")
+    reg.inc("pps_requests_total", tenant="a")
+    reg.set_gauge("pps_queue_depth", 2)
+    text = M.render_prometheus(reg.snapshot())
+    assert "# TYPE pps_phase_seconds histogram" in text
+    assert "# TYPE pps_requests_total counter" in text
+    assert "# TYPE pps_queue_depth gauge" in text
+    assert 'pps_phase_seconds_bucket{le="+Inf",phase="fit"} 3' in text
+    assert 'pps_phase_seconds_count{phase="fit"} 3' in text
+    # bucket counts are cumulative and end at the total
+    cums = [int(ln.rsplit(" ", 1)[1]) for ln in text.splitlines()
+            if ln.startswith("pps_phase_seconds_bucket")]
+    assert cums == sorted(cums) and cums[-1] == 3
+
+
+def test_evaluate_slo_pass_and_each_breach():
+    h = M.Histogram()
+    for v in (0.1, 0.1, 0.2, 0.4):
+        h.observe(v)
+    snap = h.to_snapshot()
+    ok = M.evaluate_slo({"p50_s": 1.0, "p99_s": 1.0,
+                         "max_error_rate": 0.1,
+                         "min_throughput_rps": 0.01,
+                         "min_requests": 4}, snap, 4, 0, 10.0)
+    assert ok["ok"] and not ok["breaches"]
+    assert ok["measured"]["p50_s"] <= 0.2 * (1 + RES)
+
+    lat = M.evaluate_slo({"p99_s": 0.05}, snap, 4, 0, 10.0)
+    assert not lat["ok"] and lat["breaches"][0]["slo"] == "p99_s"
+    err = M.evaluate_slo({"max_error_rate": 0.1}, snap, 4, 1, 10.0)
+    assert not err["ok"]
+    thr = M.evaluate_slo({"min_throughput_rps": 10.0}, snap, 4, 0,
+                         10.0)
+    assert not thr["ok"]
+    few = M.evaluate_slo({"min_requests": 100}, snap, 4, 0, 10.0)
+    assert not few["ok"]
+    # an empty histogram cannot vacuously pass a latency SLO
+    empty = M.evaluate_slo({"p50_s": 1.0}, None, 0, 0, 1.0)
+    assert not empty["ok"]
+
+
+def test_render_watch_rates_and_phases():
+    reg = M.MetricsRegistry()
+    for v in (0.1, 0.2):
+        reg.observe(M.PHASE_HISTOGRAM, v, phase="fit", tenant="a")
+    reg.inc("pps_requests_total", tenant="a", outcome="done", value=2)
+    s1 = reg.snapshot()
+    for v in (0.3, 0.4):
+        reg.observe(M.PHASE_HISTOGRAM, v, phase="fit", tenant="a")
+    s2 = reg.snapshot()
+    s2["t"] = s1["t"] + 2.0  # deterministic tick spacing
+    frame = M.render_watch(s2, prev=s1, title="t")
+    assert "fit" in frame and "p99" in frame
+    # 2 new observations over 2 s -> 1.00/s
+    row = [ln for ln in frame.splitlines()
+           if ln.startswith("fit")][0]
+    assert " 1.00" in row
+    assert M.render_watch(None) == "(no metrics snapshot yet)"

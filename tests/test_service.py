@@ -529,3 +529,101 @@ def test_socket_server_roundtrip(corpus, tmp_path):
         server.stop()
         svc.shutdown(timeout=60)
     assert not os.path.exists(sock)
+
+
+# -- streaming metrics (ISSUE 8) ----------------------------------------
+
+
+def test_metrics_lifecycle_histograms_and_socket_verb(corpus,
+                                                      tmp_path):
+    """The request lifecycle lands in the streaming-metrics
+    histograms (queue_wait/checkout/park/dispatch/fit/checkpoint/
+    total), the ``metrics`` socket verb serves the snapshot + the
+    Prometheus text exposition, and the closed run's report renders
+    the ``## latency`` section from the final metrics.jsonl
+    snapshot."""
+    from pulseportraiture_tpu.obs import metrics as M
+
+    svc = _service(corpus, tmp_path / "wd", batch_window_s=0.5,
+                   batch_max=4).start()
+    sock = str(tmp_path / "m.sock")
+    server = ServiceServer(svc, sock).start()
+    try:
+        run_dir = obs.current().dir
+        ids = []
+        for tenant, path in zip(["alice", "bob"], corpus.files[:2]):
+            r = svc.submit(tenant, path)
+            assert r["ok"], r
+            ids.append(r["request_id"])
+        for rid in ids:
+            assert svc.wait(rid, timeout=300)["state"] == "done"
+
+        resp = client_request(sock, {"op": "metrics"}, timeout=60)
+        assert resp["ok"], resp
+        snap = resp["snapshot"]
+        phases = {}
+        for key, h in snap["histograms"].items():
+            name, labels = M.parse_series(key)
+            if name == M.PHASE_HISTOGRAM:
+                ph = labels.get("phase")
+                phases[ph] = phases.get(ph, 0) + h["count"]
+        for ph in ("queue_wait", "checkout", "park", "dispatch",
+                   "fit", "checkpoint", "total"):
+            assert phases.get(ph), (ph, phases)
+        assert phases["total"] == 2 and phases["queue_wait"] == 2
+        # per-tenant labeled series exist for the end-to-end phase
+        assert 'pps_phase_seconds{bucket="8x64",phase="total",' \
+               'tenant="alice"}' in snap["histograms"]
+        done = sum(v for k, v in snap["counters"].items()
+                   if k.startswith('pps_requests_total')
+                   and 'outcome="done"' in k)
+        assert done == 2
+        # total >= fit for the same request stream
+        tot = M.quantile(snap["histograms"][
+            'pps_phase_seconds{bucket="8x64",phase="total",'
+            'tenant="alice"}'], 0.5)
+        assert tot and tot > 0.0
+
+        prom = client_request(sock, {"op": "metrics",
+                                     "format": "prometheus"},
+                              timeout=60)["text"]
+        assert "# TYPE pps_phase_seconds histogram" in prom
+        assert "# TYPE pps_requests_total counter" in prom
+        assert 'le="+Inf"' in prom
+    finally:
+        server.stop()
+        assert svc.shutdown(timeout=120)
+
+    # recorder close wrote the final snapshot; the report reads it
+    final = M.last_snapshot(run_dir)
+    assert final is not None
+    assert final["histograms"]
+    from tools.obs_report import summarize
+
+    text = summarize(run_dir)
+    assert "## latency" in text, text
+    assert "| total |" in text and "| fit |" in text, text
+    assert "per-tenant end-to-end" in text, text
+    assert "(per-tenant outcomes from metrics snapshot)" in text, text
+    assert "- tenant alice: done: 1" in text, text
+
+
+def test_metrics_watch_frame_from_daemon_snapshot(corpus, tmp_path):
+    """`ppserve status --watch` path: a frame renders from the live
+    snapshot with per-phase latency rows (the CLI loop is driven by
+    exactly this call chain)."""
+    from pulseportraiture_tpu.obs import metrics as M
+
+    svc = _service(corpus, tmp_path / "wd").start()
+    try:
+        r = svc.submit("alice", corpus.files[2], wait=True,
+                       timeout=300)
+        assert r["state"] == "done", r
+        frame = M.render_watch(svc.metrics_snapshot(),
+                               title="ppserve test")
+        assert "phase" in frame and "p99" in frame
+        assert "fit" in frame and "total" in frame
+        assert 'pps_requests_total{outcome="done",tenant="alice"}: 1' \
+            in frame
+    finally:
+        assert svc.shutdown(timeout=120)

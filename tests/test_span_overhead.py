@@ -20,20 +20,32 @@ if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 
 from tools.span_overhead import (BUDGET_FRACTION, CALLS_PER_ARCHIVE,
+                                 METRICS_CALLS_PER_ARCHIVE,
                                  measure)  # noqa: E402
 
 
 def test_probe_schema_and_sanity():
     out = measure(n=200)
-    for name in ("span", "phases", "event", "fit_telemetry"):
+    for name in ("span", "phases", "event", "fit_telemetry",
+                 "metrics_observe", "metrics_timed", "metrics_inc",
+                 "metrics_gauge"):
         assert out["%s_off_s" % name] > 0.0
         assert out["%s_on_s" % name] > 0.0
     assert out["archive_off_s"] == pytest.approx(
         CALLS_PER_ARCHIVE * out["span_off_s"])
+    assert out["metrics_archive_off_s"] == pytest.approx(
+        METRICS_CALLS_PER_ARCHIVE * out["metrics_observe_off_s"])
+    assert out["hot_fit_off_s"] == pytest.approx(
+        out["archive_off_s"] + out["metrics_archive_off_s"])
     # disabled primitives are nanosecond-scale dict lookups; even a
     # very loaded CI box keeps them under 50 us/call
     assert out["span_off_s"] < 50e-6
     assert out["fit_telemetry_off_s"] < 50e-6
+    # disabled-metrics guard (ISSUE 8): with no obs run active every
+    # metrics primitive is one module-global read + None check
+    assert out["metrics_observe_off_s"] < 50e-6
+    assert out["metrics_timed_off_s"] < 50e-6
+    assert out["metrics_inc_off_s"] < 50e-6
 
 
 @pytest.mark.slow
@@ -71,3 +83,11 @@ def test_disabled_overhead_within_budget():
     # enabled telemetry writes JSON lines; still far below one fit
     assert out["archive_on_s"] < fit_wall, (out["archive_on_s"],
                                             fit_wall)
+    # the hot fit path with streaming metrics layered on (ISSUE 8):
+    # disabled obs+metrics together stay inside the same <2% budget,
+    # and even ENABLED metrics (in-memory histogram updates, no IO
+    # per call) stay inside it
+    assert out["hot_fit_off_s"] < BUDGET_FRACTION * fit_wall, \
+        (out["hot_fit_off_s"], fit_wall)
+    assert out["metrics_archive_on_s"] < BUDGET_FRACTION * fit_wall, \
+        (out["metrics_archive_on_s"], fit_wall)

@@ -27,7 +27,14 @@
 #                 quarantined across 2 tenants, drain exits 0, zero
 #                 post-warm compiles, per-request audit trail
 #                 (docs/SERVICE.md)
-#   8. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
+#   8. loadgen smoke — pploadgen against a real warmed daemon: a
+#                 lenient SLO spec must pass (exit 0) and client/server
+#                 latency histograms must agree within bucket
+#                 resolution; a second daemon under an injected
+#                 dispatch fault must BREACH the SLO gate (nonzero
+#                 exit) — the live-telemetry/SLO plane end to end
+#                 (docs/SERVICE.md, docs/OBSERVABILITY.md)
+#   9. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
 #
 # Exit status is non-zero when any stage fails.
 set -u
@@ -104,6 +111,17 @@ if [ $? -ne 0 ]; then
     fail=1
 else
     tail -1 /tmp/_service_smoke.log
+fi
+
+echo
+echo "== loadgen smoke (pploadgen SLO gate vs warmed daemon, docs/SERVICE.md) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu PPTPU_OBS_DIR="" PPTPU_FAULTS="" \
+    python -m tools.loadgen_smoke >/tmp/_loadgen_smoke.log 2>&1
+if [ $? -ne 0 ]; then
+    tail -40 /tmp/_loadgen_smoke.log
+    fail=1
+else
+    tail -1 /tmp/_loadgen_smoke.log
 fi
 
 echo

@@ -44,6 +44,19 @@ _OBS_API_NAMES = {"span", "phases", "event", "counter", "gauge",
                   "trace_capture"}
 _OBS_BARE_CALLS = {"fit_telemetry", "trace_capture"}
 
+# streaming metrics (pulseportraiture_tpu.obs.metrics): host-side by
+# contract — under jit an observe() would record the trace-time value
+# once and never again, a timed() block would time TRACING, and the
+# registry/exporter locks and file IO cannot exist in compiled code.
+# Matched as ``metrics.<name>`` / ``obs.metrics.<name>`` (bare names
+# like ``observe``/``snapshot`` are too generic to match unqualified).
+_METRICS_API_NAMES = {"inc", "set_gauge", "observe", "timed",
+                      "snapshot", "render_prometheus", "render_watch",
+                      "evaluate_slo", "merge_snapshots",
+                      "load_snapshots", "last_snapshot", "quantile",
+                      "percentiles", "Histogram", "MetricsRegistry",
+                      "MetricsExporter"}
+
 # obs.devtime (profiler-capture ingestion): host-side FILE PARSING by
 # contract — inside jit it would read gigabyte traces at trace time
 # and its result could never feed compiled code.  Matched as
@@ -379,6 +392,19 @@ class RuleVisitor(ast.NodeVisitor):
                           "once, at trace time) and fit telemetry "
                           "would sync a traced value; move it after "
                           "the jit boundary (docs/OBSERVABILITY.md)")
+            elif fname is not None and (
+                    fname.rsplit(".", 1)[-1] in _METRICS_API_NAMES
+                    and fname.startswith(("metrics.",
+                                          "obs.metrics."))):
+                self._add("J002", node,
+                          "obs.metrics call inside a jitted function "
+                          "— streaming metrics are host-side by "
+                          "contract: under jit an observe() records "
+                          "the trace-time value once, a timed() block "
+                          "times tracing, and the registry locks / "
+                          "snapshot IO cannot exist in compiled code; "
+                          "record after the jit boundary "
+                          "(docs/OBSERVABILITY.md)")
             elif fname is not None and (
                     fname.rsplit(".", 1)[-1] in _DEVTIME_API_NAMES
                     and (fname in _DEVTIME_API_NAMES

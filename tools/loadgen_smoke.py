@@ -1,0 +1,222 @@
+"""Loadgen smoke gate: pploadgen against a real warmed ppserve daemon
+must pass a lenient SLO (exit 0), and must FAIL the gate (exit
+nonzero) when an injected ``dispatch`` fault drives the error rate up
+— wired into tools/check.sh (ISSUE 8 acceptance).
+
+Stage A (clean, warmed):
+
+* a daemon subprocess starts with ``--warm`` over a one-bucket plan
+  (no faults), ``pploadgen`` runs a closed-loop schedule of fresh
+  spooled copies with a lenient SLO spec → exit 0;
+* the daemon's streaming-metrics snapshot must hold the request
+  lifecycle phases, its per-phase ``total`` p50/p99 must match the
+  loadgen's client-side measurements within histogram bucket
+  resolution (plus socket overhead), and ``tools/obs_report.py`` must
+  render the ``## latency`` section from the same snapshot;
+* ``ppserve status --watch --ticks 2`` renders live frames from the
+  ``metrics`` socket verb.
+
+Stage B (chaos):
+
+* a second daemon starts with ``PPTPU_FAULTS="site:dispatch@1.0"``
+  and ``--max_attempts 1`` — every dispatch faults, every request
+  quarantines — and the same pploadgen invocation with an error-rate
+  SLO must exit **nonzero**: the gate actually gates.
+
+Run:  env JAX_PLATFORMS=cpu python -m tools.loadgen_smoke
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+LENIENT_SLO = json.dumps({"p50_s": 120.0, "p99_s": 300.0,
+                          "max_error_rate": 0.0,
+                          "min_throughput_rps": 0.001,
+                          "min_requests": 4})
+CHAOS_SLO = json.dumps({"max_error_rate": 0.2, "min_requests": 2})
+
+
+def _wait_ready(proc, timeout=420.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise AssertionError(
+                "daemon exited before ready: rc=%s" % proc.poll())
+        line = line.decode("utf-8", "replace").strip()
+        if line.startswith("PPSERVE_READY "):
+            return json.loads(line[len("PPSERVE_READY "):])
+    raise AssertionError("daemon never became ready")
+
+
+def _start_daemon(wd, gm, plan_path, warm, faults=None,
+                  max_attempts=3):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PPTPU_FAULTS"] = faults or ""
+    env["PPTPU_METRICS_INTERVAL"] = "0.5"
+    cmd = [sys.executable, "-m", "pulseportraiture_tpu.cli.ppserve",
+           "start", "-w", wd, "-m", gm, "--plan", plan_path,
+           "--window", "0.2", "--batch", "2", "--backoff", "0",
+           "--max_attempts", str(max_attempts), "--no_bary",
+           "--quiet"]
+    if warm:
+        cmd.append("--warm")
+    proc = subprocess.Popen(cmd, env=env, cwd=os.getcwd(),
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
+    return proc, _wait_ready(proc)
+
+
+def _shutdown(sock, proc):
+    from pulseportraiture_tpu.service import client_request
+
+    try:
+        client_request(sock, {"op": "shutdown"}, timeout=30.0)
+    except (OSError, ValueError):
+        pass
+    try:
+        return proc.wait(timeout=300)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def main():
+    workroot = tempfile.mkdtemp(prefix="pptpu_loadgen_smoke_")
+    procs = []
+    try:
+        from pulseportraiture_tpu.cli.pploadgen import main as lg_main
+        from pulseportraiture_tpu.cli.ppserve import main as serve_main
+        from pulseportraiture_tpu.io.archive import make_fake_pulsar
+        from pulseportraiture_tpu.io.gmodel import write_model
+        from pulseportraiture_tpu.obs.metrics import DEFAULT_PER_OCTAVE
+        from pulseportraiture_tpu.runner.plan import plan_survey
+
+        gm = os.path.join(workroot, "lg.gmodel")
+        write_model(gm, "lg", "000", 1500.0,
+                    np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0,
+                              -0.5]),
+                    np.ones(8, int), -4.0, 0, quiet=True)
+        par = os.path.join(workroot, "lg.par")
+        with open(par, "w") as f:
+            f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                    "PEPOCH 56000.0\nDM 30.0\n")
+        sources = []
+        for i in range(2):
+            fits = os.path.join(workroot, "src%d.fits" % i)
+            make_fake_pulsar(gm, par, fits, nsub=2, nchan=8, nbin=64,
+                             nu0=1500.0, bw=800.0, tsub=60.0,
+                             phase=0.03 * (i + 1), dDM=5e-4,
+                             noise_stds=0.01, dedispersed=False,
+                             seed=171 + i, quiet=True)
+            sources.append(fits)
+
+        # -- stage A: warmed daemon, lenient SLO -> exit 0 -----------
+        wd = os.path.join(workroot, "wd_clean")
+        os.makedirs(wd)
+        plan = plan_survey(sources, modelfile=gm)
+        plan_path = os.path.join(wd, "plan.json")
+        plan.save(plan_path)
+        proc, ready = _start_daemon(wd, gm, plan_path, warm=True)
+        procs.append(proc)
+        assert ready["warmed"], ready
+        sock = ready["socket"]
+
+        report_path = os.path.join(workroot, "loadgen_report.json")
+        rc = lg_main(["-w", wd, "--socket", sock, "-t", "alice,bob",
+                      "--archives"] + sources +
+                     ["-n", "4", "--mode", "closed",
+                      "--concurrency", "2", "--seed", "7",
+                      "--timeout", "300", "--slo", LENIENT_SLO,
+                      "--out", report_path, "--quiet"])
+        assert rc == 0, "clean loadgen run breached the lenient SLO"
+        report = json.load(open(report_path))
+        assert report["n_ok"] == 4 and report["n_err"] == 0, report
+        assert report["n_cached"] == 0, \
+            "spooled copies must never replay"
+
+        # client-vs-server latency agreement: the daemon's 'total'
+        # phase p50/p99 within histogram bucket resolution (~9%) of
+        # the client's measurement, plus socket/queue slack
+        server_phases = report["server"]["phases"]
+        for phase in ("queue_wait", "checkout", "park", "dispatch",
+                      "fit", "checkpoint", "total"):
+            assert phase in server_phases, \
+                (phase, sorted(server_phases))
+        res = 2.0 ** (1.0 / DEFAULT_PER_OCTAVE) - 1.0
+        for q in ("p50_s", "p99_s"):
+            client = report["client"][q]
+            server = server_phases["total"][q]
+            tol = 2.0 * res * max(client, server) + 0.25
+            assert abs(client - server) <= tol, \
+                (q, client, server, tol)
+
+        # watch view: 2 frames from the metrics socket verb
+        rc = serve_main(["status", "-w", wd, "--socket", sock,
+                         "--watch", "--ticks", "2",
+                         "--interval", "0.1"])
+        assert rc == 0, "ppserve status --watch failed"
+
+        rc_daemon = _shutdown(sock, proc)
+        assert rc_daemon == 0, (rc_daemon,
+                                proc.stderr.read()[-2000:])
+
+        # the closed daemon run renders the latency section from its
+        # final metrics snapshot
+        from tools.obs_report import summarize
+
+        obs_base = os.path.join(wd, "obs")
+        run = sorted(os.path.join(obs_base, d)
+                     for d in os.listdir(obs_base))[-1]
+        text = summarize(run)
+        assert "## latency" in text, text
+        for phase in ("queue_wait", "dispatch", "fit", "total"):
+            assert "| %s " % phase in text, (phase, text)
+        assert "per-tenant end-to-end" in text, text
+        assert "(per-tenant outcomes from metrics snapshot)" in text, \
+            text
+
+        # -- stage B: injected dispatch fault -> SLO gate fires ------
+        wd2 = os.path.join(workroot, "wd_chaos")
+        os.makedirs(wd2)
+        plan.save(os.path.join(wd2, "plan.json"))
+        proc2, ready2 = _start_daemon(
+            wd2, gm, os.path.join(wd2, "plan.json"), warm=False,
+            faults="site:dispatch@1.0", max_attempts=1)
+        procs.append(proc2)
+        rc = lg_main(["-w", wd2, "--socket", ready2["socket"],
+                      "-t", "alice", "--archives"] + sources +
+                     ["-n", "2", "--mode", "open", "--rate", "4.0",
+                      "--concurrency", "2", "--seed", "11",
+                      "--timeout", "300", "--slo", CHAOS_SLO,
+                      "--quiet"])
+        assert rc != 0, \
+            "loadgen must exit nonzero when the dispatch fault " \
+            "drives the error rate over the SLO"
+        rc_daemon2 = _shutdown(ready2["socket"], proc2)
+        assert rc_daemon2 == 0, rc_daemon2
+
+        print("loadgen smoke OK: lenient SLO passed (4/4 in %.1fs, "
+              "p50 %.3fs / p99 %.3fs, client==server within bucket "
+              "resolution), watch rendered, latency section rendered, "
+              "injected dispatch fault breached the gate"
+              % (report["wall_s"], report["client"]["p50_s"],
+                 report["client"]["p99_s"]))
+        return 0
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

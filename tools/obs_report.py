@@ -17,7 +17,11 @@ i.e. from ingested profiler captures attributed by ``pp_*`` named
 scope — obs/devtime.py), device-time attribution per scope when
 captures exist, fit-quality telemetry aggregated over every batched
 solve (nfeval, reduced chi2, return-code histogram, non-converged
-subints), and the counters/gauges from the closed manifest.
+subints), the ``## latency`` section (per-phase p50/p90/p99/max and a
+per-tenant table from the run's ``metrics.jsonl`` streaming-metrics
+snapshot — obs/metrics.py), the service request audit (per-tenant
+outcomes sourced from the same snapshot when present), and the
+counters/gauges from the closed manifest.
 
 Degenerate runs render rather than raise: a run holding only a
 manifest, a crashed run with a torn manifest, zero archives, or an
@@ -341,31 +345,141 @@ def summarize_robustness(events):
     return "\n".join(lines)
 
 
-def summarize_service(events):
+_LATENCY_PHASE_ORDER = ["queue_wait", "checkout", "park", "dispatch",
+                        "fit", "checkpoint", "total", "claim",
+                        "archive"]
+
+
+def _fmt_lat_s(v):
+    """Latency seconds: sub-ms phases (a checkout, a park) need more
+    digits than %.3f shows."""
+    if v is None:
+        return "-"
+    return "%.6f" % v if v < 0.01 else "%.3f" % v
+
+
+def _latency_phase_key(name):
+    try:
+        return (0, _LATENCY_PHASE_ORDER.index(name))
+    except ValueError:
+        return (1, str(name))
+
+
+def load_metrics_snapshot(run_dir):
+    """Newest streaming-metrics snapshot of a run (metrics.jsonl last
+    parseable line — obs/metrics.py), or None."""
+    from pulseportraiture_tpu.obs import metrics
+
+    return metrics.last_snapshot(run_dir)
+
+
+def summarize_latency(snapshot):
+    """The ``## latency`` section: per-phase p50/p90/p99/max from the
+    run's latency-histogram snapshot (one row per ``phase`` label of
+    the shared ``pps_phase_seconds`` family, merged across
+    tenant/bucket series — exact, the buckets are identical), plus a
+    per-tenant table of end-to-end ``total`` latency."""
+    if not snapshot:
+        return None
+    from pulseportraiture_tpu.obs.metrics import (PHASE_HISTOGRAM,
+                                                  Histogram,
+                                                  parse_series)
+
+    by_phase = {}
+    by_tenant = {}
+    for key, h in (snapshot.get("histograms") or {}).items():
+        name, labels = parse_series(key)
+        if name != PHASE_HISTOGRAM:
+            continue
+        hist = Histogram.from_snapshot(h)
+        phase = labels.get("phase", "?")
+        if phase in by_phase:
+            by_phase[phase].merge(hist)
+        else:
+            by_phase[phase] = hist
+        if phase == "total" and labels.get("tenant"):
+            t = labels["tenant"]
+            if t in by_tenant:
+                by_tenant[t].merge(Histogram.from_snapshot(h))
+            else:
+                by_tenant[t] = Histogram.from_snapshot(h)
+    if not by_phase:
+        return None
+    rows = []
+    for phase in sorted(by_phase, key=_latency_phase_key):
+        h = by_phase[phase]
+        rows.append([phase, h.count,
+                     _fmt_lat_s(h.quantile(0.5)),
+                     _fmt_lat_s(h.quantile(0.9)),
+                     _fmt_lat_s(h.quantile(0.99)),
+                     _fmt_lat_s(h.max)])
+    lines = [_table(["phase", "n", "p50_s", "p90_s", "p99_s", "max_s"],
+                    rows)]
+    if by_tenant:
+        trows = []
+        for tenant in sorted(by_tenant):
+            h = by_tenant[tenant]
+            trows.append([tenant, h.count,
+                          _fmt_lat_s(h.quantile(0.5)),
+                          _fmt_lat_s(h.quantile(0.99)),
+                          _fmt_lat_s(h.max)])
+        lines.append("")
+        lines.append("per-tenant end-to-end (total):")
+        lines.append(_table(["tenant", "n", "p50_s", "p99_s", "max_s"],
+                            trows))
+    return "\n".join(lines)
+
+
+def summarize_service(events, snapshot=None):
     """TOA-service audit trail (docs/SERVICE.md): per-tenant request
     outcomes, the per-request lifecycle tail, micro-batch dispatch
     efficiency, and the warm-up program table — a daemon's report must
-    answer "who asked for what, what happened, and was it warm?"."""
+    answer "who asked for what, what happened, and was it warm?".
+
+    With a metrics ``snapshot`` the per-tenant outcome counts come
+    from the ``pps_requests_total`` counter series (the same snapshots
+    the SLO gate and ``--watch`` read) instead of being recomputed
+    from raw events; the lifecycle tail stays event-sourced (per-
+    request detail is exactly what the event stream is for)."""
     reqs = [e for e in events if e.get("kind") == "event"
             and e.get("name") == "service_request"]
     disp = [e for e in events if e.get("kind") == "event"
             and e.get("name") == "microbatch_dispatch"]
     warm = [e for e in events if e.get("kind") == "event"
             and e.get("name") == "warm_program"]
-    if not reqs and not disp and not warm:
+    tenants = {}
+    src = None
+    if snapshot:
+        from pulseportraiture_tpu.obs.metrics import parse_series
+
+        for key, v in (snapshot.get("counters") or {}).items():
+            name, labels = parse_series(key)
+            if name == "pps_requests_total" and labels.get("tenant") \
+                    and labels.get("outcome") in ("done",
+                                                  "quarantined"):
+                per = tenants.setdefault(labels["tenant"], {})
+                per[labels["outcome"]] = per.get(
+                    labels["outcome"], 0) + int(_num(v))
+        if tenants:
+            src = "metrics snapshot"
+    if not reqs and not disp and not warm and not tenants:
         return None
     lines = []
     terminal = [e for e in reqs if e.get("phase") == "terminal"]
-    if reqs:
-        tenants = {}
+    if not tenants:
         for e in terminal:
             per = tenants.setdefault(e.get("tenant", "?"), {})
             st = e.get("state", "?")
             per[st] = per.get(st, 0) + 1
+        if tenants:
+            src = "events"
+    if tenants:
         for tenant in sorted(tenants):
             lines.append("- tenant %s: %s" % (
                 tenant, "  ".join("%s: %d" % (k, v) for k, v in
                                   sorted(tenants[tenant].items()))))
+        lines.append("(per-tenant outcomes from %s)" % src)
+    if reqs:
         rows = []
         for e in terminal[-20:]:
             rows.append([
@@ -460,7 +574,13 @@ def summarize(run_dir):
         out.append("")
         out.append("## fit telemetry (per-subint convergence)")
         out.append(fits)
-    svc = summarize_service(events)
+    msnap = load_metrics_snapshot(run_dir)
+    lat = summarize_latency(msnap)
+    if lat:
+        out.append("")
+        out.append("## latency (streaming-metrics histograms)")
+        out.append(lat)
+    svc = summarize_service(events, snapshot=msnap)
     if svc:
         out.append("")
         out.append("## service requests")

@@ -25,6 +25,11 @@ import numpy as np
 # per-archive obs call rate of the GetTOAs pipeline: 5 phase spans +
 # 1 archive event + 1 fit-telemetry call (docs/OBSERVABILITY.md)
 CALLS_PER_ARCHIVE = 7
+# streaming-metrics call rate of the hot fit path (obs/metrics.py):
+# the service request lifecycle observes queue_wait / checkout / park
+# / dispatch / fit / total + the checkpoint phase, plus ~2 gauge/
+# counter updates per request (daemon.py instrumentation)
+METRICS_CALLS_PER_ARCHIVE = 9
 BUDGET_FRACTION = 0.02
 
 
@@ -36,9 +41,12 @@ def _time_per_call(fn, n):
 
 
 def measure(n=2000):
-    """Per-call costs [s] of one span, one phases-cycle, one event and
-    one fit-telemetry call, with obs disabled and enabled."""
+    """Per-call costs [s] of one span, one phases-cycle, one event,
+    one fit-telemetry call and the streaming-metrics primitives
+    (obs/metrics.py: observe / timed / inc / gauge), with obs disabled
+    and enabled."""
     from pulseportraiture_tpu import obs
+    from pulseportraiture_tpu.obs import metrics
 
     fit_result = {"nfeval": np.full(8, 12),
                   "red_chi2": np.ones(8),
@@ -60,8 +68,28 @@ def measure(n=2000):
     def one_fit_telemetry():
         obs.fit_telemetry(dict(fit_result), where="probe")
 
+    def one_metrics_observe():
+        metrics.observe("pps_phase_seconds", 0.25, phase="fit",
+                        tenant="probe", bucket="64x256")
+
+    def one_metrics_timed():
+        with metrics.timed("pps_phase_seconds", phase="total",
+                           tenant="probe"):
+            pass
+
+    def one_metrics_inc():
+        metrics.inc("pps_requests_total", tenant="probe",
+                    outcome="done")
+
+    def one_metrics_gauge():
+        metrics.set_gauge("pps_queue_depth", 3, tenant="probe")
+
     probes = {"span": one_span, "phases": one_phases,
-              "event": one_event, "fit_telemetry": one_fit_telemetry}
+              "event": one_event, "fit_telemetry": one_fit_telemetry,
+              "metrics_observe": one_metrics_observe,
+              "metrics_timed": one_metrics_timed,
+              "metrics_inc": one_metrics_inc,
+              "metrics_gauge": one_metrics_gauge}
 
     out = {}
     saved = os.environ.pop("PPTPU_OBS_DIR", None)
@@ -85,6 +113,17 @@ def measure(n=2000):
     out["archive_on_s"] = (
         5 * out["span_on_s"] + out["event_on_s"]
         + out["fit_telemetry_on_s"])
+    # the hot fit path with streaming metrics layered on (ISSUE 8):
+    # the obs rate above + the service/runner lifecycle's metrics rate
+    out["metrics_archive_off_s"] = (
+        METRICS_CALLS_PER_ARCHIVE * out["metrics_observe_off_s"])
+    out["metrics_archive_on_s"] = (
+        7 * out["metrics_observe_on_s"] + out["metrics_inc_on_s"]
+        + out["metrics_gauge_on_s"])
+    out["hot_fit_off_s"] = out["archive_off_s"] \
+        + out["metrics_archive_off_s"]
+    out["hot_fit_on_s"] = out["archive_on_s"] \
+        + out["metrics_archive_on_s"]
     return out
 
 
