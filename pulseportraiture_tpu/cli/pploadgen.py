@@ -32,6 +32,13 @@ callers experienced, socket included) and the daemon's own
 streaming-metrics snapshot (the ``metrics`` socket verb): the
 acceptance contract is that the server's per-phase ``total`` p50/p99
 match the client's within histogram bucket resolution.
+
+Every request is issued inside its own **distributed trace**
+(obs/tracing.py, unless ``--no_trace``): the client submit span — the
+trace root — lands in ``<workdir>/obs_client`` and the trace id rides
+the socket as a W3C ``traceparent``, so a p99 bucket's exemplar in
+either histogram resolves via ``tools/obs_trace.py`` to the full
+client → daemon span tree and its critical path.
 """
 
 import argparse
@@ -87,7 +94,7 @@ def load_slo(spec):
 
 class _Result:
     __slots__ = ("tenant", "archive", "latency_s", "ok", "state",
-                 "error", "cached")
+                 "error", "cached", "trace_id")
 
     def __init__(self, tenant, archive):
         self.tenant = tenant
@@ -97,21 +104,43 @@ class _Result:
         self.state = None
         self.error = None
         self.cached = False
+        self.trace_id = None
 
 
 def _submit_one(socket_path, res, timeout):
+    """Submit one request inside a freshly-minted trace.
+
+    The client-side ``submit`` span is the trace ROOT: it lands in the
+    loadgen's own obs run (``<workdir>/obs_client``) and its id rides
+    the socket protocol as a W3C ``traceparent``, so the daemon-side
+    request span tree hangs off it — ``tools/obs_trace.py`` over both
+    run dirs reconstructs client submit → daemon lifecycle end to end.
+    With no obs run active the span no-ops and no carrier is sent (the
+    daemon then mints its own trace); ids stamped here still feed the
+    client histogram's exemplars either way.
+    """
+    from ..obs import tracing
     from ..service import client_request
 
+    payload = {"op": "submit", "tenant": res.tenant,
+               "archive": res.archive, "wait": True,
+               "timeout_s": timeout}
+    ctx = tracing.mint()
+    res.trace_id = ctx[0]
     t0 = time.perf_counter()
-    try:
-        resp = client_request(
-            socket_path, {"op": "submit", "tenant": res.tenant,
-                          "archive": res.archive, "wait": True,
-                          "timeout_s": timeout},
-            timeout=timeout + 30.0)
-    except (OSError, ValueError) as e:
-        res.error = "%s: %s" % (type(e).__name__, e)
-        return res
+    with tracing.activate(ctx):
+        from .. import obs
+
+        with obs.span("submit", tenant=res.tenant,
+                      archive=os.path.basename(res.archive)):
+            if tracing.current_span_id() is not None:
+                tracing.inject(payload)
+            try:
+                resp = client_request(socket_path, payload,
+                                      timeout=timeout + 30.0)
+            except (OSError, ValueError) as e:
+                res.error = "%s: %s" % (type(e).__name__, e)
+                return res
     res.latency_s = time.perf_counter() - t0
     res.state = resp.get("state")
     res.cached = bool(resp.get("cached"))
@@ -183,7 +212,10 @@ def summarize_load(results, wall_s, server_snapshot=None, slo=None):
     n_ok = n_err = n_cached = 0
     for res in results:
         if res.latency_s is not None:
-            hist.observe(res.latency_s)
+            # the client histogram carries exemplars too: a slow
+            # client-side bucket resolves to its trace without asking
+            # the daemon
+            hist.observe(res.latency_s, exemplar=res.trace_id)
         if res.ok:
             n_ok += 1
         else:
@@ -205,13 +237,15 @@ def summarize_load(results, wall_s, server_snapshot=None, slo=None):
             "p50_s": metrics.quantile(snap, 0.5),
             "p90_s": metrics.quantile(snap, 0.9),
             "p99_s": metrics.quantile(snap, 0.99),
+            "p99_exemplar": metrics.exemplar_for_quantile(snap, 0.99),
             "max_s": snap.get("max"),
             "throughput_rps": round(n_ok / wall_s, 6)
             if wall_s > 0 else None,
         },
         "errors": [{"tenant": r.tenant,
                     "archive": os.path.basename(r.archive),
-                    "state": r.state, "error": r.error}
+                    "state": r.state, "error": r.error,
+                    "trace_id": r.trace_id}
                    for r in results if not r.ok][:20],
         "slo": verdict if slo else None,
         "measured": verdict["measured"],
@@ -283,6 +317,11 @@ def build_parser():
                         "min_requests); breach = nonzero exit.")
     p.add_argument("--out", default=None,
                    help="Write the full JSON report here.")
+    p.add_argument("--no_trace", action="store_true",
+                   help="Skip distributed tracing: no client obs run "
+                        "under <workdir>/obs_client, no traceparent "
+                        "on the wire (the daemon then mints its own "
+                        "trace ids).")
     p.add_argument("--quiet", action="store_true")
     return p
 
@@ -313,10 +352,21 @@ def main(argv=None):
     spool = args.spool or os.path.join(args.workdir, "loadgen_spool")
     requests = build_requests(args.archives, args.requests, tenants,
                               spool, args.seed)
-    results, wall_s = run_load(
-        sock, requests, mode=args.mode, rate=args.rate,
-        concurrency=args.concurrency, seed=args.seed,
-        timeout=args.timeout, quiet=args.quiet)
+    # the client side of the trace: each request's submit span (the
+    # trace root) lands in this run so tools/obs_trace.py can join it
+    # to the daemon's span tree across run dirs
+    import contextlib
+
+    from .. import obs
+
+    client_run = contextlib.nullcontext() if args.no_trace else \
+        obs.run("pploadgen",
+                base_dir=os.path.join(args.workdir, "obs_client"))
+    with client_run:
+        results, wall_s = run_load(
+            sock, requests, mode=args.mode, rate=args.rate,
+            concurrency=args.concurrency, seed=args.seed,
+            timeout=args.timeout, quiet=args.quiet)
     try:
         server_snap = client_request(
             sock, {"op": "metrics"}, timeout=30.0).get("snapshot")

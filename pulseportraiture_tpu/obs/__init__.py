@@ -24,6 +24,11 @@ Layout:
   gauges + log-bucketed latency histograms with exact deterministic
   merge, periodic ``metrics.jsonl`` snapshots, Prometheus text
   rendering, SLO evaluation (``pploadgen``), the ``--watch`` frames
+* :mod:`.tracing`  — distributed tracing: ``trace_id`` / ``span_id``
+  / ``parent_span_id`` on every span and event via a thread-ambient
+  context, ``traceparent`` carriers across processes, span links for
+  batched fan-in; ``tools/obs_trace.py`` rebuilds the span trees and
+  critical paths
 * :mod:`.merge`    — multihost shard merge: per-process
   ``events.<proc>.jsonl`` + ``manifest.<proc>.json`` shards into one
   run (span paths prefixed by process, counters summed)
@@ -33,7 +38,7 @@ contract (jaxlint J002 enforces it statically; ``fit_telemetry``
 additionally passes tracers through untouched at runtime).
 """
 
-from . import devtime, metrics, monitor  # noqa: F401
+from . import devtime, metrics, monitor, tracing  # noqa: F401
 from .core import (Recorder, configure, counter, current, enabled,
                    event, fit_telemetry, gauge, list_event_files,
                    obs_dir, obs_max_bytes, phases, run, scoped_run,
@@ -45,4 +50,4 @@ __all__ = ["Recorder", "configure", "counter", "current", "devtime",
            "enabled", "event", "fit_telemetry", "gauge",
            "list_event_files", "merge_obs_shards", "metrics",
            "obs_dir", "obs_max_bytes", "phases", "run", "scoped_run",
-           "span", "trace_capture", "trace_dir", "monitor"]
+           "span", "trace_capture", "trace_dir", "monitor", "tracing"]

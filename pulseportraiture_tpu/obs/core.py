@@ -50,7 +50,24 @@ _state_lock = threading.Lock()
 _active = None           # the process's active Recorder, or None
 _run_seq = 0             # uniquifies run dirs within one process
 
-_tls = threading.local()  # per-thread span path stack
+_tls = threading.local()  # per-thread span path stack + trace context
+
+
+def _trace_child():
+    """Allocate a child span under the thread's ambient trace context
+    (obs/tracing.py) and install it; returns ``(saved_ctx, fields)`` —
+    ``fields`` is None when no context is ambient.  The caller MUST
+    restore ``_tls.trace = saved_ctx`` on exit.  Kept inline here (not
+    in tracing.py) so the no-context cost is one thread-local read."""
+    ctx = getattr(_tls, "trace", None)
+    if ctx is None:
+        return None, None
+    sid = os.urandom(8).hex()
+    _tls.trace = (ctx[0], sid)
+    fields = {"trace_id": ctx[0], "span_id": sid}
+    if ctx[1] is not None:
+        fields["parent_span_id"] = ctx[1]
+    return ctx, fields
 
 
 def obs_dir():
@@ -434,6 +451,10 @@ def span(name, **attrs):
     sp = _Span(name)
     stack = _span_stack()
     stack.append(sp)
+    # ambient trace context (obs/tracing.py): the span becomes a child
+    # of whatever request/archive trace this thread is working for,
+    # and its own id is ambient for nested spans — zero caller churn
+    saved_ctx, trace_fields = _trace_child()
     t0 = time.perf_counter()
     err = None
     try:
@@ -450,10 +471,14 @@ def span(name, **attrs):
             except Exception:
                 pass
         dur = time.perf_counter() - t0
+        if trace_fields is not None:
+            _tls.trace = saved_ctx
         if stack and stack[-1] is sp:
             stack.pop()
         path = "/".join(s.name for s in stack + [sp])
         fields = dict(attrs)
+        if trace_fields is not None:
+            fields.update(trace_fields)
         if err is not None:
             fields["error"] = err
         rec.emit("span", name=name, path=path, dur_s=round(dur, 6),
@@ -488,6 +513,8 @@ class phases:
         self._t0 = 0.0
         self._extra = {}
         self._block = None
+        self._saved_ctx = None
+        self._trace_fields = None
 
     def enter(self, name, **attrs):
         """Close the current phase (if any) and open ``name``."""
@@ -497,6 +524,9 @@ class phases:
         self._sp = _Span(name)
         self._extra = dict(attrs)
         _span_stack().append(self._sp)
+        # each phase is a child span of the ambient trace context, and
+        # ambient for its own extent (same contract as obs.span)
+        self._saved_ctx, self._trace_fields = _trace_child()
         self._t0 = time.perf_counter()
 
     def block(self, value):
@@ -524,6 +554,10 @@ class phases:
                 pass
             self._block = None
         dur = time.perf_counter() - self._t0
+        trace_fields, self._trace_fields = self._trace_fields, None
+        if trace_fields is not None:
+            _tls.trace = self._saved_ctx
+            self._saved_ctx = None
         stack = _span_stack()
         if sp in stack:
             path = "/".join(s.name for s in stack[:stack.index(sp) + 1])
@@ -534,15 +568,28 @@ class phases:
         if rec is not None:
             fields = dict(self._attrs)
             fields.update(self._extra)
+            if trace_fields is not None:
+                fields.update(trace_fields)
             rec.emit("span", name=sp.name, path=path,
                      dur_s=round(dur, 6), **fields)
         self._extra = {}
 
 
 def event(name, **fields):
-    """One-off JSON event (no duration); no-op when no run is active."""
+    """One-off JSON event (no duration); no-op when no run is active.
+
+    When a trace context is ambient (obs/tracing.py) the event is
+    stamped with ``trace_id`` (+ the enclosing ``span_id``), so the
+    lease/robustness audit events become causally searchable without
+    any caller change.  Explicit fields win over the ambient stamp.
+    """
     rec = _active
     if rec is not None:
+        ctx = getattr(_tls, "trace", None)
+        if ctx is not None:
+            fields.setdefault("trace_id", ctx[0])
+            if ctx[1] is not None:
+                fields.setdefault("span_id", ctx[1])
         rec.emit("event", name=name, **fields)
 
 

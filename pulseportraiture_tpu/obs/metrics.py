@@ -56,11 +56,12 @@ from . import core as _core
 
 __all__ = ["Histogram", "MetricsRegistry", "MetricsExporter",
            "series_key", "parse_series", "quantile", "percentiles",
-           "inc", "set_gauge", "observe", "timed", "snapshot",
-           "metrics_interval", "render_prometheus", "merge_snapshots",
-           "load_snapshots", "last_snapshot", "latest_run_dir",
-           "evaluate_slo", "render_watch",
-           "PHASE_HISTOGRAM", "SNAPSHOT_SCHEMA"]
+           "exemplar_for_quantile", "inc", "set_gauge", "observe",
+           "timed", "snapshot", "metrics_interval",
+           "render_prometheus", "merge_snapshots", "load_snapshots",
+           "last_snapshot", "latest_run_dir", "evaluate_slo",
+           "render_watch", "PHASE_HISTOGRAM", "SNAPSHOT_SCHEMA",
+           "EXEMPLARS_PER_BUCKET"]
 
 SNAPSHOT_SCHEMA = "pptpu-metrics-v1"
 
@@ -77,6 +78,11 @@ PHASE_HISTOGRAM = "pps_phase_seconds"
 DEFAULT_LO = 1e-6
 DEFAULT_HI = 4096.0
 DEFAULT_PER_OCTAVE = 8
+
+# per-bucket exemplar retention (last-K trace ids per bucket): enough
+# to resolve "who was in this p99 bucket" without the snapshot growing
+# with traffic (OpenMetrics exemplars carry one per rendered bucket)
+EXEMPLARS_PER_BUCKET = 4
 
 
 def metrics_interval():
@@ -127,7 +133,7 @@ class Histogram:
 
     __slots__ = ("lo", "hi", "per_octave", "n_buckets", "edges",
                  "counts", "under", "over", "count", "sum", "min",
-                 "max", "_lock")
+                 "max", "exemplars", "_lock")
 
     def __init__(self, lo=DEFAULT_LO, hi=DEFAULT_HI,
                  per_octave=DEFAULT_PER_OCTAVE):
@@ -147,6 +153,11 @@ class Histogram:
         self.sum = 0.0
         self.min = None
         self.max = None
+        # sparse: bucket index -> last-K [{"trace_id", "value"}, ...]
+        # (index n_buckets = the overflow bucket); the distributed-
+        # tracing hook: a quantile's bucket resolves to concrete trace
+        # ids (obs/tracing.py, tools/obs_trace.py)
+        self.exemplars = {}
         self._lock = threading.Lock()
 
     def bucket_index(self, value):
@@ -158,7 +169,10 @@ class Histogram:
             return self.n_buckets
         return bisect.bisect_right(self.edges, v) - 1
 
-    def observe(self, value):
+    def observe(self, value, exemplar=None):
+        """Record one observation; ``exemplar`` (a trace id string)
+        attaches the observation's trace to its bucket, keeping the
+        last ``EXEMPLARS_PER_BUCKET`` per bucket."""
         v = float(value)
         if v != v:          # NaN: drop rather than poison the stats
             return
@@ -170,6 +184,11 @@ class Histogram:
                 self.over += 1
             else:
                 self.counts[i] = self.counts.get(i, 0) + 1
+            if exemplar and i >= 0:
+                ex = self.exemplars.setdefault(min(i, self.n_buckets),
+                                               [])
+                ex.append({"trace_id": str(exemplar), "value": v})
+                del ex[:-EXEMPLARS_PER_BUCKET]
             self.count += 1
             self.sum += v
             self.min = v if self.min is None else min(self.min, v)
@@ -188,6 +207,20 @@ class Histogram:
             for i, c in other.counts.items():
                 i = int(i)
                 self.counts[i] = self.counts.get(i, 0) + int(c)
+            # exemplars survive the merge (the bucket-count merge stays
+            # exact regardless): concatenate per bucket, dedupe by
+            # trace id preserving order, keep the last K — shard order
+            # is fixed by the callers (sorted proc), so the merged
+            # exemplar set is deterministic
+            for i, ex in other.exemplars.items():
+                i = int(i)
+                seen = {}
+                for item in self.exemplars.get(i, []) + list(ex):
+                    tid = item.get("trace_id")
+                    if tid:
+                        seen[tid] = item
+                self.exemplars[i] = \
+                    list(seen.values())[-EXEMPLARS_PER_BUCKET:]
             self.under += other.under
             self.over += other.over
             self.count += other.count
@@ -202,7 +235,7 @@ class Histogram:
 
     def to_snapshot(self):
         with self._lock:
-            return {"lo": self.lo, "hi": self.hi,
+            snap = {"lo": self.lo, "hi": self.hi,
                     "per_octave": self.per_octave,
                     "count": self.count,
                     "sum": round(self.sum, 9),
@@ -210,6 +243,11 @@ class Histogram:
                     "under": self.under, "over": self.over,
                     "counts": {str(i): c
                                for i, c in sorted(self.counts.items())}}
+            if self.exemplars:
+                snap["exemplars"] = {
+                    str(i): [dict(x) for x in ex]
+                    for i, ex in sorted(self.exemplars.items()) if ex}
+            return snap
 
     @classmethod
     def from_snapshot(cls, snap):
@@ -224,6 +262,10 @@ class Histogram:
         h.sum = float(snap.get("sum", 0.0))
         h.min = snap.get("min")
         h.max = snap.get("max")
+        h.exemplars = {int(i): [dict(x) for x in ex
+                                if isinstance(x, dict)]
+                       for i, ex in (snap.get("exemplars")
+                                     or {}).items()}
         return h
 
     def quantile(self, q):
@@ -277,6 +319,39 @@ def percentiles(hist_snapshot, qs=(0.5, 0.9, 0.99)):
     return {q: h.quantile(q) for q in qs}
 
 
+def exemplar_for_quantile(hist_snapshot, q):
+    """The exemplar whose bucket covers quantile ``q`` of a snapshot —
+    the "resolve this p99 to a trace" hook (docs/OBSERVABILITY.md).
+
+    Walks the cumulative counts to q's covering bucket and returns its
+    newest exemplar as ``{"trace_id", "value", "bucket"}``; when that
+    bucket recorded none (exemplars are sampled, counts are exact) the
+    nearest exemplar-carrying bucket wins, preferring slower buckets —
+    for a tail quantile the slower neighbor is the honest stand-in.
+    None when the snapshot carries no exemplars at all.
+    """
+    if not hist_snapshot:
+        return None
+    h = Histogram.from_snapshot(hist_snapshot)
+    if not h.exemplars or not h.count:
+        return None
+    rank = max(0.0, min(1.0, float(q))) * h.count
+    cum = h.under
+    covering = None
+    for i in sorted(h.counts):
+        cum += h.counts[i]
+        if cum >= rank:
+            covering = i
+            break
+    if covering is None:
+        covering = h.n_buckets  # rank beyond all buckets: overflow
+    have = sorted(h.exemplars)
+    best = min(have, key=lambda i: (abs(i - covering), covering - i))
+    ex = dict(h.exemplars[best][-1])
+    ex["bucket"] = best
+    return ex
+
+
 class MetricsRegistry:
     """Label-keyed counters, gauges and histograms for one run.
 
@@ -317,8 +392,9 @@ class MetricsRegistry:
                     lo=lo, hi=hi, per_octave=per_octave)
             return h
 
-    def observe(self, name, value, **labels):
-        self.histogram(name, **labels).observe(value)
+    def observe(self, name, value, exemplar=None, **labels):
+        self.histogram(name, **labels).observe(value,
+                                               exemplar=exemplar)
 
     # -- read side ------------------------------------------------------
 
@@ -409,11 +485,22 @@ def set_gauge(name, value, **labels):
         reg.set_gauge(name, value, **labels)
 
 
-def observe(name, seconds, **labels):
-    """Record one latency observation; no-op when inactive."""
+def _ambient_exemplar():
+    """Ambient trace id (obs/tracing.py) as the default exemplar: one
+    thread-local read, so every observe made while serving a traced
+    request links its bucket to that trace with zero caller churn."""
+    ctx = getattr(_core._tls, "trace", None)
+    return ctx[0] if ctx is not None else None
+
+
+def observe(name, seconds, exemplar=None, **labels):
+    """Record one latency observation; no-op when inactive.  The
+    ambient trace context (if any) rides along as the bucket's
+    exemplar unless the caller passes its own."""
     reg = _registry()
     if reg is not None:
-        reg.observe(name, seconds, **labels)
+        reg.observe(name, seconds,
+                    exemplar=exemplar or _ambient_exemplar(), **labels)
 
 
 @contextlib.contextmanager
@@ -429,7 +516,8 @@ def timed(name, **labels):
     try:
         yield
     finally:
-        reg.observe(name, time.perf_counter() - t0, **labels)
+        reg.observe(name, time.perf_counter() - t0,
+                    exemplar=_ambient_exemplar(), **labels)
 
 
 def snapshot():
@@ -483,18 +571,36 @@ def render_prometheus(snap):
         cum = int(h.get("under", 0))
         counts = {int(i): int(c)
                   for i, c in (h.get("counts") or {}).items()}
+        exemplars = {int(i): ex
+                     for i, ex in (h.get("exemplars") or {}).items()
+                     if ex}
+
+        def exemplar_suffix(i):
+            # OpenMetrics exemplar syntax on the bucket that recorded
+            # it: `# {trace_id="..."} <observed value>` — a scraper
+            # (or a human) jumps from the p99 bucket straight to the
+            # trace (tools/obs_trace.py)
+            ex = exemplars.get(i)
+            if not ex:
+                return ""
+            last = ex[-1]
+            return ' # {trace_id="%s"} %.9g' % (
+                last.get("trace_id", ""), float(last.get("value", 0.0)))
+
         # only edges that close a non-empty bucket, to keep the
         # exposition proportional to the data, plus +Inf
+        n_buckets = len(edges) - 1
         for i in sorted(counts):
             cum += counts[i]
             lab = dict(labels)
             lab["le"] = "%.9g" % edges[i + 1]
-            out.append("%s %d" % (series_key(name + "_bucket", lab),
-                                  cum))
+            out.append("%s %d%s" % (series_key(name + "_bucket", lab),
+                                    cum, exemplar_suffix(i)))
         lab = dict(labels)
         lab["le"] = "+Inf"
-        out.append("%s %d" % (series_key(name + "_bucket", lab),
-                              int(h.get("count", 0))))
+        out.append("%s %d%s" % (series_key(name + "_bucket", lab),
+                                int(h.get("count", 0)),
+                                exemplar_suffix(n_buckets)))
         out.append("%s %s" % (series_key(name + "_sum", labels),
                               h.get("sum", 0.0)))
         out.append("%s %d" % (series_key(name + "_count", labels),

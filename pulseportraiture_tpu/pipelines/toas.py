@@ -20,7 +20,7 @@ import numpy as np
 
 from .. import obs
 from ..config import host_array, host_stats_device, scattering_alpha
-from ..obs import metrics
+from ..obs import metrics, tracing
 from ..obs.metrics import PHASE_HISTOGRAM
 from ..fit.phase_shift import fit_phase_shift
 from ..fit.portrait import (auto_scan_size, bucket_batch_size,
@@ -37,7 +37,7 @@ from ..ops.stats import weighted_mean
 from ..testing import faults
 from ..utils.databunch import DataBunch
 
-__all__ = ["GetTOAs", "drop_checkpoint_blocks"]
+__all__ = ["GetTOAs", "drop_checkpoint_blocks", "checkpoint_traces"]
 
 # Per-checkpoint-file locks: the TOA service (service/daemon.py) runs
 # several requests of one tenant concurrently to micro-batch their
@@ -87,6 +87,35 @@ def _nonfinite_guard(ports, errs_b, weights_b):
     errs_b = np.where(bad, 1.0, errs_b)
     weights_b = np.where(bad, 0.0, weights_b)
     return ports, errs_b, weights_b, bad, n_zap, int(wok.sum())
+
+
+def _trace_marker():
+    """`` trace=<id>`` suffix for the ``pp_done`` marker line when a
+    trace context is ambient (obs/tracing.py) — the checkpoint block
+    then names the trace that produced it, so a replayed or
+    reconciled block is causally auditable.  Both marker parsers
+    tolerate the extra token (``len(tok) >= 4``); pre-trace
+    checkpoints parse unchanged."""
+    tid = tracing.current_trace_id()
+    return " trace=%s" % tid if tid else ""
+
+
+def checkpoint_traces(checkpoint):
+    """{realpath(archive): trace_id} for every marked block of a
+    checkpoint that carries a ``trace=`` token (tools/obs_trace.py's
+    takeover/replay audit)."""
+    out = {}
+    try:
+        with open(checkpoint) as cf:
+            for ln in cf:
+                tok = ln.split()
+                if len(tok) >= 5 and tok[0] == "C" \
+                        and tok[1] == "pp_done" \
+                        and tok[4].startswith("trace="):
+                    out[os.path.realpath(tok[2])] = tok[4][6:]
+    except OSError:
+        pass
+    return out
 
 
 def _resume_checkpoint(checkpoint, quiet=True):
@@ -958,9 +987,11 @@ class GetTOAs:
                      if t.archive == datafile],
                     "snr", 0.0, ">=", pass_unflagged=False)
                 blk = [format_toa_line(t) for t in arch_toas]
-                blk.append("C pp_done %s %d" % (datafile, len(blk)))
+                blk.append("C pp_done %s %d%s"
+                           % (datafile, len(blk), _trace_marker()))
                 with metrics.timed(PHASE_HISTOGRAM,
                                    phase="checkpoint"), \
+                        obs.span("checkpoint", checkpoint=checkpoint), \
                         _checkpoint_lock(checkpoint):
                     with open(checkpoint, "a") as cf:
                         cf.write("".join(line + "\n" for line in blk))
@@ -1362,9 +1393,11 @@ class GetTOAs:
                      if t.archive == datafile],
                     "snr", 0.0, ">=", pass_unflagged=False)
                 blk = [format_toa_line(t) for t in arch_toas]
-                blk.append("C pp_done %s %d" % (datafile, len(blk)))
+                blk.append("C pp_done %s %d%s"
+                           % (datafile, len(blk), _trace_marker()))
                 with metrics.timed(PHASE_HISTOGRAM,
                                    phase="checkpoint"), \
+                        obs.span("checkpoint", checkpoint=checkpoint), \
                         _checkpoint_lock(checkpoint):
                     with open(checkpoint, "a") as cf:
                         cf.write("".join(line + "\n" for line in blk))

@@ -50,7 +50,7 @@ import time
 import numpy as np
 
 from .. import obs
-from ..obs import metrics
+from ..obs import metrics, tracing
 from ..obs.merge import merge_obs_shards, write_shard
 from ..obs.metrics import PHASE_HISTOGRAM
 from ..pipelines.toas import (GetTOAs, _resume_checkpoint,
@@ -436,13 +436,17 @@ def _fit_one_guarded(gt, queue, info, checkpoint, padded, get_toas_kw,
                         narrowband=narrowband), False
     cancelled = threading.Event()
     box = {}
+    # the watchdog worker is a fresh thread: adopt this archive's
+    # ambient trace context so its spans/ledger records stay stamped
+    ctx = tracing.current()
 
     def _work():
         try:
-            box["state"] = _fit_one(gt, queue, info, checkpoint,
-                                    padded, get_toas_kw, quiet,
-                                    cancelled=cancelled,
-                                    narrowband=narrowband)
+            with tracing.activate(ctx):
+                box["state"] = _fit_one(gt, queue, info, checkpoint,
+                                        padded, get_toas_kw, quiet,
+                                        cancelled=cancelled,
+                                        narrowband=narrowband)
         except BaseException as e:
             box["err"] = e
 
@@ -727,90 +731,124 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                         # order and refit it
                         blabel = "%dx%d" % bucket.key
                         t_arch0 = time.perf_counter()
-                        queue.refresh()
-                        if queue.state(info.path) in \
-                                (DONE, QUARANTINED) \
-                                or not queue.ready(info.path):
-                            continue
-                        prev_rec = queue.record(info.path) or {}
-                        was_held = prev_rec.get("state") == RUNNING
-                        claim = queue.claim(info.path)
-                        queue.refresh()
-                        if not queue.owns(info.path):
-                            # double-claim lost: the deterministic
-                            # (t, owner) union order elected the other
-                            # claimant — abandon with NO transition
-                            obs.event("lease_claim_lost",
-                                      archive=info.path, owner=owner,
-                                      winner=(queue.record(info.path)
+                        # each archive's claim->fit->checkpoint runs
+                        # under its own trace (obs/tracing.py): the
+                        # ledger transitions and the .tim pp_done
+                        # marker carry the trace id, and the fit's
+                        # phase spans become children of the root
+                        # "archive" span emitted below
+                        trace_ctx = (tracing.new_trace_id(),
+                                     tracing.new_span_id())
+                        with tracing.activate(trace_ctx):
+                            queue.refresh()
+                            if queue.state(info.path) in \
+                                    (DONE, QUARANTINED) \
+                                    or not queue.ready(info.path):
+                                continue
+                            prev_rec = queue.record(info.path) or {}
+                            was_held = prev_rec.get("state") == RUNNING
+                            claim = queue.claim(info.path)
+                            queue.refresh()
+                            if not queue.owns(info.path):
+                                # double-claim lost: the deterministic
+                                # (t, owner) union order elected the
+                                # other claimant — abandon with NO
+                                # transition
+                                obs.event("lease_claim_lost",
+                                          archive=info.path,
+                                          owner=owner,
+                                          winner=(queue.record(
+                                              info.path)
                                               or {}).get("owner"))
-                            obs.counter("lease_claims_lost")
-                            continue
-                        if was_held:
-                            obs.event(
-                                "lease_expired", archive=info.path,
-                                prev_owner=prev_rec.get("owner"),
-                                lease_expires_at=prev_rec.get(
-                                    "lease_expires_at"))
-                            obs.counter("leases_expired")
-                        takeover = claim.get("takeover_from")
-                        n_scrubbed = 0
-                        if takeover:
-                            ppid = owner_pid(takeover)
-                            if ppid is not None and ppid != pid:
-                                # the previous owner may have died
-                                # between its checkpoint flush and the
-                                # ledger append: scrub its block so
-                                # the refit cannot double-write
-                                n_scrubbed = drop_checkpoint_blocks(
-                                    _ckpt_path(workdir, ppid),
-                                    [info.path])
-                            obs.counter("lease_takeovers")
-                        obs.event("lease_claimed", archive=info.path,
-                                  owner=owner,
-                                  lease_expires_at=claim.get(
-                                      "lease_expires_at"),
-                                  takeover_from=takeover,
-                                  blocks_scrubbed=n_scrubbed or None,
-                                  attempts=claim.get("attempts", 0))
-                        obs.counter("leases_claimed")
-                        # claim latency: union refresh + ledger append
-                        # + takeover scrub for this archive
-                        metrics.observe(PHASE_HISTOGRAM,
-                                        time.perf_counter() - t_arch0,
-                                        phase="claim", bucket=blabel)
-                        # -- bucketed fit ----------------------------
-                        gt = gts.get(bucket.key)
-                        if gt is None:
-                            gt = _BucketedGetTOAs(
-                                [i.path for i, b in ordered
-                                 if b.key == bucket.key],
-                                modelfile, bucket.key, quiet=quiet)
-                            gt.fit_batch = fitter
-                            gts[bucket.key] = gt
-                        if trace_base is not None \
-                                and bucket.key != cur_bucket:
-                            tracer.close()  # stop + ingest prev bucket
-                            tracer = contextlib.ExitStack()
-                            tracer.enter_context(obs.trace_capture(
-                                "bucket_%dx%d" % bucket.key,
-                                base_dir=trace_base))
-                            cur_bucket = bucket.key
-                        padded = (info.nchan, info.nbin) != bucket.key
-                        hold = hb.hold(info.path) if hb is not None \
-                            else contextlib.nullcontext()
-                        with hold:
-                            with metrics.timed(PHASE_HISTOGRAM,
-                                               phase="fit",
-                                               bucket=blabel):
-                                _, gt_poisoned = _fit_one_guarded(
-                                    gt, queue, info,
-                                    paths["checkpoint"], padded,
-                                    get_toas_kw, quiet, watchdog_s,
-                                    narrowband=narrowband)
-                        metrics.observe(PHASE_HISTOGRAM,
-                                        time.perf_counter() - t_arch0,
-                                        phase="archive", bucket=blabel)
+                                obs.counter("lease_claims_lost")
+                                continue
+                            if was_held:
+                                obs.event(
+                                    "lease_expired", archive=info.path,
+                                    prev_owner=prev_rec.get("owner"),
+                                    lease_expires_at=prev_rec.get(
+                                        "lease_expires_at"))
+                                obs.counter("leases_expired")
+                            takeover = claim.get("takeover_from")
+                            n_scrubbed = 0
+                            if takeover:
+                                ppid = owner_pid(takeover)
+                                if ppid is not None and ppid != pid:
+                                    # the previous owner may have died
+                                    # between its checkpoint flush and
+                                    # the ledger append: scrub its
+                                    # block so the refit cannot
+                                    # double-write
+                                    n_scrubbed = drop_checkpoint_blocks(
+                                        _ckpt_path(workdir, ppid),
+                                        [info.path])
+                                obs.counter("lease_takeovers")
+                            obs.event("lease_claimed",
+                                      archive=info.path,
+                                      owner=owner,
+                                      lease_expires_at=claim.get(
+                                          "lease_expires_at"),
+                                      takeover_from=takeover,
+                                      blocks_scrubbed=n_scrubbed
+                                      or None,
+                                      attempts=claim.get("attempts",
+                                                         0))
+                            obs.counter("leases_claimed")
+                            # claim latency: union refresh + ledger
+                            # append + takeover scrub for this archive
+                            claim_s = time.perf_counter() - t_arch0
+                            metrics.observe(PHASE_HISTOGRAM, claim_s,
+                                            phase="claim",
+                                            bucket=blabel)
+                            tracing.emit_span("claim", claim_s,
+                                              archive=info.path)
+                            # -- bucketed fit ------------------------
+                            gt = gts.get(bucket.key)
+                            if gt is None:
+                                gt = _BucketedGetTOAs(
+                                    [i.path for i, b in ordered
+                                     if b.key == bucket.key],
+                                    modelfile, bucket.key, quiet=quiet)
+                                gt.fit_batch = fitter
+                                gts[bucket.key] = gt
+                            if trace_base is not None \
+                                    and bucket.key != cur_bucket:
+                                tracer.close()  # stop + ingest prev
+                                tracer = contextlib.ExitStack()
+                                tracer.enter_context(obs.trace_capture(
+                                    "bucket_%dx%d" % bucket.key,
+                                    base_dir=trace_base))
+                                cur_bucket = bucket.key
+                            padded = (info.nchan,
+                                      info.nbin) != bucket.key
+                            hold = hb.hold(info.path) \
+                                if hb is not None \
+                                else contextlib.nullcontext()
+                            with hold:
+                                with metrics.timed(PHASE_HISTOGRAM,
+                                                   phase="fit",
+                                                   bucket=blabel), \
+                                        obs.span("fit",
+                                                 archive=info.path,
+                                                 bucket=blabel):
+                                    _, gt_poisoned = _fit_one_guarded(
+                                        gt, queue, info,
+                                        paths["checkpoint"], padded,
+                                        get_toas_kw, quiet, watchdog_s,
+                                        narrowband=narrowband)
+                            arch_s = time.perf_counter() - t_arch0
+                            metrics.observe(PHASE_HISTOGRAM, arch_s,
+                                            phase="archive",
+                                            bucket=blabel)
+                            # the root span of this archive's trace:
+                            # children (claim/fit/...) reference its
+                            # pre-allocated id
+                            tracing.emit_span(
+                                "archive", arch_s,
+                                ctx=(trace_ctx[0], None),
+                                span_id=trace_ctx[1],
+                                archive=info.path, bucket=blabel,
+                                owner=owner)
                         if gt_poisoned:
                             # the abandoned worker may still touch this
                             # instance; retries get a fresh one

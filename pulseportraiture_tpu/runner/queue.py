@@ -57,6 +57,7 @@ import re
 import threading
 import time
 
+from ..obs import tracing
 from ..testing import faults
 
 __all__ = ["WorkQueue", "PENDING", "RUNNING", "DONE", "FAILED",
@@ -285,10 +286,16 @@ class WorkQueue:
         # disk, killed process) — nothing is recorded, and the resume
         # path must reconstruct from what IS on disk
         faults.check("ledger_append", key=key)
+        # ambient trace context (obs/tracing.py): ledger transitions
+        # made while serving a traced request/archive carry the trace
+        # id, so lease takeovers and replays are causally auditable
+        trace_id = tracing.current_trace_id()
         with self._iolock:
             self._seq += 1
             rec = {"t": round(time.time(), 6), "archive": key,
                    "state": state, "seq": self._seq}
+            if trace_id is not None:
+                rec["trace"] = trace_id
             if self.owner is not None:
                 rec["owner"] = self.owner
             prev = self.entries.get(key)

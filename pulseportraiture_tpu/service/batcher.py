@@ -46,7 +46,7 @@ import time
 import numpy as np
 
 from .. import obs
-from ..obs import metrics
+from ..obs import metrics, tracing
 from ..obs.metrics import PHASE_HISTOGRAM
 
 __all__ = ["MicroBatcher"]
@@ -77,7 +77,8 @@ def _static_key(kw):
 class _Parked:
     """One worker's fit call waiting for the cycle's leader."""
 
-    __slots__ = ("args", "kw", "n", "event", "result", "error", "t0")
+    __slots__ = ("args", "kw", "n", "event", "result", "error", "t0",
+                 "ctx")
 
     def __init__(self, args, kw):
         self.args = args
@@ -87,6 +88,9 @@ class _Parked:
         self.result = None
         self.error = None
         self.t0 = time.perf_counter()  # park time (metrics)
+        # the parking worker's trace context (obs/tracing.py): the
+        # combined dispatch span links back to every member through it
+        self.ctx = tracing.current()
 
 
 class MicroBatcher:
@@ -181,10 +185,26 @@ class MicroBatcher:
         fit = self._resolve_fit()
         self.n_dispatches += 1
         self._emit(1, slot.n)
+        attrs = self._span_attrs([slot], slot.n)
         with metrics.timed(PHASE_HISTOGRAM, phase="dispatch",
                            bucket="-" if self.bucket is None
-                           else "%dx%d" % self.bucket):
+                           else "%dx%d" % self.bucket), \
+                obs.span("dispatch", **attrs):
             return fit(*slot.args, **self._sized_kw(slot.kw, slot.n))
+
+    def _span_attrs(self, slots, total):
+        """Attrs for the dispatch span: fan-in is first-class — ONE
+        span per device dispatch, carrying a span link to every member
+        call's context (obs/tracing.py), so a combined dispatch is
+        causally reachable from each of the K requests it served."""
+        attrs = {"n_requests": len(slots), "batch": int(total),
+                 "bucket": None if self.bucket is None
+                 else "%dx%d" % self.bucket}
+        links = [tracing.link(s.ctx) for s in slots
+                 if s.ctx is not None]
+        if links:
+            attrs["links"] = links
+        return attrs
 
     def _sized_kw(self, kw, total):
         """Recompute the batch-shaping knobs for the (possibly
@@ -221,9 +241,14 @@ class MicroBatcher:
         t_fire = time.perf_counter()
         blabel = "-" if self.bucket is None else "%dx%d" % self.bucket
         for slot in slots:
-            metrics.observe(PHASE_HISTOGRAM,
-                            max(0.0, t_fire - slot.t0),
-                            phase="park", bucket=blabel)
+            park_s = max(0.0, t_fire - slot.t0)
+            metrics.observe(PHASE_HISTOGRAM, park_s,
+                            phase="park", bucket=blabel,
+                            exemplar=slot.ctx[0] if slot.ctx else None)
+            if slot.ctx is not None:
+                # each member's wait-for-leader, in its own trace
+                tracing.emit_span("park", park_s, ctx=slot.ctx,
+                                  bucket=blabel)
         if len(slots) == 1:
             slot = slots[0]
             try:
@@ -288,7 +313,8 @@ class MicroBatcher:
         self._emit(len(slots), total)
         with metrics.timed(PHASE_HISTOGRAM, phase="dispatch",
                            bucket="-" if self.bucket is None
-                           else "%dx%d" % self.bucket):
+                           else "%dx%d" % self.bucket), \
+                obs.span("dispatch", **self._span_attrs(slots, total)):
             out = fit(data, models, init, Ps, freqs, **kw0)
         out = {k: np.asarray(v) for k, v in dict(out).items()}
         off = 0

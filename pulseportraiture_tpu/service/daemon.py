@@ -61,7 +61,7 @@ import time
 
 from .. import obs
 from ..io.timfile import format_toa_line
-from ..obs import metrics
+from ..obs import metrics, tracing
 from ..obs.metrics import PHASE_HISTOGRAM
 from ..obs.core import Recorder
 from ..runner.execute import _BucketedGetTOAs, _fit_one
@@ -103,7 +103,8 @@ class Request:
     __slots__ = ("id", "tenant", "path", "key", "config", "bucket",
                  "nsub", "nchan", "nbin", "state", "reason", "attempts",
                  "n_toas", "toa_lines", "t_submit", "t_done", "done_evt",
-                 "recorder", "recovered", "batch_id")
+                 "recorder", "recovered", "batch_id", "trace_id",
+                 "parent_span_id", "span_id")
 
     def __init__(self, req_id, tenant, path, key, config):
         self.id = req_id
@@ -124,11 +125,25 @@ class Request:
         self.recorder = None
         self.recovered = False
         self.batch_id = None
+        # causal identity (obs/tracing.py): the trace this request
+        # belongs to (client-minted via the traceparent carrier, or
+        # daemon-minted), the client span it parents on, and the id of
+        # the daemon-side request span every lifecycle child references
+        self.trace_id = None
+        self.parent_span_id = None
+        self.span_id = tracing.new_span_id()
+
+    def ctx(self):
+        """(trace_id, request_span_id): the context lifecycle children
+        parent on."""
+        return (self.trace_id, self.span_id)
 
     def payload(self, cached=False):
         out = {"ok": True, "request_id": self.id, "tenant": self.tenant,
                "archive": self.path, "state": self.state,
                "attempts": self.attempts}
+        if self.trace_id:
+            out["trace_id"] = self.trace_id
         if self.bucket:
             out["bucket"] = "%dx%d" % self.bucket
         if self.reason:
@@ -388,11 +403,19 @@ class TOAService:
             if self._classify(rq):
                 self._emit_request(rq, "recovered")
 
-    def _new_request(self, tenant, path, key, config, recovered=False):
+    def _new_request(self, tenant, path, key, config, recovered=False,
+                     traceparent=None):
         """Register an open request (caller holds the lock)."""
         rq = Request("r%06d" % next(_REQ_SEQ), tenant.name, path, key,
                      config)
         rq.recovered = recovered
+        # join the client's trace (traceparent carrier) or mint a new
+        # one: every accepted request is traceable, client-aware or not
+        ctx = tracing.parse_traceparent(traceparent)
+        if ctx is not None:
+            rq.trace_id, rq.parent_span_id = ctx
+        else:
+            rq.trace_id = tracing.new_trace_id()
         self._requests[rq.id] = rq
         tenant.fifo.append(rq.id)
         tenant.n_submitted += 1
@@ -406,7 +429,7 @@ class TOAService:
         return rq
 
     def submit(self, tenant, archive, config=None, wait=False,
-               timeout=None):
+               timeout=None, traceparent=None):
         """Accept one TOA request; returns the response payload.
 
         Replays: an archive this tenant's ledger already records as
@@ -416,6 +439,12 @@ class TOAService:
         recorded as an immediate quarantine, ``backpressure`` beyond
         the tenant's open-request budget, ``draining`` after a drain
         began.
+
+        ``traceparent`` (W3C carrier string, obs/tracing.py) threads
+        the caller's trace through the whole request lifecycle; without
+        one the daemon mints a trace of its own.  Replays echo the
+        recorded outcome's trace id so a duplicate submission is
+        causally linked to the fit that actually served it.
         """
         if not _TENANT_RE.match(str(tenant or "")):
             return {"ok": False, "error": "bad_tenant",
@@ -434,10 +463,15 @@ class TOAService:
                 obs.counter("service_replays")
                 metrics.inc("pps_requests_total", tenant=tenant,
                             outcome="replayed")
+                obs.event("service_replay", tenant=tenant,
+                          archive=path, state=state,
+                          trace_id=rec.get("trace"),
+                          replay_traceparent=traceparent)
                 return {"ok": True, "request_id": None, "cached": True,
                         "tenant": tenant, "archive": path,
                         "state": state,
                         "n_toas": rec.get("n_toas"),
+                        "trace_id": rec.get("trace"),
                         "reason": rec.get("reason")}
             for rid in t.fifo:
                 rq = self._requests[rid]
@@ -457,7 +491,8 @@ class TOAService:
                                 tenant=tenant)
                     return {"ok": False, "error": "backpressure",
                             "tenant": tenant, "open": len(t.fifo)}
-                rq = self._new_request(t, path, key, config)
+                rq = self._new_request(t, path, key, config,
+                                       traceparent=traceparent)
                 obs.counter("service_requests")
         if rq.bucket is None and not self._classify(rq):
             # header scan failed: quarantined at intake, like the
@@ -477,7 +512,7 @@ class TOAService:
             info = scan_archive_header(rq.path)
         except (OSError, ValueError, KeyError,
                 faults.InjectedFault) as e:
-            with self._lock:
+            with self._lock, tracing.activate(rq.ctx()):
                 t = self._tenants[rq.tenant]
                 if t.queue.state(rq.key) is None:
                     t.queue.add([rq.path])
@@ -486,7 +521,7 @@ class TOAService:
                 self._finalize_locked(rq, QUARANTINED,
                                       "unreadable at intake: %s" % e)
             return False
-        with self._lock:
+        with self._lock, tracing.activate(rq.ctx()):
             rq.nsub, rq.nchan, rq.nbin = info.nsub, info.nchan, info.nbin
             rq.bucket = canonical_shape(info.nchan, info.nbin)
             t = self._tenants[rq.tenant]
@@ -590,16 +625,22 @@ class TOAService:
             for rq in batch:
                 rq.batch_id = batch_id
                 t = self._tenants[rq.tenant]
-                claim = t.queue.claim(rq.path)
+                with tracing.activate(rq.ctx()):
+                    # the ambient context stamps the ledger's running
+                    # record with the trace id (runner/queue.py)
+                    claim = t.queue.claim(rq.path)
                 rq.attempts = claim.get("attempts", 0)
         now = time.time()
         for rq in batch:
             # queue-wait: submission (or last retry release) to the
             # cycle that finally claimed the request
-            metrics.observe(PHASE_HISTOGRAM,
-                            max(0.0, now - rq.t_submit),
+            wait_s = max(0.0, now - rq.t_submit)
+            metrics.observe(PHASE_HISTOGRAM, wait_s,
                             phase="queue_wait", tenant=rq.tenant,
-                            bucket=_blabel(rq.bucket))
+                            bucket=_blabel(rq.bucket),
+                            exemplar=rq.trace_id)
+            tracing.emit_span("queue_wait", wait_s, ctx=rq.ctx(),
+                              request=rq.id, batch=batch_id)
             self._emit_request(rq, "dispatching")
         bucket.batcher.begin(len(batch))
         workers = []
@@ -626,12 +667,21 @@ class TOAService:
             return b
 
     def _run_one(self, rq, bucket):
+        # the worker thread adopts the request's trace context: every
+        # span/event/metric below — including the GetTOAs phase spans
+        # and the batcher's park/dispatch — is causally stamped
+        with tracing.activate(rq.ctx()):
+            self._run_one_traced(rq, bucket)
+
+    def _run_one_traced(self, rq, bucket):
         t = self._tenants[rq.tenant]
         blabel = _blabel(bucket.key)
         t0 = time.perf_counter()
         gt = bucket.checkout()
-        metrics.observe(PHASE_HISTOGRAM, time.perf_counter() - t0,
+        checkout_s = time.perf_counter() - t0
+        metrics.observe(PHASE_HISTOGRAM, checkout_s,
                         phase="checkout", bucket=blabel)
+        tracing.emit_span("checkout", checkout_s, request=rq.id)
         gt.fit_batch = bucket.batcher.fit
         kw = dict(self.get_toas_kw)
         kw.update(rq.config or {})
@@ -642,7 +692,9 @@ class TOAService:
         state = None
         try:
             with metrics.timed(PHASE_HISTOGRAM, phase="fit",
-                               tenant=rq.tenant, bucket=blabel):
+                               tenant=rq.tenant, bucket=blabel), \
+                    obs.span("fit", request=rq.id, tenant=rq.tenant,
+                             bucket=blabel):
                 state = _fit_one(gt, t.queue, _Info(rq.path),
                                  t.checkpoint, padded, kw, self.quiet,
                                  narrowband=self.narrowband)
@@ -700,10 +752,20 @@ class TOAService:
                     else "service_quarantined")
         metrics.inc("pps_requests_total", tenant=rq.tenant,
                     outcome=state)
-        metrics.observe(PHASE_HISTOGRAM,
-                        max(0.0, rq.t_done - rq.t_submit),
+        total_s = max(0.0, rq.t_done - rq.t_submit)
+        metrics.observe(PHASE_HISTOGRAM, total_s,
                         phase="total", tenant=rq.tenant,
-                        bucket=_blabel(rq.bucket))
+                        bucket=_blabel(rq.bucket),
+                        exemplar=rq.trace_id)
+        # the daemon-side request span: the root every lifecycle child
+        # (queue_wait/checkout/fit/...) parents on, itself a child of
+        # the client's submit span when a traceparent arrived
+        tracing.emit_span("request", total_s,
+                          ctx=(rq.trace_id, rq.parent_span_id),
+                          span_id=rq.span_id, request=rq.id,
+                          tenant=rq.tenant, archive=rq.path,
+                          state=state, batch=rq.batch_id,
+                          attempts=rq.attempts)
         metrics.set_gauge("pps_queue_depth", len(t.fifo),
                           tenant=rq.tenant)
         metrics.set_gauge("pps_open_requests", len(self._requests))
@@ -728,7 +790,9 @@ class TOAService:
                       attempts=rq.attempts,
                       bucket=None if rq.bucket is None
                       else "%dx%d" % rq.bucket,
-                      batch=rq.batch_id, reason=rq.reason, **extra)
+                      batch=rq.batch_id, reason=rq.reason,
+                      trace_id=rq.trace_id, span_id=rq.span_id,
+                      **extra)
         if rq.state == DONE:
             fields["n_toas"] = rq.n_toas
         if rq.t_done is not None:

@@ -12,7 +12,8 @@ Ops (all responses carry ``ok``)::
 
     {"op": "ping"}
     {"op": "submit", "tenant": T, "archive": PATH,
-     "config": {...}, "wait": true, "timeout_s": 300}
+     "config": {...}, "wait": true, "timeout_s": 300,
+     "traceparent": "00-<32hex>-<16hex>-01"}   # optional W3C carrier
     {"op": "wait", "request_id": "r000001", "timeout_s": 300}
     {"op": "status"}
     {"op": "metrics"}           # live streaming-metrics snapshot
@@ -133,7 +134,8 @@ class ServiceServer:
             return svc.submit(req.get("tenant"), req.get("archive"),
                               config=req.get("config"),
                               wait=bool(req.get("wait")),
-                              timeout=req.get("timeout_s"))
+                              timeout=req.get("timeout_s"),
+                              traceparent=req.get("traceparent"))
         if op == "wait":
             return svc.wait(req.get("request_id"),
                             timeout=req.get("timeout_s"))

@@ -1,0 +1,94 @@
+"""J002 fixtures: distributed-tracing API misuse inside jit.
+
+obs.tracing (docs/OBSERVABILITY.md "Distributed tracing") is host-side
+by contract: the ambient context is a thread-local read, trace ids are
+host strings, and span emission is file IO.  Under jit a ``current()``
+captures the TRACE-TIME context once and bakes it into every
+execution, and a trace id fed into an array op becomes a traced value
+that can never name the request actually being served.  This corpus
+proves the ``tracing.*`` / ``obs.tracing.*`` surface — and the
+trace-id-as-traced-value hazard — is unreachable inside a jit trace
+without the linter firing.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from pulseportraiture_tpu import obs
+from pulseportraiture_tpu.obs import tracing
+
+
+@jax.jit
+def bad_current_in_jit(x):
+    ctx = tracing.current()  # EXPECT: J002
+    return x + (1.0 if ctx else 0.0)
+
+
+@jax.jit
+def bad_activate_in_jit(x):
+    with tracing.activate(("t" * 32, "s" * 16)):  # EXPECT: J002
+        y = x * 2.0
+    return y
+
+
+@jax.jit
+def bad_emit_span_in_jit(x):
+    tracing.emit_span("dispatch", 0.1)  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_qualified_in_jit(x):
+    tid = obs.tracing.current_trace_id()  # EXPECT: J002
+    return x + len(tid or "")
+
+
+@jax.jit
+def bad_inject_in_jit(x):
+    carrier = tracing.inject({})  # EXPECT: J002
+    return x + len(carrier)
+
+
+@jax.jit
+def bad_trace_id_captured(x, trace_id):
+    # a trace id consumed by an array op inside jit: the id seen at
+    # trace time is burned into the compiled program
+    tag = jnp.asarray(trace_id)  # EXPECT: J002
+    return x + tag
+
+
+@jax.jit
+def bad_span_id_captured(x, span_id):
+    return x * jnp.float64(span_id)  # EXPECT: J002
+
+
+@jax.jit
+def ok_suppressed(x):
+    tracing.current()  # jaxlint: disable=J002
+    return x
+
+
+@jax.jit
+def ok_unrelated_names(x, current, mint):
+    # traced values merely NAMED like the API must not trip the rule
+    return x + current.sum() + mint.mean()
+
+
+def ok_host_side(archive_latency):
+    # outside jit: exactly how the daemon threads context through the
+    # request lifecycle (service/daemon.py)
+    ctx = tracing.mint()
+    with tracing.activate(ctx):
+        carrier = tracing.inject({})
+        tracing.emit_span("queue_wait", archive_latency)
+    return tracing.extract(carrier)
+
+
+def ok_context_around_boundary(data):
+    # the documented pattern: context propagates AROUND the jit
+    # boundary — activate outside, dispatch inside, stamp after
+    with tracing.activate(tracing.mint()):
+        y = jnp.square(data)
+        jax.block_until_ready(y)
+        tracing.emit_span("dispatch", 0.0)
+    return y

@@ -1,0 +1,195 @@
+"""tools/obs_trace.py reconstruction robustness (ISSUE 9 satellite).
+
+Span-tree reconstruction must be independent of shard order and file
+layout (per-process ``events.<proc>.jsonl`` shards, rotated
+``events.jsonl.N`` sets), must drop ONLY a torn final line, must flag
+orphaned spans explicitly rather than crashing or guessing, and the
+critical path must partition the root interval exactly — including
+over overlapping (concurrent) children.  Also pins the obs_report
+``## slowest requests`` section and its graceful degradation on runs
+with no trace ids (pre-PR-9 runs must still render).
+"""
+
+import itertools
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools import obs_trace  # noqa: E402
+from tools.obs_report import summarize, summarize_slowest  # noqa: E402
+
+TID = "ab" * 16
+T0 = 1_700_000_000.0
+
+
+def _span(name, sid, parent, start, dur, **kw):
+    d = {"kind": "span", "name": name, "trace_id": TID,
+         "span_id": sid, "t": T0 + start + dur, "dur_s": dur}
+    if parent is not None:
+        d["parent_span_id"] = parent
+    d.update(kw)
+    return d
+
+
+def _tree_spans():
+    """submit(1.0) -> request(0.9) -> {queue_wait(0.3),
+    fit(0.5) -> dispatch(0.4)}; plus a second tiny trace."""
+    spans = [
+        _span("submit", "s1", None, 0.0, 1.0),
+        _span("request", "s2", "s1", 0.05, 0.9),
+        _span("queue_wait", "s3", "s2", 0.05, 0.3),
+        _span("fit", "s4", "s2", 0.4, 0.5),
+        _span("dispatch", "s5", "s4", 0.45, 0.4,
+              n_requests=2,
+              links=[{"trace_id": TID, "span_id": "s4"},
+                     {"trace_id": "cd" * 16, "span_id": "x1"}]),
+    ]
+    other = {"kind": "span", "name": "archive", "trace_id": "cd" * 16,
+             "span_id": "x1", "t": T0 + 0.2, "dur_s": 0.2}
+    return spans, other
+
+
+def _write(path, events, torn=None):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        for ev in events:
+            fh.write(json.dumps(ev) + "\n")
+        if torn is not None:
+            fh.write(json.dumps(torn)[:25])  # no newline: torn tail
+
+
+def test_shard_permutation_torn_tail_and_rotation(tmp_path):
+    spans, other = _tree_spans()
+    # distribute over 2 process shards + a rotated set, torn final
+    # line in one shard; every permutation of the file layout must
+    # reconstruct identically
+    layouts = [
+        [("events.0.jsonl", spans[:2]),
+         ("events.1.jsonl.1", spans[2:4]),
+         ("events.1.jsonl", [spans[4], other])],
+        [("events.1.jsonl", spans[1::2] + [other]),
+         ("events.0.jsonl.1", spans[0:1]),
+         ("events.0.jsonl", spans[2::2])],
+    ]
+    torn_span = _span("torn", "s9", "s2", 0.8, 0.05)
+    results = []
+    for i, layout in enumerate(layouts):
+        for j, perm in enumerate(itertools.permutations(layout)):
+            d = tmp_path / ("lay%d_%d" % (i, j))
+            for k, (name, evs) in enumerate(perm):
+                _write(d / name, evs,
+                       torn=torn_span if k == 0 else None)
+            res = obs_trace.analyze([str(d)])
+            results.append(res)
+    base = results[0]
+    assert base["n_traces"] == 2
+    s = base["traces"][TID]
+    # the torn span is dropped — exactly it, nothing else
+    assert s["n_spans"] == 5
+    assert s["n_orphans"] == 0
+    assert base["orphan_spans"] == 0
+    assert sum(s["critical_path_s"].values()) == \
+        pytest.approx(s["total_s"], abs=1e-9)
+    for res in results[1:]:
+        assert res["traces"][TID]["critical_path_s"] == \
+            s["critical_path_s"]
+        assert res["traces"][TID]["n_spans"] == 5
+        assert res["traces"]["cd" * 16]["total_s"] == \
+            pytest.approx(0.2)
+
+
+def test_orphans_flagged_never_fatal(tmp_path):
+    spans, _ = _tree_spans()
+    # drop the request span: its children become orphans, the trace
+    # still renders from the longest remaining span
+    broken = [sp for sp in spans if sp["span_id"] != "s2"]
+    _write(tmp_path / "events.jsonl", broken)
+    res = obs_trace.analyze([str(tmp_path)])
+    s = res["traces"][TID]
+    assert s["n_orphans"] == 2  # queue_wait + fit (dispatch resolves)
+    assert set(s["orphans"]) == {"s3", "s4"}
+    assert s["root"] == "submit"
+    assert res["orphan_spans"] == 2
+    # the tree rendering names the orphans explicitly
+    traces = obs_trace.build_traces(
+        obs_trace.collect_spans([str(tmp_path)])[0])
+    lines = obs_trace.render_tree(traces[TID])
+    assert sum(1 for ln in lines if ln.startswith("ORPHAN")) == 2
+    # report rendering over the same events flags the orphan count
+    text = obs_trace.render_report(res, traces)
+    assert "orphan" in text
+
+
+def test_critical_path_overlapping_children():
+    # parent [0, 10]; children A [1, 6] and B [4, 9] overlap: the
+    # backward walk gives B its full interval, A only [1, 4), and the
+    # parent keeps [0,1) + [9,10] — partition is exact
+    parent = _span("p", "p1", None, 0.0, 10.0)
+    a = _span("a", "a1", "p1", 1.0, 5.0)
+    b = _span("b", "b1", "p1", 4.0, 5.0)
+    children = {"p1": [a, b]}
+    cp = obs_trace.critical_path(parent, children)
+    assert cp["b"] == pytest.approx(5.0)
+    assert cp["a"] == pytest.approx(3.0)
+    assert cp["p"] == pytest.approx(2.0)
+    assert sum(cp.values()) == pytest.approx(10.0)
+    # a child leaking past its parent's interval is clamped
+    c = _span("c", "c1", "p1", 8.0, 5.0)  # ends at 13 > parent end
+    cp2 = obs_trace.critical_path(parent, {"p1": [c]})
+    assert sum(cp2.values()) == pytest.approx(10.0)
+    assert cp2["c"] == pytest.approx(2.0)
+
+
+def test_aggregate_and_chrome_export(tmp_path):
+    spans, other = _tree_spans()
+    _write(tmp_path / "events.jsonl", spans + [other])
+    res = obs_trace.analyze([str(tmp_path)])
+    agg = obs_trace.aggregate_critical_path(res["traces"].values())
+    assert agg["n_traces"] == 2
+    # a phase absent from one trace counts as 0 there
+    assert agg["phases"]["dispatch"]["p50"] in (0.0, 0.4)
+    assert agg["total_s"]["p99"] == pytest.approx(1.0)
+    doc = obs_trace.chrome_trace(obs_trace.build_traces(
+        obs_trace.collect_spans([str(tmp_path)])[0]))
+    names = {e["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "X"}
+    assert {"submit", "request", "dispatch"} <= names
+    # X events nest by depth rows and json-serialize cleanly
+    json.dumps(doc)
+    # CLI: unknown trace id exits nonzero; export writes a file
+    out = tmp_path / "perfetto.json"
+    rc = obs_trace.main([str(tmp_path), "--export", str(out),
+                         "--json"])
+    assert rc == 0 and json.load(open(out))["traceEvents"]
+    assert obs_trace.main([str(tmp_path), "--trace", "ff" * 16]) == 1
+
+
+def test_report_slowest_section_and_degradation(tmp_path):
+    spans, other = _tree_spans()
+    run = tmp_path / "run"
+    run.mkdir()
+    _write(run / "events.jsonl", spans + [other])
+    text = summarize(str(run))
+    assert "## slowest requests" in text
+    assert TID[:16] in text
+    assert "aggregate critical path over 2 trace(s)" in text
+    # pre-tracing runs: span events without trace ids -> section absent,
+    # report still renders
+    legacy = tmp_path / "legacy"
+    legacy.mkdir()
+    _write(legacy / "events.jsonl",
+           [{"kind": "span", "name": "solve", "path": "solve",
+             "dur_s": 1.0, "t": T0},
+            {"kind": "event", "name": "archive", "t": T0}])
+    assert summarize_slowest(
+        [json.loads(ln) for ln in
+         (legacy / "events.jsonl").read_text().splitlines()]) is None
+    text2 = summarize(str(legacy))
+    assert "## slowest requests" not in text2
+    assert "## phases" in text2

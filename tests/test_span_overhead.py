@@ -21,6 +21,7 @@ if str(REPO) not in sys.path:
 
 from tools.span_overhead import (BUDGET_FRACTION, CALLS_PER_ARCHIVE,
                                  METRICS_CALLS_PER_ARCHIVE,
+                                 TRACING_CALLS_PER_ARCHIVE,
                                  measure)  # noqa: E402
 
 
@@ -28,7 +29,8 @@ def test_probe_schema_and_sanity():
     out = measure(n=200)
     for name in ("span", "phases", "event", "fit_telemetry",
                  "metrics_observe", "metrics_timed", "metrics_inc",
-                 "metrics_gauge"):
+                 "metrics_gauge", "tracing_current",
+                 "tracing_activate", "span_traced", "observe_traced"):
         assert out["%s_off_s" % name] > 0.0
         assert out["%s_on_s" % name] > 0.0
     assert out["archive_off_s"] == pytest.approx(
@@ -37,6 +39,10 @@ def test_probe_schema_and_sanity():
         METRICS_CALLS_PER_ARCHIVE * out["metrics_observe_off_s"])
     assert out["hot_fit_off_s"] == pytest.approx(
         out["archive_off_s"] + out["metrics_archive_off_s"])
+    assert out["tracing_archive_off_s"] == pytest.approx(
+        TRACING_CALLS_PER_ARCHIVE * out["tracing_current_off_s"])
+    assert out["hot_fit_tracing_off_s"] == pytest.approx(
+        out["hot_fit_off_s"] + out["tracing_archive_off_s"])
     # disabled primitives are nanosecond-scale dict lookups; even a
     # very loaded CI box keeps them under 50 us/call
     assert out["span_off_s"] < 50e-6
@@ -46,6 +52,9 @@ def test_probe_schema_and_sanity():
     assert out["metrics_observe_off_s"] < 50e-6
     assert out["metrics_timed_off_s"] < 50e-6
     assert out["metrics_inc_off_s"] < 50e-6
+    # disabled-tracing guard (ISSUE 9): reading the ambient context is
+    # ONE thread-local lookup — priced like the other disabled paths
+    assert out["tracing_current_off_s"] < 50e-6
 
 
 @pytest.mark.slow
@@ -91,3 +100,12 @@ def test_disabled_overhead_within_budget():
         (out["hot_fit_off_s"], fit_wall)
     assert out["metrics_archive_on_s"] < BUDGET_FRACTION * fit_wall, \
         (out["metrics_archive_on_s"], fit_wall)
+    # distributed tracing (ISSUE 9): the DISABLED path — hot fit obs +
+    # metrics + every ambient-context read tracing adds — must stay
+    # inside the same <2% budget, and even the fully-traced request
+    # path (activate + traced spans + exemplar observes) stays far
+    # below one archive's fit wall
+    assert out["hot_fit_tracing_off_s"] < BUDGET_FRACTION * fit_wall, \
+        (out["hot_fit_tracing_off_s"], fit_wall)
+    assert out["tracing_archive_on_s"] < fit_wall, \
+        (out["tracing_archive_on_s"], fit_wall)

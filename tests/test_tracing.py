@@ -1,0 +1,385 @@
+"""Distributed-tracing tests (the ISSUE 9 acceptance scenarios).
+
+Unit layer: context/carrier roundtrips, ambient stamping on
+span/phases/event at zero caller churn, cross-thread isolation,
+histogram exemplars (observe/snapshot/merge/OpenMetrics rendering,
+quantile→exemplar resolution), ledger + checkpoint trace stamping.
+
+End to end (in-process daemon, real fits): two concurrent traced
+submissions coalesce into ONE combined dispatch span carrying exactly
+two span links; each trace reconstructs (tools/obs_trace.py) into an
+orphan-free tree rooted at the client submit span whose critical path
+sums exactly to the total; ledger records, `.tim` markers, metric
+exemplars and replays all carry the trace ids.
+"""
+
+import json
+import sys
+import threading
+from pathlib import Path
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from pulseportraiture_tpu import obs  # noqa: E402
+from pulseportraiture_tpu.io.archive import make_fake_pulsar  # noqa: E402
+from pulseportraiture_tpu.io.gmodel import write_model  # noqa: E402
+from pulseportraiture_tpu.obs import metrics, tracing  # noqa: E402
+from pulseportraiture_tpu.pipelines.toas import (  # noqa: E402
+    _resume_checkpoint, checkpoint_traces, drop_checkpoint_blocks)
+from pulseportraiture_tpu.runner.plan import plan_survey  # noqa: E402
+from pulseportraiture_tpu.runner.queue import WorkQueue  # noqa: E402
+from pulseportraiture_tpu.service import TOAService  # noqa: E402
+from tools import obs_trace  # noqa: E402
+
+MODEL_PARAMS = np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5])
+
+
+def _events(run_dir):
+    out = []
+    for path in obs.list_event_files(run_dir):
+        with open(path, encoding="utf-8") as fh:
+            out.extend(json.loads(ln) for ln in fh if ln.strip())
+    return out
+
+
+# -- context & carriers -------------------------------------------------
+
+
+def test_ids_and_carrier_roundtrip():
+    tid, sid = tracing.new_trace_id(), tracing.new_span_id()
+    assert len(tid) == 32 and len(sid) == 16
+    ctx = (tid, sid)
+    carrier = tracing.inject({}, ctx=ctx)
+    assert carrier["traceparent"] == "00-%s-%s-01" % (tid, sid)
+    assert tracing.extract(carrier) == ctx
+    # malformed carriers degrade to None, never raise
+    for bad in (None, "", "garbage", "00-zz-xx-01",
+                "00-%s-%s" % (tid, sid), 42):
+        assert tracing.parse_traceparent(bad) is None
+    assert tracing.extract({"traceparent": "nope"}) is None
+    assert tracing.extract("not-a-dict") is None
+    # mint: fresh trace, no parent; inject from a rootless context
+    # still produces a parseable carrier
+    mtid, msid = tracing.mint()
+    assert len(mtid) == 32 and msid is None
+    assert tracing.parse_traceparent(
+        tracing.format_traceparent((mtid, None))) is not None
+
+
+def test_activate_restores_and_is_thread_local():
+    assert tracing.current() is None
+    with tracing.activate(("a" * 32, "b" * 16)):
+        assert tracing.current() == ("a" * 32, "b" * 16)
+        assert tracing.current_trace_id() == "a" * 32
+        seen = {}
+
+        def other():
+            seen["ctx"] = tracing.current()
+            with tracing.activate(("c" * 32, None)):
+                seen["inner"] = tracing.current_trace_id()
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        # a fresh thread sees NO ambient context (per-thread TLS)
+        assert seen["ctx"] is None
+        assert seen["inner"] == "c" * 32
+        with tracing.activate(None):
+            assert tracing.current() is None
+        assert tracing.current() == ("a" * 32, "b" * 16)
+    assert tracing.current() is None
+    assert tracing.current_trace_id() is None
+    assert tracing.current_span_id() is None
+
+
+# -- ambient stamping on the existing obs API ---------------------------
+
+
+def test_span_phases_event_stamping(tmp_path):
+    with obs.run("t", base_dir=str(tmp_path)) as rec:
+        with obs.span("untraced"):
+            pass
+        with tracing.activate(tracing.mint()):
+            with obs.span("root"):
+                with obs.span("child"):
+                    obs.event("evt", foo=1)
+            ph = obs.phases(archive="x")
+            ph.enter("load")
+            ph.enter("solve")
+            ph.done()
+            tracing.emit_span("posthoc", 0.25, custom="y")
+        run_dir = rec.dir
+    evs = {e.get("name"): e for e in _events(run_dir)}
+    assert "trace_id" not in evs["untraced"]
+    root, child = evs["root"], evs["child"]
+    assert "parent_span_id" not in root
+    assert child["parent_span_id"] == root["span_id"]
+    assert child["trace_id"] == root["trace_id"]
+    # the event inherits the ENCLOSING span's identity
+    assert evs["evt"]["span_id"] == child["span_id"]
+    assert evs["evt"]["trace_id"] == root["trace_id"]
+    # phases: siblings under the ambient root context (no parent —
+    # the phases ran at trace top level after the root span closed)
+    assert evs["load"]["trace_id"] == root["trace_id"]
+    assert evs["solve"]["trace_id"] == root["trace_id"]
+    assert evs["load"]["span_id"] != evs["solve"]["span_id"]
+    # post-hoc span parents on the ambient context
+    post = evs["posthoc"]
+    assert post["trace_id"] == root["trace_id"]
+    assert post["dur_s"] == 0.25 and post["custom"] == "y"
+
+
+def test_emit_span_links_and_explicit_ids(tmp_path):
+    with obs.run("t", base_dir=str(tmp_path)) as rec:
+        ctx = ("d" * 32, "e" * 16)
+        sid = tracing.emit_span(
+            "dispatch", 0.1, ctx=ctx, span_id="f" * 16,
+            links=[tracing.link(("a" * 32, "b" * 16))])
+        assert sid == "f" * 16
+        run_dir = rec.dir
+    (ev,) = [e for e in _events(run_dir) if e.get("name") == "dispatch"]
+    assert ev["trace_id"] == "d" * 32
+    assert ev["parent_span_id"] == "e" * 16
+    assert ev["span_id"] == "f" * 16
+    assert ev["links"] == [{"trace_id": "a" * 32, "span_id": "b" * 16}]
+    # no run active: emit_span is a no-op returning None
+    assert tracing.emit_span("x", 0.0) is None
+
+
+# -- histogram exemplars ------------------------------------------------
+
+
+def test_exemplar_observe_snapshot_merge_and_render():
+    h = metrics.Histogram()
+    for i in range(50):
+        h.observe(0.01, exemplar="fast%02d" % i)
+    h.observe(2.0, exemplar="slow")
+    h.observe(0.5)  # no exemplar: counts still exact
+    snap = h.to_snapshot()
+    fast_bucket = str(h.bucket_index(0.01))
+    ex = snap["exemplars"][fast_bucket]
+    # last-K retention
+    assert len(ex) == metrics.EXEMPLARS_PER_BUCKET
+    assert ex[-1]["trace_id"] == "fast49"
+    assert ex[-1]["value"] == pytest.approx(0.01)
+    # roundtrip preserves exemplars; merge stays count-exact
+    h2 = metrics.Histogram.from_snapshot(snap)
+    h3 = metrics.Histogram()
+    h3.observe(2.1, exemplar="other")
+    h2.merge(h3)
+    assert h2.count == 53
+    ids = {x["trace_id"] for exl in h2.to_snapshot()["exemplars"]
+           .values() for x in exl}
+    assert {"slow", "other"} <= ids
+    # quantile resolution: p99 resolves to the slow trace's bucket
+    got = metrics.exemplar_for_quantile(h2.to_snapshot(), 0.999)
+    assert got["trace_id"] in ("slow", "other")
+    # p50 resolves to a fast exemplar
+    got50 = metrics.exemplar_for_quantile(h2.to_snapshot(), 0.5)
+    assert got50["trace_id"].startswith("fast")
+    # empty / exemplar-free snapshots return None
+    assert metrics.exemplar_for_quantile(None, 0.99) is None
+    assert metrics.exemplar_for_quantile(
+        metrics.Histogram().to_snapshot(), 0.99) is None
+    # OpenMetrics exemplar syntax on the bucket lines
+    text = metrics.render_prometheus(
+        {"histograms": {'pps_phase_seconds{phase="total"}':
+                        h2.to_snapshot()}})
+    assert '# {trace_id="' in text
+    # merge_snapshots (the obs/merge.py path) keeps them too, with
+    # identical bucket counts regardless of shard order
+    a = {"histograms": {"h": snap}}
+    b = {"histograms": {"h": h3.to_snapshot()}}
+    m1 = metrics.merge_snapshots({0: a, 1: b})
+    m2 = metrics.merge_snapshots({0: b, 1: a})
+    assert m1["histograms"]["h"]["counts"] == \
+        m2["histograms"]["h"]["counts"]
+    assert "exemplars" in m1["histograms"]["h"]
+
+
+def test_ambient_exemplar_pickup(tmp_path):
+    with obs.run("t", base_dir=str(tmp_path)):
+        with tracing.activate(("ab" * 16, None)):
+            metrics.observe("pps_phase_seconds", 0.125, phase="fit")
+            with metrics.timed("pps_phase_seconds", phase="total"):
+                pass
+        metrics.observe("pps_phase_seconds", 0.125, phase="fit")
+        snap = metrics.snapshot()
+    hists = snap["histograms"]
+    fit = hists['pps_phase_seconds{phase="fit"}']
+    ids = [x["trace_id"] for ex in (fit.get("exemplars") or {}).values()
+           for x in ex]
+    # only the traced observation carried an exemplar
+    assert ids == ["ab" * 16]
+    total = hists['pps_phase_seconds{phase="total"}']
+    assert any(x["trace_id"] == "ab" * 16
+               for ex in total["exemplars"].values() for x in ex)
+
+
+# -- ledger & checkpoint stamping ---------------------------------------
+
+
+def test_ledger_trace_stamping(tmp_path):
+    q = WorkQueue(str(tmp_path / "ledger.0.jsonl"), backoff_s=0.0)
+    q.add(["/tmp/tr_a.fits"])
+    with tracing.activate(("9a" * 16, "7b" * 8)):
+        q.claim("/tmp/tr_a.fits")
+        q.complete("/tmp/tr_a.fits", n_toas=2)
+    q.close()
+    recs = [json.loads(ln) for ln in
+            (tmp_path / "ledger.0.jsonl").read_text().splitlines()]
+    assert "trace" not in recs[0]  # untraced add
+    assert recs[1]["trace"] == "9a" * 16  # claim
+    assert recs[2]["trace"] == "9a" * 16  # done
+    # replay keeps the field queryable
+    q2 = WorkQueue(str(tmp_path / "ledger.0.jsonl"))
+    assert q2.record("/tmp/tr_a.fits")["trace"] == "9a" * 16
+    q2.close()
+
+
+def test_checkpoint_marker_trace_roundtrip(tmp_path):
+    ck = str(tmp_path / "toas.tim")
+    with open(ck, "w") as f:
+        f.write("a1.fits 1400.0 56000.0 1.0 pks\n")
+        f.write("C pp_done a1.fits 1 trace=%s\n" % ("c3" * 16))
+        f.write("a2.fits 1400.0 56000.1 1.0 pks\n")
+        f.write("C pp_done a2.fits 1\n")  # pre-trace marker: still valid
+    done = _resume_checkpoint(ck)
+    assert len(done) == 2
+    traces = checkpoint_traces(ck)
+    assert list(traces.values()) == ["c3" * 16]
+    # the traced block drops cleanly like any other
+    assert drop_checkpoint_blocks(ck, ["a1.fits"]) == 1
+    assert len(_resume_checkpoint(ck)) == 1
+    assert checkpoint_traces(ck) == {}
+
+
+# -- end to end through the daemon (real fits) --------------------------
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("tracing")
+    gm = str(tmp / "tr.gmodel")
+    write_model(gm, "tr", "000", 1500.0, MODEL_PARAMS,
+                np.ones(8, int), -4.0, 0, quiet=True)
+    par = str(tmp / "tr.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    files = []
+    for i in range(3):
+        out = str(tmp / f"tr{i}.fits")
+        make_fake_pulsar(gm, par, out, nsub=2, nchan=8, nbin=64,
+                         nu0=1500.0, bw=800.0, tsub=60.0,
+                         phase=0.02 * (i + 1), dDM=5e-4,
+                         noise_stds=0.01, dedispersed=False,
+                         seed=417 + i, quiet=True)
+        files.append(out)
+    return SimpleNamespace(tmp=tmp, gm=gm, files=files,
+                           plan=plan_survey(files, modelfile=gm))
+
+
+def test_service_end_to_end_trace(corpus, tmp_path):
+    svc = TOAService(corpus.gm, str(tmp_path / "wd"),
+                     batch_window_s=0.5, batch_max=4, backoff_s=0.0,
+                     get_toas_kw={"bary": False}, quiet=True).start()
+    outcomes = {}
+    try:
+        run_dir = obs.current().dir
+
+        def client(tenant, path):
+            # in-process stand-in for pploadgen: the client submit
+            # span lands in the (shared) daemon run, the context rides
+            # the traceparent carrier exactly like the socket path
+            ctx = tracing.mint()
+            with tracing.activate(ctx):
+                with obs.span("submit", tenant=tenant):
+                    carrier = tracing.inject()
+                    r = svc.submit(tenant, path, wait=True,
+                                   timeout=300,
+                                   traceparent=carrier["traceparent"])
+            outcomes[tenant] = (ctx[0], r)
+
+        threads = [threading.Thread(target=client, args=args)
+                   for args in (("alice", corpus.files[0]),
+                                ("bob", corpus.files[1]))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for tenant, (tid, r) in outcomes.items():
+            assert r["state"] == "done", (tenant, r)
+            assert r["trace_id"] == tid  # payload echoes the trace
+        # replay echoes the ORIGINAL trace id (the fit that served it)
+        rp = svc.submit("alice", corpus.files[0], wait=True)
+        assert rp.get("cached")
+        assert rp["trace_id"] == outcomes["alice"][0]
+        snap = svc.metrics_snapshot()
+    finally:
+        assert svc.shutdown(timeout=300)
+
+    tids = {tid for tid, _ in outcomes.values()}
+
+    # -- reconstruction: orphan-free trees, exact critical path ------
+    result = obs_trace.analyze([run_dir])
+    spans, _ = obs_trace.collect_spans([run_dir])
+    traces = obs_trace.build_traces(spans)
+    for tid in tids:
+        s = result["traces"][tid]
+        assert s["n_orphans"] == 0, s
+        assert s["root"] == "submit", s
+        names = {sp.get("name") for sp in traces[tid].values()}
+        for need in ("submit", "request", "queue_wait", "checkout",
+                     "fit", "load", "solve", "write", "checkpoint"):
+            assert need in names, (need, sorted(names))
+        assert sum(s["critical_path_s"].values()) == \
+            pytest.approx(s["total_s"], abs=1e-6)
+
+    # -- fan-in: ONE combined dispatch span, exactly K links ---------
+    dispatches = [sp for tr in traces.values() for sp in tr.values()
+                  if sp.get("name") == "dispatch"]
+    combined = [sp for sp in dispatches
+                if int(sp.get("n_requests") or 1) > 1]
+    assert combined, "concurrent same-bucket submits did not coalesce"
+    (disp,) = combined
+    assert disp["n_requests"] == 2
+    assert len(disp["links"]) == 2
+    assert {ln["trace_id"] for ln in disp["links"]} == tids
+
+    # -- durable records carry the ids -------------------------------
+    for tenant, (tid, _) in outcomes.items():
+        led = tmp_path / "wd" / "tenants" / tenant / "ledger.0.jsonl"
+        recs = [json.loads(ln) for ln in
+                led.read_text().splitlines()]
+        done = [r for r in recs if r["state"] == "done"]
+        assert done and all(r["trace"] == tid for r in done)
+        marks = checkpoint_traces(
+            str(tmp_path / "wd" / "tenants" / tenant / "toas.tim"))
+        assert list(marks.values()) == [tid]
+
+    # -- exemplars: the p99 resolves to one of the traces ------------
+    total = None
+    for key, h in (snap.get("histograms") or {}).items():
+        name, labels = metrics.parse_series(key)
+        if name == metrics.PHASE_HISTOGRAM \
+                and labels.get("phase") == "total":
+            hh = metrics.Histogram.from_snapshot(h)
+            total = hh if total is None else total.merge(hh)
+    ex = metrics.exemplar_for_quantile(total.to_snapshot(), 0.99)
+    assert ex and ex["trace_id"] in tids, ex
+    assert '# {trace_id="' in metrics.render_prometheus(snap)
+
+    # -- report renders the slowest-requests section -----------------
+    from tools.obs_report import summarize
+
+    text = summarize(run_dir)
+    assert "## slowest requests" in text, text
+    for tid in tids:
+        assert tid[:16] in text

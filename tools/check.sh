@@ -34,7 +34,14 @@
 #                 dispatch fault must BREACH the SLO gate (nonzero
 #                 exit) — the live-telemetry/SLO plane end to end
 #                 (docs/SERVICE.md, docs/OBSERVABILITY.md)
-#   9. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
+#   9. trace smoke — distributed tracing end to end: a p99 histogram
+#                 exemplar pulled from a warmed daemon's metrics
+#                 snapshot must resolve via tools/obs_trace.py to a
+#                 complete orphan-free span tree (client submit ->
+#                 daemon lifecycle -> combined-dispatch span links ->
+#                 checkpoint) whose critical path sums to the recorded
+#                 total within 10% (docs/OBSERVABILITY.md)
+#  10. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
 #
 # Exit status is non-zero when any stage fails.
 set -u
@@ -122,6 +129,17 @@ if [ $? -ne 0 ]; then
     fail=1
 else
     tail -1 /tmp/_loadgen_smoke.log
+fi
+
+echo
+echo "== trace smoke (p99 exemplar -> span tree, docs/OBSERVABILITY.md) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu PPTPU_OBS_DIR="" PPTPU_FAULTS="" \
+    python -m tools.trace_smoke >/tmp/_trace_smoke.log 2>&1
+if [ $? -ne 0 ]; then
+    tail -40 /tmp/_trace_smoke.log
+    fail=1
+else
+    tail -1 /tmp/_trace_smoke.log
 fi
 
 echo

@@ -57,6 +57,25 @@ _METRICS_API_NAMES = {"inc", "set_gauge", "observe", "timed",
                       "percentiles", "Histogram", "MetricsRegistry",
                       "MetricsExporter"}
 
+# distributed tracing (pulseportraiture_tpu.obs.tracing): host-side by
+# contract — a trace id is a host string and the ambient context lives
+# in a thread-local; under jit a current()/activate() would capture the
+# TRACE-TIME context once and bake it into every execution, and an
+# emit_span's file IO cannot exist in compiled code.  Matched as
+# ``tracing.<name>`` / ``obs.tracing.<name>``.
+_TRACING_API_NAMES = {"current", "current_trace_id", "current_span_id",
+                      "mint", "activate", "new_trace_id",
+                      "new_span_id", "inject", "extract",
+                      "format_traceparent", "parse_traceparent",
+                      "emit_span", "link"}
+
+# parameter names that (by repo convention) carry trace identity as
+# host strings; seeing one consumed by an array op inside jit means a
+# trace id was captured as a traced value — the id seen at trace time
+# would be burned into the compiled program
+_TRACE_ID_NAMES = {"trace_id", "span_id", "parent_span_id",
+                   "traceparent", "trace_ctx"}
+
 # obs.devtime (profiler-capture ingestion): host-side FILE PARSING by
 # contract — inside jit it would read gigabyte traces at trace time
 # and its result could never feed compiled code.  Matched as
@@ -404,6 +423,34 @@ class RuleVisitor(ast.NodeVisitor):
                           "times tracing, and the registry locks / "
                           "snapshot IO cannot exist in compiled code; "
                           "record after the jit boundary "
+                          "(docs/OBSERVABILITY.md)")
+            elif fname is not None and (
+                    fname.rsplit(".", 1)[-1] in _TRACING_API_NAMES
+                    and fname.startswith(("tracing.",
+                                          "obs.tracing."))):
+                self._add("J002", node,
+                          "obs.tracing call inside a jitted function "
+                          "— trace context is host-side by contract: "
+                          "under jit the ambient context read at "
+                          "trace time is baked into every execution "
+                          "of the compiled program, and span "
+                          "emission's file IO cannot exist in "
+                          "compiled code; propagate context around "
+                          "the jit boundary (docs/OBSERVABILITY.md)")
+            elif fname is not None and (
+                    fname.startswith(_JNP_PREFIXES
+                                     + ("jax.lax.", "lax."))
+                    and any(isinstance(a, ast.Name)
+                            and a.id in _TRACE_ID_NAMES
+                            for a in node.args)):
+                self._add("J002", node,
+                          "trace id captured as a traced value — a "
+                          "trace/span id is a host-side string "
+                          "identity; feeding it into an array op "
+                          "inside jit burns the id seen at TRACE time "
+                          "into every execution (and forces a host "
+                          "sync to read it back); keep trace ids "
+                          "outside the jit boundary "
                           "(docs/OBSERVABILITY.md)")
             elif fname is not None and (
                     fname.rsplit(".", 1)[-1] in _DEVTIME_API_NAMES

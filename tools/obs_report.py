@@ -430,6 +430,57 @@ def summarize_latency(snapshot):
     return "\n".join(lines)
 
 
+def summarize_slowest(events, top=10):
+    """The ``## slowest requests`` section: top-N traces by total
+    duration with each one's per-phase critical-path split, rebuilt
+    from the run's own span events (obs/tracing.py ids,
+    tools/obs_trace.py reconstruction).
+
+    Degrades gracefully: runs predating distributed tracing carry no
+    trace ids and the section is simply absent (returns None) — the
+    rest of the report renders unchanged.  A span whose parent lives
+    in another run's stream (the client side of a daemon request) is
+    an orphan *here*; the trace still renders from its longest local
+    span, with the orphan count shown.
+    """
+    spans = [e for e in events if e.get("kind") == "span"
+             and e.get("trace_id") and e.get("span_id")]
+    if not spans:
+        return None
+    try:
+        from tools import obs_trace
+    except ImportError:
+        return None
+    traces = obs_trace.build_traces(spans)
+    summaries = [s for s in (obs_trace.summarize_trace(tr)
+                             for tr in traces.values()) if s]
+    if not summaries:
+        return None
+    summaries.sort(key=lambda s: -s["total_s"])
+    rows = []
+    for s in summaries[:top]:
+        split = "  ".join(
+            "%s %s" % (k, _fmt_lat_s(v))
+            for k, v in list(s["critical_path_s"].items())[:4])
+        rows.append([s["trace_id"][:16], str(s["root"]),
+                     _fmt_lat_s(s["total_s"]), split,
+                     str(s["n_orphans"]) if s["n_orphans"] else "-"])
+    lines = [_table(["trace", "root", "total_s",
+                     "critical path (top phases)", "orphans"], rows)]
+    agg = obs_trace.aggregate_critical_path(summaries)
+    if agg:
+        parts = ["%s p50 %s / p99 %s"
+                 % (ph, _fmt_lat_s(qs["p50"]), _fmt_lat_s(qs["p99"]))
+                 for ph, qs in sorted(agg["phases"].items(),
+                                      key=lambda kv: -kv[1]["p99"])]
+        lines.append("")
+        lines.append("aggregate critical path over %d trace(s): %s"
+                     % (agg["n_traces"], "  ".join(parts[:6])))
+        lines.append("(full breakdown: python -m tools.obs_trace "
+                     "<run-dir>)")
+    return "\n".join(lines)
+
+
 def summarize_service(events, snapshot=None):
     """TOA-service audit trail (docs/SERVICE.md): per-tenant request
     outcomes, the per-request lifecycle tail, micro-batch dispatch
@@ -580,6 +631,11 @@ def summarize(run_dir):
         out.append("")
         out.append("## latency (streaming-metrics histograms)")
         out.append(lat)
+    slow = summarize_slowest(events)
+    if slow:
+        out.append("")
+        out.append("## slowest requests (distributed traces)")
+        out.append(slow)
     svc = summarize_service(events, snapshot=msnap)
     if svc:
         out.append("")

@@ -1,0 +1,430 @@
+"""Reconstruct distributed traces from obs event streams.
+
+The tracing layer (pulseportraiture_tpu/obs/tracing.py) stamps every
+span event with ``trace_id`` / ``span_id`` / ``parent_span_id`` and
+records batched fan-in as span ``links``.  This tool turns those flat
+JSONL streams back into causal request trees and answers the question
+metrics cannot: *which phase actually bounded this request's latency?*
+
+    python -m tools.obs_trace <run-or-base-dir> [more dirs/files ...]
+    python -m tools.obs_trace <dirs> --trace <trace-id>   # one tree
+    python -m tools.obs_trace <dirs> --export perfetto.json
+    python -m tools.obs_trace <dirs> --json               # machine use
+
+Inputs may be obs run directories, base directories holding many runs
+(a daemon's ``obs`` + a loadgen's ``obs_client``), shard directories
+(``events.<proc>.jsonl``), or bare event files — every file whose name
+starts with ``events`` and contains ``.jsonl`` is read, including
+rotated ``events.jsonl.N`` sets, in ANY order: reconstruction sorts by
+timestamp and parents by id, so shard order cannot change the result.
+Torn tail lines (crash mid-append) drop exactly the torn span; spans
+whose parent id resolves to no recorded span are flagged as
+**orphans**, never invented or silently dropped.
+
+Critical path: for each trace the primary (longest root) span's
+interval is partitioned bottom-up — walking children newest-end-first,
+each child owns its clamped interval, the gaps belong to the parent —
+so the per-phase contributions sum *exactly* to the root duration and
+name the phase that bounded the request (queue_wait vs fit vs
+dispatch...).  The report prints the top-N slowest traces with their
+splits, an aggregate per-phase breakdown at p50/p99 across traces, and
+exports Chrome-trace/Perfetto JSON for visual inspection.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def _num(x, default=0.0):
+    try:
+        v = float(x)
+    except (TypeError, ValueError):
+        return default
+    return v if v == v else default
+
+
+def _span_interval(span):
+    """(start, end) seconds of a span event: ``t`` is stamped at span
+    END, ``dur_s`` is the measured duration."""
+    end = _num(span.get("t"))
+    return end - _num(span.get("dur_s")), end
+
+
+def _iter_event_files(path):
+    """Every event file under ``path`` (a file, run dir, shards dir,
+    or base dir of many runs), in deterministic sorted order."""
+    if os.path.isfile(path):
+        yield path
+        return
+    for root, dirs, files in os.walk(path):
+        dirs.sort()
+        for name in sorted(files):
+            if name.startswith("events") and ".jsonl" in name:
+                yield os.path.join(root, name)
+
+
+def collect_spans(paths):
+    """All traced span events (and the files they came from) under the
+    given paths.  Unparseable lines — torn tails, partial writes — are
+    skipped line by line; only the torn span is lost."""
+    spans = []
+    sources = []
+    for path in paths:
+        for fpath in _iter_event_files(path):
+            sources.append(fpath)
+            try:
+                fh = open(fpath, encoding="utf-8")
+            except OSError:
+                continue
+            with fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn tail: drop this line only
+                    if isinstance(ev, dict) \
+                            and ev.get("kind") == "span" \
+                            and ev.get("trace_id") \
+                            and ev.get("span_id"):
+                        spans.append(ev)
+    return spans, sources
+
+
+def build_traces(spans):
+    """{trace_id: {span_id: span}} — duplicate span ids (a shard
+    copied twice, a merge overlapping its sources) keep one record."""
+    traces = {}
+    for sp in spans:
+        tr = traces.setdefault(sp["trace_id"], {})
+        old = tr.get(sp["span_id"])
+        if old is None or _num(sp.get("dur_s")) >= _num(
+                old.get("dur_s")):
+            tr[sp["span_id"]] = sp
+    return traces
+
+
+def _tree(tr):
+    """(roots, children, orphans) of one trace's {span_id: span}.
+
+    An orphan carries a ``parent_span_id`` that resolves to no
+    recorded span — a torn parent line, a shard that was not passed
+    in, or a half-landed write.  Flagged, never guessed at.
+    """
+    children = {}
+    roots, orphans = [], []
+    for sp in tr.values():
+        pid = sp.get("parent_span_id")
+        if pid is None:
+            roots.append(sp)
+        elif pid in tr:
+            children.setdefault(pid, []).append(sp)
+        else:
+            orphans.append(sp)
+    return roots, children, orphans
+
+
+def critical_path(root, children):
+    """Per-phase critical-path seconds over ``root``'s interval.
+
+    Bottom-up interval partition: children are walked newest-end
+    first, each owning its interval clamped into what remains; the
+    uncovered remainder is the parent's own contribution.  The values
+    sum exactly to the root's duration, so "which phase bounded this
+    request" is an identity, not an estimate.
+    """
+    contrib = {}
+
+    def credit(name, secs):
+        if secs > 0:
+            contrib[name] = contrib.get(name, 0.0) + secs
+
+    def walk(sp, lo, hi):
+        name = str(sp.get("name") or "?")
+        kids = []
+        for ch in children.get(sp["span_id"], ()):
+            s, e = _span_interval(ch)
+            s, e = max(s, lo), min(e, hi)
+            if e > s:
+                kids.append((e, s, ch))
+        cursor = hi
+        for e, s, ch in sorted(kids, key=lambda x: (x[0], x[1]),
+                               reverse=True):
+            e = min(e, cursor)
+            if e <= s:
+                continue  # fully shadowed by a later sibling
+            credit(name, cursor - e)
+            walk(ch, s, e)
+            cursor = s
+            if cursor <= lo:
+                break
+        credit(name, cursor - lo)
+
+    lo, hi = _span_interval(root)
+    walk(root, lo, hi)
+    return contrib
+
+
+def summarize_trace(tr):
+    """One trace's summary: primary root, total, critical-path split,
+    orphans.  The primary root is the longest root span (with both
+    client and daemon streams that is the client submit span); when a
+    trace has only orphans (its root lives in a shard not passed in)
+    the longest orphan stands in so the trace still renders."""
+    roots, children, orphans = _tree(tr)
+    candidates = roots or orphans
+    if not candidates:
+        return None
+    primary = max(candidates, key=lambda sp: _num(sp.get("dur_s")))
+    phases = critical_path(primary, children)
+    tid = primary.get("trace_id")
+    return {
+        "trace_id": tid,
+        "n_spans": len(tr),
+        "root": primary.get("name"),
+        "root_span_id": primary.get("span_id"),
+        "total_s": _num(primary.get("dur_s")),
+        "t_end": _num(primary.get("t")),
+        "critical_path_s": {k: round(v, 6)
+                            for k, v in sorted(phases.items(),
+                                               key=lambda kv: -kv[1])},
+        "orphans": [sp["span_id"] for sp in orphans],
+        "n_orphans": len(orphans),
+    }
+
+
+def _analyze_traces(traces, n_spans, n_sources):
+    summaries = {}
+    orphan_total = 0
+    for tid, tr in traces.items():
+        summary = summarize_trace(tr)
+        if summary is not None:
+            summaries[tid] = summary
+            orphan_total += summary["n_orphans"]
+    return {"traces": summaries,
+            "n_spans": n_spans,
+            "n_traces": len(summaries),
+            "n_sources": n_sources,
+            "orphan_spans": orphan_total}
+
+
+def analyze(paths):
+    """Full analysis of every trace under ``paths``:
+    ``{"traces": {tid: summary}, "n_spans", "n_sources",
+    "orphan_spans"}`` — the importable API the trace-smoke gate and
+    ``tools/obs_report.py`` build on."""
+    spans, sources = collect_spans(paths)
+    return _analyze_traces(build_traces(spans), len(spans),
+                           len(sources))
+
+
+def aggregate_critical_path(summaries, qs=(0.5, 0.99)):
+    """Across-trace aggregate: for each phase, the critical-path
+    seconds it contributed at the given quantiles (sorted-sample
+    quantile over traces; phases a trace lacks count as 0 so shares
+    stay comparable), plus the same quantiles of the totals."""
+    summaries = list(summaries)
+    if not summaries:
+        return {}
+    phases = sorted({p for s in summaries
+                     for p in s["critical_path_s"]})
+    n = len(summaries)
+
+    def q_of(values, q):
+        vs = sorted(values)
+        return vs[min(n - 1, int(q * (n - 1) + 0.5))]
+
+    out = {"n_traces": n, "phases": {}, "total_s": {}}
+    for q in qs:
+        out["total_s"]["p%g" % (100 * q)] = round(
+            q_of([s["total_s"] for s in summaries], q), 6)
+    for phase in phases:
+        vals = [s["critical_path_s"].get(phase, 0.0)
+                for s in summaries]
+        out["phases"][phase] = {
+            "p%g" % (100 * q): round(q_of(vals, q), 6) for q in qs}
+    return out
+
+
+def render_tree(tr, out=None):
+    """Human-readable indented tree of one trace."""
+    lines = [] if out is None else out
+    roots, children, orphans = _tree(tr)
+
+    def fmt(sp):
+        attrs = {k: v for k, v in sp.items()
+                 if k in ("request", "tenant", "archive", "bucket",
+                          "state", "batch", "n_requests")
+                 and v is not None}
+        extra = ("  " + json.dumps(attrs, sort_keys=True)) \
+            if attrs else ""
+        links = sp.get("links") or []
+        if links:
+            extra += "  links=%d" % len(links)
+        return "%-12s %9.3fs  [%s]%s" % (sp.get("name"),
+                                         _num(sp.get("dur_s")),
+                                         sp.get("span_id"), extra)
+
+    def walk(sp, depth):
+        lines.append("  " * depth + fmt(sp))
+        kids = sorted(children.get(sp["span_id"], ()),
+                      key=lambda c: _span_interval(c)[0])
+        for ch in kids:
+            walk(ch, depth + 1)
+
+    for root in sorted(roots, key=lambda sp: _span_interval(sp)[0]):
+        walk(root, 0)
+    for sp in orphans:
+        lines.append("ORPHAN (parent %s not found): %s"
+                     % (sp.get("parent_span_id"), fmt(sp)))
+        for ch in sorted(children.get(sp["span_id"], ()),
+                         key=lambda c: _span_interval(c)[0]):
+            walk(ch, 1)
+    return lines
+
+
+def chrome_trace(traces):
+    """Chrome-trace/Perfetto JSON for the given ``{tid: {sid: span}}``
+    — one "process" per trace, spans stacked by tree depth."""
+    events = []
+    starts = [s for tr in traces.values()
+              for s in (_span_interval(sp)[0] for sp in tr.values())]
+    t0 = min(starts) if starts else 0.0
+    for i, tid in enumerate(sorted(traces)):
+        tr = traces[tid]
+        pid = i + 1
+        events.append({"ph": "M", "pid": pid, "name": "process_name",
+                       "args": {"name": "trace %s" % tid[:16]}})
+        _, children, _ = _tree(tr)
+        depth = {}
+
+        def walk(sp, d):
+            depth[sp["span_id"]] = d
+            for ch in children.get(sp["span_id"], ()):
+                walk(ch, d + 1)
+
+        for sp in tr.values():
+            if sp.get("parent_span_id") not in tr:
+                walk(sp, 0)
+        for sp in tr.values():
+            s, e = _span_interval(sp)
+            ev = {"name": str(sp.get("name") or "?"), "ph": "X",
+                  "pid": pid, "tid": depth.get(sp["span_id"], 0),
+                  "ts": round((s - t0) * 1e6, 3),
+                  "dur": round((e - s) * 1e6, 3),
+                  "args": {k: v for k, v in sp.items()
+                           if k not in ("kind", "t", "dur_s")}}
+            events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _fmt_split(cp, limit=4):
+    return "  ".join("%s %.3fs" % (k, v)
+                     for k, v in list(cp.items())[:limit])
+
+
+def render_report(result, traces, top=10):
+    """The human report: totals, slowest traces, aggregate breakdown."""
+    out = ["# obs trace report",
+           "spans: %d in %d trace(s) from %d file(s); orphan spans: %d"
+           % (result["n_spans"], result["n_traces"],
+              result["n_sources"], result["orphan_spans"])]
+    summaries = sorted(result["traces"].values(),
+                       key=lambda s: -s["total_s"])
+    if not summaries:
+        out.append("(no traced spans found — runs predating "
+                   "distributed tracing render empty)")
+        return "\n".join(out) + "\n"
+    out.append("")
+    out.append("## slowest traces (critical-path split)")
+    for s in summaries[:top]:
+        flag = "  [%d orphan(s)]" % s["n_orphans"] \
+            if s["n_orphans"] else ""
+        out.append("- %s  %s %.3fs  %s%s"
+                   % (s["trace_id"], s["root"], s["total_s"],
+                      _fmt_split(s["critical_path_s"]), flag))
+    agg = aggregate_critical_path(summaries)
+    out.append("")
+    out.append("## aggregate critical path (across %d traces)"
+               % agg["n_traces"])
+    out.append("| phase | p50_s | p99_s |")
+    out.append("|---|---|---|")
+    for phase, qs in sorted(agg["phases"].items(),
+                            key=lambda kv: -kv[1]["p99"]):
+        out.append("| %s | %.3f | %.3f |" % (phase, qs["p50"],
+                                             qs["p99"]))
+    out.append("| (total) | %.3f | %.3f |"
+               % (agg["total_s"]["p50"], agg["total_s"]["p99"]))
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="obs_trace",
+        description="Reconstruct distributed traces + critical paths "
+                    "from obs event streams (docs/OBSERVABILITY.md).")
+    p.add_argument("paths", nargs="+",
+                   help="Run dirs, obs base dirs, shard dirs or event "
+                        "files (any mix, any order).")
+    p.add_argument("--trace", default=None,
+                   help="Render one trace id as a span tree.")
+    p.add_argument("--top", type=int, default=10,
+                   help="Slowest traces to list (default 10).")
+    p.add_argument("--export", default=None,
+                   help="Write Chrome-trace/Perfetto JSON here.")
+    p.add_argument("--json", action="store_true",
+                   help="Print the analysis as JSON (machine use).")
+    args = p.parse_args(argv)
+
+    spans, sources = collect_spans(args.paths)
+    traces = build_traces(spans)
+    result = _analyze_traces(traces, len(spans), len(sources))
+
+    if args.export:
+        doc = chrome_trace(traces if args.trace is None
+                           else {args.trace:
+                                 traces.get(args.trace, {})})
+        with open(args.export, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+            fh.write("\n")
+
+    if args.trace is not None:
+        tr = traces.get(args.trace)
+        if not tr:
+            print("obs_trace: trace %s not found in %d source file(s)"
+                  % (args.trace, len(sources)), file=sys.stderr)
+            return 1
+        summary = result["traces"].get(args.trace)
+        if args.json:
+            print(json.dumps({"summary": summary,
+                              "spans": sorted(
+                                  tr.values(),
+                                  key=lambda s: _span_interval(s)[0])},
+                             default=str))
+        else:
+            print("# trace %s" % args.trace)
+            for line in render_tree(tr):
+                print(line)
+            if summary:
+                print()
+                print("total %.3fs  critical path: %s"
+                      % (summary["total_s"],
+                         _fmt_split(summary["critical_path_s"],
+                                    limit=99)))
+                if summary["n_orphans"]:
+                    print("ORPHANS: %s" % summary["orphans"])
+        return 0
+
+    if args.json:
+        print(json.dumps(result, default=str))
+    else:
+        sys.stdout.write(render_report(result, traces, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

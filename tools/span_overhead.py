@@ -30,6 +30,10 @@ CALLS_PER_ARCHIVE = 7
 # / dispatch / fit / total + the checkpoint phase, plus ~2 gauge/
 # counter updates per request (daemon.py instrumentation)
 METRICS_CALLS_PER_ARCHIVE = 9
+# distributed-tracing touch points per archive (obs/tracing.py): one
+# activate per request/archive plus the ambient-context reads the
+# span/metrics instrumentation performs (ISSUE 9 budget satellite)
+TRACING_CALLS_PER_ARCHIVE = 10
 BUDGET_FRACTION = 0.02
 
 
@@ -46,11 +50,12 @@ def measure(n=2000):
     (obs/metrics.py: observe / timed / inc / gauge), with obs disabled
     and enabled."""
     from pulseportraiture_tpu import obs
-    from pulseportraiture_tpu.obs import metrics
+    from pulseportraiture_tpu.obs import metrics, tracing
 
     fit_result = {"nfeval": np.full(8, 12),
                   "red_chi2": np.ones(8),
                   "return_code": np.zeros(8, int)}
+    trace_ctx = (tracing.new_trace_id(), tracing.new_span_id())
 
     def one_span():
         with obs.span("solve", batch=8):
@@ -84,12 +89,37 @@ def measure(n=2000):
     def one_metrics_gauge():
         metrics.set_gauge("pps_queue_depth", 3, tenant="probe")
 
+    def one_tracing_current():
+        # the disabled-tracing contract (ISSUE 9): reading the ambient
+        # context is ONE thread-local lookup, run active or not
+        tracing.current()
+
+    def one_tracing_activate():
+        with tracing.activate(trace_ctx):
+            pass
+
+    def one_span_traced():
+        # a span recorded while a trace context is ambient: the
+        # traced-request path (child id allocation + stamped fields)
+        with tracing.activate(trace_ctx):
+            with obs.span("solve", batch=8):
+                pass
+
+    def one_observe_traced():
+        with tracing.activate(trace_ctx):
+            metrics.observe("pps_phase_seconds", 0.25, phase="fit",
+                            tenant="probe", bucket="64x256")
+
     probes = {"span": one_span, "phases": one_phases,
               "event": one_event, "fit_telemetry": one_fit_telemetry,
               "metrics_observe": one_metrics_observe,
               "metrics_timed": one_metrics_timed,
               "metrics_inc": one_metrics_inc,
-              "metrics_gauge": one_metrics_gauge}
+              "metrics_gauge": one_metrics_gauge,
+              "tracing_current": one_tracing_current,
+              "tracing_activate": one_tracing_activate,
+              "span_traced": one_span_traced,
+              "observe_traced": one_observe_traced}
 
     out = {}
     saved = os.environ.pop("PPTPU_OBS_DIR", None)
@@ -124,6 +154,17 @@ def measure(n=2000):
         + out["metrics_archive_off_s"]
     out["hot_fit_on_s"] = out["archive_on_s"] \
         + out["metrics_archive_on_s"]
+    # distributed tracing (ISSUE 9): disabled = the ambient-context
+    # reads the instrumentation would perform; enabled = one activate
+    # per archive plus every span/observe going through the traced
+    # (child-id + stamp) path
+    out["tracing_archive_off_s"] = (
+        TRACING_CALLS_PER_ARCHIVE * out["tracing_current_off_s"])
+    out["tracing_archive_on_s"] = (
+        out["tracing_activate_on_s"] + 5 * out["span_traced_on_s"]
+        + 7 * out["observe_traced_on_s"])
+    out["hot_fit_tracing_off_s"] = out["hot_fit_off_s"] \
+        + out["tracing_archive_off_s"]
     return out
 
 

@@ -1,0 +1,247 @@
+"""Trace smoke gate: a p99 histogram exemplar must resolve to a
+complete, orphan-free distributed trace — wired into tools/check.sh
+(ISSUE 9 acceptance).
+
+Flow (docs/OBSERVABILITY.md "Distributed tracing"):
+
+* a warmed ``ppserve`` daemon starts over a one-bucket plan;
+  ``pploadgen`` drives it closed-loop (2 workers, micro-batch window
+  open) so same-bucket requests coalesce into combined dispatches;
+* the daemon's streaming-metrics snapshot (``metrics`` socket verb)
+  must carry **exemplars** on the ``total`` phase histogram, rendered
+  in OpenMetrics exemplar syntax in the Prometheus exposition;
+* the **p99 exemplar's trace id** must resolve via
+  ``tools/obs_trace.py`` — over the daemon's obs run plus the
+  loadgen's client run — to a span tree rooted at the client
+  ``submit`` span, containing the daemon ``request`` lifecycle
+  (queue_wait / checkout / fit) down to the ``checkpoint`` span, with
+  ZERO orphan spans, and a critical path whose per-phase sum is within
+  10% of the recorded request total (the exemplar's own observed
+  value, modulo client-side socket overhead);
+* at least one **combined dispatch** (K > 1 coalesced requests) must
+  exist and carry **exactly K span links**, and the p99 trace must be
+  reachable from some dispatch span through its links (fan-in is
+  first-class, not lost);
+* the Chrome-trace export must parse and ``tools/obs_report.py`` must
+  render the ``## slowest requests`` section from the daemon run.
+
+Run:  env JAX_PLATFORMS=cpu python -m tools.trace_smoke
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def _wait_ready(proc, timeout=420.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise AssertionError(
+                "daemon exited before ready: rc=%s" % proc.poll())
+        line = line.decode("utf-8", "replace").strip()
+        if line.startswith("PPSERVE_READY "):
+            return json.loads(line[len("PPSERVE_READY "):])
+    raise AssertionError("daemon never became ready")
+
+
+def _start_daemon(wd, gm, plan_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PPTPU_FAULTS"] = ""
+    env["PPTPU_METRICS_INTERVAL"] = "0.5"
+    cmd = [sys.executable, "-m", "pulseportraiture_tpu.cli.ppserve",
+           "start", "-w", wd, "-m", gm, "--plan", plan_path,
+           "--window", "0.25", "--batch", "2", "--backoff", "0",
+           "--no_bary", "--warm", "--quiet"]
+    proc = subprocess.Popen(cmd, env=env, cwd=os.getcwd(),
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
+    return proc, _wait_ready(proc)
+
+
+def _shutdown(sock, proc):
+    from pulseportraiture_tpu.service import client_request
+
+    try:
+        client_request(sock, {"op": "shutdown"}, timeout=30.0)
+    except (OSError, ValueError):
+        pass
+    try:
+        return proc.wait(timeout=300)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def main():
+    workroot = tempfile.mkdtemp(prefix="pptpu_trace_smoke_")
+    procs = []
+    try:
+        from pulseportraiture_tpu.cli.pploadgen import main as lg_main
+        from pulseportraiture_tpu.io.archive import make_fake_pulsar
+        from pulseportraiture_tpu.io.gmodel import write_model
+        from pulseportraiture_tpu.obs import metrics
+        from pulseportraiture_tpu.obs.metrics import (
+            PHASE_HISTOGRAM, exemplar_for_quantile, parse_series)
+        from pulseportraiture_tpu.runner.plan import plan_survey
+        from pulseportraiture_tpu.service import client_request
+        from tools import obs_trace
+
+        gm = os.path.join(workroot, "tr.gmodel")
+        write_model(gm, "tr", "000", 1500.0,
+                    np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0,
+                              -0.5]),
+                    np.ones(8, int), -4.0, 0, quiet=True)
+        par = os.path.join(workroot, "tr.par")
+        with open(par, "w") as f:
+            f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                    "PEPOCH 56000.0\nDM 30.0\n")
+        sources = []
+        for i in range(2):
+            fits = os.path.join(workroot, "src%d.fits" % i)
+            make_fake_pulsar(gm, par, fits, nsub=2, nchan=8, nbin=64,
+                             nu0=1500.0, bw=800.0, tsub=60.0,
+                             phase=0.03 * (i + 1), dDM=5e-4,
+                             noise_stds=0.01, dedispersed=False,
+                             seed=311 + i, quiet=True)
+            sources.append(fits)
+
+        wd = os.path.join(workroot, "wd")
+        os.makedirs(wd)
+        plan = plan_survey(sources, modelfile=gm)
+        plan_path = os.path.join(wd, "plan.json")
+        plan.save(plan_path)
+        proc, ready = _start_daemon(wd, gm, plan_path)
+        procs.append(proc)
+        assert ready["warmed"], ready
+        sock = ready["socket"]
+
+        # closed-loop load with 2 workers against a window-0.25/batch-2
+        # daemon: same-bucket requests coalesce into combined
+        # dispatches, every request inside its own minted trace
+        report_path = os.path.join(workroot, "loadgen_report.json")
+        rc = lg_main(["-w", wd, "--socket", sock, "-t", "alice,bob",
+                      "--archives"] + sources +
+                     ["-n", "6", "--mode", "closed",
+                      "--concurrency", "2", "--seed", "13",
+                      "--timeout", "300", "--out", report_path,
+                      "--quiet"])
+        assert rc == 0, "loadgen run failed"
+        report = json.load(open(report_path))
+        assert report["n_ok"] == 6 and report["n_err"] == 0, report
+
+        # -- p99 exemplar from the SERVER histogram snapshot ---------
+        resp = client_request(sock, {"op": "metrics",
+                                     "format": "prometheus"},
+                              timeout=30.0)
+        snap = resp["snapshot"]
+        total = None
+        for key, h in (snap.get("histograms") or {}).items():
+            name, labels = parse_series(key)
+            if name == PHASE_HISTOGRAM \
+                    and labels.get("phase") == "total":
+                hist = metrics.Histogram.from_snapshot(h)
+                total = hist if total is None else total.merge(hist)
+        assert total is not None, sorted(snap.get("histograms") or {})
+        ex = exemplar_for_quantile(total.to_snapshot(), 0.99)
+        assert ex and ex.get("trace_id"), \
+            "server total histogram carries no exemplars: %s" % ex
+        p99_tid = ex["trace_id"]
+        # exemplars must also render in OpenMetrics syntax
+        assert '# {trace_id="' in resp["text"], resp["text"][:400]
+
+        rc_daemon = _shutdown(sock, proc)
+        assert rc_daemon == 0, (rc_daemon, proc.stderr.read()[-2000:])
+
+        # -- resolve the exemplar to a complete span tree ------------
+        obs_dirs = [os.path.join(wd, "obs"),
+                    os.path.join(wd, "obs_client")]
+        spans, _ = obs_trace.collect_spans(obs_dirs)
+        traces = obs_trace.build_traces(spans)
+        result = obs_trace.analyze(obs_dirs)
+        assert p99_tid in result["traces"], \
+            ("p99 exemplar trace not reconstructable", p99_tid,
+             sorted(result["traces"])[:5])
+        s = result["traces"][p99_tid]
+        assert s["n_orphans"] == 0, ("orphan spans in p99 trace", s)
+        assert s["root"] == "submit", s  # client submit is the root
+        names = {sp.get("name") for sp in traces[p99_tid].values()}
+        for need in ("submit", "request", "queue_wait", "fit",
+                     "checkpoint"):
+            assert need in names, (need, sorted(names))
+        # critical path partitions the root span exactly; vs the
+        # recorded request total (the exemplar's own observed value)
+        # it may differ by client socket overhead — bounded at 10%
+        # (+25 ms absolute slack for scheduler jitter on tiny fits)
+        cp_sum = sum(s["critical_path_s"].values())
+        assert abs(cp_sum - s["total_s"]) < 1e-6, (cp_sum, s)
+        assert abs(cp_sum - ex["value"]) <= 0.1 * ex["value"] + 0.025, \
+            (cp_sum, ex["value"])
+
+        # -- combined dispatch: ONE span, exactly K links ------------
+        dispatches = [sp for tr in traces.values()
+                      for sp in tr.values()
+                      if sp.get("name") == "dispatch"]
+        combined = [sp for sp in dispatches
+                    if int(sp.get("n_requests") or 1) > 1]
+        assert combined, "no combined (K>1) dispatch was recorded"
+        for sp in combined:
+            k = int(sp["n_requests"])
+            links = sp.get("links") or []
+            assert len(links) == k, (k, sp)
+        # the p99 request's trace must be reachable from some dispatch
+        # span through its links (fan-in audit)
+        linked_tids = {ln.get("trace_id") for sp in dispatches
+                       for ln in (sp.get("links") or [])}
+        assert p99_tid in linked_tids, \
+            ("p99 trace not linked from any dispatch", p99_tid)
+
+        # -- exports + report sections -------------------------------
+        perfetto = os.path.join(workroot, "trace.json")
+        rc = obs_trace.main(obs_dirs + ["--trace", p99_tid,
+                                        "--export", perfetto,
+                                        "--json"])
+        assert rc == 0
+        doc = json.load(open(perfetto))
+        assert doc["traceEvents"], "empty Chrome-trace export"
+
+        from tools.obs_report import summarize
+
+        obs_base = os.path.join(wd, "obs")
+        run = sorted(os.path.join(obs_base, d)
+                     for d in os.listdir(obs_base))[-1]
+        text = summarize(run)
+        assert "## slowest requests" in text, text
+
+        agg = obs_trace.aggregate_critical_path(
+            result["traces"].values())
+        breakdown = "  ".join(
+            "%s %.0f/%.0fms" % (ph, 1e3 * qs["p50"], 1e3 * qs["p99"])
+            for ph, qs in sorted(agg["phases"].items(),
+                                 key=lambda kv: -kv[1]["p99"])[:6])
+        print("trace smoke OK: p99 exemplar %s -> %d-span orphan-free "
+              "tree (critical path == total to within %.1f%%), %d "
+              "combined dispatch(es) with exact K links; aggregate "
+              "critical path p50/p99: %s"
+              % (p99_tid[:16], s["n_spans"],
+                 100.0 * abs(cp_sum - ex["value"])
+                 / max(ex["value"], 1e-9),
+                 len(combined), breakdown))
+        return 0
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
