@@ -34,8 +34,10 @@ def build_parser():
     pl = sub.add_parser("plan", help="Scan archives into shape buckets.")
     pl.add_argument("-d", "--datafiles", required=True, metavar="meta",
                     help="Metafile of archive paths (or one archive).")
-    pl.add_argument("-m", "--modelfile", required=True, metavar="model",
-                    help="Model file the survey fits with.")
+    pl.add_argument("-m", "--modelfile", default=None, metavar="model",
+                    help="Model file the survey fits with (required "
+                         "at run time for the toas workload; doubles "
+                         "as the align initial-guess template).")
     pl.add_argument("-w", "--workdir", required=True,
                     help="Survey working directory (created).")
 
@@ -44,6 +46,26 @@ def build_parser():
             ("resume", "Alias of run: continue a killed survey.")):
         r = sub.add_parser(name, help=help_text)
         r.add_argument("-w", "--workdir", required=True)
+        r.add_argument("--workload", default=None, metavar="NAME",
+                       help="What a claimed archive means "
+                            "(runner/workloads.py): toas (default), "
+                            "zap, align, modelfit, or any registered "
+                            "name.  One workdir can chain workloads "
+                            "(zap, then align, then toas) — each "
+                            "keeps its own ledger records and "
+                            "checkpoints.")
+        r.add_argument("--workload_opt", action="append", default=[],
+                       metavar="KEY=VALUE", dest="workload_opts",
+                       help="Workload constructor option (repeatable; "
+                            "values parse as JSON, else strings): "
+                            "e.g. --workload zap --workload_opt "
+                            "nstd=5, --workload align --workload_opt "
+                            "niter=2.")
+        r.add_argument("-m", "--modelfile", default=None,
+                       metavar="model",
+                       help="Override the plan's model file (also the "
+                            "align workload's initial-guess "
+                            "template).")
         r.add_argument("--process", type=int, default=None,
                        help="Simulated process index (default: ask the "
                             "jax runtime).")
@@ -150,30 +172,57 @@ def _cmd_plan(args):
     return 0
 
 
+def _parse_workload_opts(pairs):
+    """--workload_opt KEY=VALUE list -> constructor kwargs; values
+    parse as JSON when they can (numbers, booleans, lists), else stay
+    strings."""
+    opts = {}
+    for pair in pairs or []:
+        key, sep, value = pair.partition("=")
+        if not sep or not key:
+            raise SystemExit(
+                "ppsurvey: --workload_opt wants KEY=VALUE, got %r"
+                % pair)
+        try:
+            opts[key] = json.loads(value)
+        except json.JSONDecodeError:
+            opts[key] = value
+    return opts
+
+
 def _cmd_run(args):
     from ..runner.execute import run_survey
+    from ..runner.queue import DEFAULT_WORKLOAD
 
     plan = _plan_path(args.workdir)
     if not os.path.isfile(plan):
         print(f"ppsurvey: no plan at {plan} — run 'ppsurvey plan' "
               "first.", file=sys.stderr)
         return 1
-    # driver-specific fit kwargs: the narrowband driver has no bary
-    # (per-channel TOAs are referenced at each channel's frequency)
-    fit_kw = dict(tscrunch=args.tscrunch, fit_scat=args.fit_scat,
-                  nonfinite_max_frac=args.nonfinite_max_frac)
-    if not args.narrowband:
-        fit_kw["bary"] = args.bary
+    workload = args.workload or DEFAULT_WORKLOAD
+    fit_kw = {}
+    if workload == DEFAULT_WORKLOAD:
+        # driver-specific fit kwargs: the narrowband driver has no
+        # bary (per-channel TOAs are referenced at each channel's
+        # frequency); other workloads configure via --workload_opt
+        fit_kw = dict(tscrunch=args.tscrunch, fit_scat=args.fit_scat,
+                      nonfinite_max_frac=args.nonfinite_max_frac)
+        if not args.narrowband:
+            fit_kw["bary"] = args.bary
     summary = run_survey(
-        plan, args.workdir, process_index=args.process,
+        plan, args.workdir, modelfile=args.modelfile,
+        process_index=args.process,
         process_count=args.processes, max_attempts=args.max_attempts,
         backoff_s=args.backoff, use_mesh=args.use_mesh,
         merge=args.merge, max_archives=args.max_archives,
         trace_bucket=args.trace_bucket, watchdog_s=args.watchdog_s,
         barrier_timeout_s=args.barrier_timeout_s,
         lease_s=args.lease_s, narrowband=args.narrowband,
+        workload=workload,
+        workload_opts=_parse_workload_opts(args.workload_opts),
         quiet=args.quiet, **fit_kw)
-    out = {"counts": summary["counts"],
+    out = {"workload": summary.get("workload", workload),
+           "counts": summary["counts"],
            "quarantined": summary["quarantined"],
            "checkpoint": summary["checkpoint"]}
     if summary.get("drained"):
@@ -226,6 +275,7 @@ def _cmd_status(args):
     # who owns what, each lease's time-to-expiry, and the expired
     # leases a resume of any process count would take over
     print(json.dumps({"counts": status["counts"],
+                      "workloads": status.get("workloads", {}),
                       "quarantined": [
                           {"archive": a, "reason": r}
                           for a, r in status["quarantined"]],
@@ -267,6 +317,15 @@ def _cmd_report(args):
     print("\n## survey state")
     for k, v in sorted(status["counts"].items()):
         print(f"- {k}: {v}")
+    workloads = status.get("workloads") or {}
+    if len(workloads) > 1 or (workloads
+                              and "toas" not in workloads):
+        print("\n## per-workload state")
+        for wl in sorted(workloads):
+            nonzero = {k: v for k, v in sorted(workloads[wl].items())
+                       if v}
+            line = ", ".join("%s %d" % kv for kv in nonzero.items())
+            print(f"- {wl}: {line or '(empty)'}")
     if status["quarantined"]:
         print("\n## quarantined archives")
         for archive, reason in status["quarantined"]:

@@ -34,26 +34,89 @@ __all__ = ["write_shard", "merge_obs_shards", "list_shards"]
 _SHARD_RE = re.compile(r"^events\.(\d+)\.jsonl(?:\.(\d+))?$")
 
 
+def _advance_shard(shards_dir, proc):
+    """Rotate an existing shard set aside before publishing a new run.
+
+    A workdir that chains sequential runs (the workload engine's
+    zap→align→toas surveys share one workdir) calls ``write_shard``
+    once per run with the same ``proc``; without rotation each call
+    would overwrite the previous run's shard and the merged report
+    would only show the last workload.  The previous live files move
+    to the next free rotation index (rotation order is oldest-first,
+    matching in-run size rotation), and the caller offsets the new
+    run's own rotated files past them.  Returns the base index for
+    the new run's rotated files.
+    """
+    live = os.path.join(shards_dir, "events.%d.jsonl" % proc)
+    max_rot = 0
+    try:
+        for name in os.listdir(shards_dir):
+            m = _SHARD_RE.match(name)
+            if m and int(m.group(1)) == proc and m.group(2):
+                max_rot = max(max_rot, int(m.group(2)))
+    except OSError:
+        pass
+    if not os.path.isfile(live):
+        return max_rot
+    base = max_rot + 1
+    os.replace(live, live + ".%d" % base)
+    for name in ("manifest.%d.json" % proc,
+                 "metrics.%d.jsonl" % proc):
+        src = os.path.join(shards_dir, name)
+        if os.path.isfile(src):
+            os.replace(src, src + ".%d" % base)
+    return base
+
+
+def _rotated_paths(shards_dir, base_name):
+    """[oldest-first rotated copies..., live] for a shard-side file
+    (``manifest.<proc>.json``, ``metrics.<proc>.jsonl``)."""
+    out = []
+    try:
+        names = os.listdir(shards_dir)
+    except OSError:
+        names = []
+    rot = []
+    for name in names:
+        if name.startswith(base_name + "."):
+            tail = name[len(base_name) + 1:]
+            if tail.isdigit():
+                rot.append((int(tail), name))
+    out.extend(os.path.join(shards_dir, n) for _, n in sorted(rot))
+    live = os.path.join(shards_dir, base_name)
+    if os.path.isfile(live):
+        out.append(live)
+    return out
+
+
 def write_shard(run_dir, shards_dir, proc):
     """Copy a closed per-process run into the shared shard layout.
 
     Rotated event files keep their rotation index; the manifest is
-    copied as ``manifest.<proc>.json``.  Returns the list of files
-    written.
+    copied as ``manifest.<proc>.json``.  A shard already present for
+    ``proc`` (an earlier sequential run in the same workdir, e.g. a
+    prior workload pass) is advanced to rotated copies first, so
+    chained runs accumulate instead of overwriting.  Returns the list
+    of files written.
     """
     os.makedirs(shards_dir, exist_ok=True)
+    base = _advance_shard(shards_dir, proc)
     written = []
     for src in list_event_files(run_dir):
-        base = os.path.basename(src)          # events.jsonl[.N]
-        suffix = base[len("events.jsonl"):]   # "" or ".N"
+        name = os.path.basename(src)          # events.jsonl[.N]
+        suffix = name[len("events.jsonl"):]   # "" or ".N"
+        if suffix and base:
+            # keep global oldest-first order: this run's own rotation
+            # indices shift past the previous runs' copies
+            suffix = ".%d" % (base + int(suffix[1:]))
         dst = os.path.join(shards_dir,
                            "events.%d.jsonl%s" % (proc, suffix))
         with open(src, "rb") as sf, open(dst, "wb") as df:
             df.write(sf.read())
         written.append(dst)
-    for base, pattern in (("manifest.json", "manifest.%d.json"),
+    for name, pattern in (("manifest.json", "manifest.%d.json"),
                           ("metrics.jsonl", "metrics.%d.jsonl")):
-        src = os.path.join(run_dir, base)
+        src = os.path.join(run_dir, name)
         if os.path.isfile(src):
             dst = os.path.join(shards_dir, pattern % proc)
             with open(src, "rb") as sf, open(dst, "wb") as df:
@@ -84,6 +147,71 @@ def list_shards(shards_dir):
         out[proc] = [os.path.join(shards_dir, n)
                      for _, n in rotated] + \
                     [os.path.join(shards_dir, n) for n in live]
+    return out
+
+
+def _fold_snapshots(snaps):
+    """Fold ONE process's sequential-run metrics snapshots into one:
+    counters and histograms sum by identical series key (exact integer
+    bucket sums), gauges last-write-wins with NO process prefix —
+    unlike :func:`..metrics.merge_snapshots`, which is for DIFFERENT
+    processes."""
+    from .metrics import Histogram
+
+    if len(snaps) == 1:
+        return snaps[0]
+    counters = {}
+    gauges = {}
+    hists = {}
+    t = 0.0
+    for s in snaps:
+        t = max(t, float(s.get("t", 0.0) or 0.0))
+        for k, v in (s.get("counters") or {}).items():
+            if isinstance(v, (int, float)):
+                counters[k] = counters.get(k, 0) + v
+        gauges.update(s.get("gauges") or {})
+        for k, h in (s.get("histograms") or {}).items():
+            cur = hists.get(k)
+            if cur is None:
+                hists[k] = Histogram.from_snapshot(h)
+            else:
+                cur.merge(Histogram.from_snapshot(h))
+    out = dict(snaps[-1])
+    out.update(t=t, counters=counters, gauges=gauges,
+               histograms={k: h.to_snapshot()
+                           for k, h in sorted(hists.items())})
+    return out
+
+
+def _fold_manifests(docs):
+    """Fold ONE process's sequential-run manifests (oldest-first) into
+    one: counters/walls/compile totals sum, configs and gauges update
+    newest-wins, identity fields (platform, git SHA, name) come from
+    the newest run, ``t_start`` from the oldest."""
+    if len(docs) == 1:
+        return docs[0]
+    out = dict(docs[-1])
+    counters = {}
+    gauges = {}
+    config = {}
+    wall = 0.0
+    compile_total = 0.0
+    t_start = None
+    for m in docs:
+        for k, v in (m.get("counters") or {}).items():
+            if isinstance(v, (int, float)):
+                counters[k] = counters.get(k, 0) + v
+        gauges.update(m.get("gauges") or {})
+        config.update(m.get("config") or {})
+        wall += float(m.get("wall_s", 0.0) or 0.0)
+        compile_total += float(m.get("compile_total_s", 0.0) or 0.0)
+        ts = m.get("t_start")
+        if ts is not None:
+            t_start = ts if t_start is None else min(t_start, ts)
+    out.update(counters=counters, gauges=gauges, config=config,
+               wall_s=wall, compile_total_s=round(compile_total, 6))
+    if t_start is not None:
+        out["t_start"] = t_start
     return out
 
 
@@ -146,13 +274,20 @@ def merge_obs_shards(shards_dir, out_dir):
 
     shard_snaps = {}
     for proc in sorted(shards):
-        mpath = os.path.join(shards_dir, "metrics.%d.jsonl" % proc)
-        snaps = [s for s in _read_events(mpath)
-                 if isinstance(s, dict)
-                 and (s.get("histograms") is not None
-                      or s.get("counters") is not None)]
-        if snaps:
-            shard_snaps[proc] = snaps[-1]
+        # one last-snapshot per metrics file: the live copy plus any
+        # rotated copies from earlier chained runs, folded WITHOUT the
+        # per-process gauge prefix (they are all this proc's)
+        per_run = []
+        for mpath in _rotated_paths(shards_dir,
+                                    "metrics.%d.jsonl" % proc):
+            snaps = [s for s in _read_events(mpath)
+                     if isinstance(s, dict)
+                     and (s.get("histograms") is not None
+                          or s.get("counters") is not None)]
+            if snaps:
+                per_run.append(snaps[-1])
+        if per_run:
+            shard_snaps[proc] = _fold_snapshots(per_run)
     if shard_snaps:
         merged_snap = _metrics.merge_snapshots(shard_snaps)
         with open(os.path.join(out_dir, "metrics.jsonl"), "w",
@@ -161,13 +296,20 @@ def merge_obs_shards(shards_dir, out_dir):
 
     manifests = {}
     for proc in sorted(shards):
-        mpath = os.path.join(shards_dir, "manifest.%d.json" % proc)
-        if os.path.isfile(mpath):
+        # fold this proc's manifests oldest-first: rotated copies from
+        # earlier chained runs, then the live one.  Counters and walls
+        # sum (the runs were sequential), configs/gauges update so the
+        # newest run wins, identity fields come from the newest run.
+        docs = []
+        for mpath in _rotated_paths(shards_dir,
+                                    "manifest.%d.json" % proc):
             try:
                 with open(mpath, encoding="utf-8") as fh:
-                    manifests[proc] = json.load(fh)
+                    docs.append(json.load(fh))
             except (OSError, json.JSONDecodeError):
                 pass
+        if docs:
+            manifests[proc] = _fold_manifests(docs)
 
     counters = {}
     gauges = {}

@@ -184,9 +184,14 @@ class Histogram:
                 self.over += 1
             else:
                 self.counts[i] = self.counts.get(i, 0) + 1
-            if exemplar and i >= 0:
-                ex = self.exemplars.setdefault(min(i, self.n_buckets),
-                                               [])
+            if exemplar:
+                # clamp to [0, n_buckets]: an under-range value keeps
+                # its exemplar on the first bucket (the rendered
+                # cumulative bucket 0 already counts ``under``), the
+                # symmetric move to the over bucket above — a traced
+                # sub-resolution observation must stay traceable
+                ex = self.exemplars.setdefault(
+                    max(0, min(i, self.n_buckets)), [])
                 ex.append({"trace_id": str(exemplar), "value": v})
                 del ex[:-EXEMPLARS_PER_BUCKET]
             self.count += 1
@@ -830,6 +835,32 @@ def render_watch(snap, prev=None, title=""):
             lines.append("%-12s %8d %8s %9s %9s %9s %9s" % (
                 phase, h.count, rate,
                 _fmt_lat(h.quantile(0.5)), _fmt_lat(h.quantile(0.9)),
+                _fmt_lat(h.quantile(0.99)), _fmt_lat(h.max)))
+
+    # per-workload breakdown (the survey engine labels every phase
+    # sample with its workload): only shown when the snapshot carries
+    # more than the default single workload, so plain TOA surveys and
+    # the service keep their original frame
+    by_wl = {}
+    for key, h in hists.items():
+        name, labels = parse_series(key)
+        if name != PHASE_HISTOGRAM or "workload" not in labels:
+            continue
+        k2 = (labels["workload"], labels.get("phase", "?"))
+        cur = by_wl.get(k2)
+        if cur is None:
+            by_wl[k2] = Histogram.from_snapshot(h)
+        else:
+            cur.merge(Histogram.from_snapshot(h))
+    if len({wl for wl, _ in by_wl}) > 1:
+        lines.append("")
+        lines.append("%-12s %-10s %8s %9s %9s %9s" %
+                     ("workload", "phase", "n", "p50", "p99", "max"))
+        for wl, phase in sorted(by_wl):
+            h = by_wl[(wl, phase)]
+            lines.append("%-12s %-10s %8d %9s %9s %9s" % (
+                wl, phase, h.count,
+                _fmt_lat(h.quantile(0.5)),
                 _fmt_lat(h.quantile(0.99)), _fmt_lat(h.max)))
 
     gauges = snap.get("gauges") or {}

@@ -29,7 +29,16 @@ ONE process of a (possibly multi-process) job:
   (``obs_shards/events.<proc>.jsonl``); process 0 merges the shards
   into one report (``obs/merge.py``) after a barrier on real
   multihost runs, and a barrier straggler's leases are revoked from
-  its ``BarrierTimeout.missing`` ids.
+  its ``BarrierTimeout.missing`` ids;
+* the loop itself is workload-agnostic (``runner/workloads.py``):
+  ``workload=`` selects what a claimed archive *means* — ``toas``
+  (the default, bit-identical to the engine's original behavior),
+  ``zap``, ``align`` (multi-pass, with a per-iteration reduce), or
+  ``modelfit`` — while the ledger/lease/checkpoint/reconcile/obs
+  machinery stays exactly the same.  Every ledger record, lease row,
+  metric sample, and span carries the ``workload`` label, so one
+  workdir can chain zap→align→toas with exactly-once semantics per
+  (archive, workload).
 
 With more than one local device, each bucket's batched fit is sharded
 over a ('subint', 'chan') mesh via :func:`make_mesh_fitter`
@@ -53,11 +62,10 @@ from .. import obs
 from ..obs import metrics, tracing
 from ..obs.merge import merge_obs_shards, write_shard
 from ..obs.metrics import PHASE_HISTOGRAM
-from ..pipelines.toas import (GetTOAs, _resume_checkpoint,
-                              drop_checkpoint_blocks)
+from ..pipelines.toas import GetTOAs, drop_checkpoint_blocks
 from .plan import SurveyPlan, pad_databunch
-from .queue import DONE, FAILED, QUARANTINED, RUNNING, WorkQueue, \
-    owner_pid
+from .queue import DEFAULT_WORKLOAD, DONE, FAILED, QUARANTINED, \
+    RUNNING, WorkQueue, owner_pid
 
 __all__ = ["run_survey", "make_mesh_fitter", "survey_status",
            "abandoned_workers"]
@@ -277,13 +285,12 @@ class _LeaseHeartbeat:
         self._t.join(2.0)
 
 
-def _reconcile(queue, workdir, pid, assigned_paths, quiet=True):
-    """Make the union ledger and MY .tim checkpoint agree before
-    fitting.  Disagreements REFIT rather than silently skip
-    (docs/RUNNER.md):
+def _reconcile(wl, queue, checkpoint, pid, assigned_paths, quiet=True):
+    """Make the union ledger and MY checkpoint agree before fitting.
+    Disagreements REFIT rather than silently skip (docs/RUNNER.md):
 
     * ledger ``done`` with the block recorded in MY checkpoint
-      (``ckpt == pid``) but no complete block there -> the TOAs are
+      (``ckpt == pid``) but no complete block there -> the results are
       lost (crash between fit and append) -> reset to pending;
     * block present in MY checkpoint but the ledger does not confirm
       it as mine -> half-trusted (crash between the two appends, or a
@@ -291,13 +298,14 @@ def _reconcile(queue, workdir, pid, assigned_paths, quiet=True):
       skip, never duplicate.
 
     ``done`` records owned by OTHER processes are trusted as-is: their
-    blocks live in their own ``toas.<pid>.tim`` (the final survey TOAs
-    are the union of all checkpoints), and a takeover additionally
-    scrubs the previous owner's block at claim time.
+    blocks live in their own checkpoint (the final survey results are
+    the union of all checkpoints), and a takeover additionally scrubs
+    the previous owner's block at claim time.  The checkpoint protocol
+    (block read/drop) is the workload's: ``.tim`` block+marker for
+    ``toas``, one-JSONL-line-per-archive for the rest
+    (runner/workloads.py).
     """
-    checkpoint = _ckpt_path(workdir, pid)
-    done_ckpt = _resume_checkpoint(checkpoint, quiet) \
-        if os.path.isfile(checkpoint) else set()
+    done_ckpt = wl.resume_done(checkpoint, quiet)
     to_drop = []
     for path in assigned_paths:
         key = queue.key_for(path)
@@ -326,20 +334,22 @@ def _reconcile(queue, workdir, pid, assigned_paths, quiet=True):
             obs.event("runner_reconcile", archive=path,
                       action="refit", cause="ledger_not_done")
     if to_drop:
-        drop_checkpoint_blocks(checkpoint, to_drop)
+        wl.drop_blocks(checkpoint, to_drop)
         if not quiet:
             print(f"reconcile: dropped {len(to_drop)} checkpoint "
                   "block(s) the ledger does not confirm as this "
                   "process's; refitting where needed.")
 
 
-def _lease_lost(queue, info, checkpoint, wrote_block):
+def _lease_lost(queue, info, checkpoint, wrote_block,
+                drop=drop_checkpoint_blocks):
     """The lease was taken over mid-fit: abandon with NO ledger
     transition (the taker owns the archive's state now) and drop any
     block this fit just wrote so a re-claimed archive never
-    double-writes a checkpoint block."""
+    double-writes a checkpoint block.  ``drop`` is the workload's
+    block-drop protocol (the ``.tim`` one by default)."""
     if wrote_block:
-        drop_checkpoint_blocks(checkpoint, [info.path])
+        drop(checkpoint, [info.path])
     cur = queue.record(info.path) or {}
     obs.event("lease_lost", archive=info.path, owner=queue.owner,
               new_owner=cur.get("owner"),
@@ -408,14 +418,15 @@ def _fit_one(gt, queue, info, checkpoint, padded, get_toas_kw, quiet,
             rec = queue.complete(info.path,
                                  n_toas=int(len(gt.TOA_list) - n_toa0))
     obs.event("runner_archive", archive=info.path,
+              workload=queue.workload,
               state=rec["state"], attempts=rec.get("attempts", 0),
               reason=rec.get("reason"))
     return rec["state"]
 
 
-def _fit_one_guarded(gt, queue, info, checkpoint, padded, get_toas_kw,
-                     quiet, watchdog_s, narrowband=False):
-    """:func:`_fit_one`, bounded by a dispatch watchdog.
+def _fit_one_guarded(wl, state, queue, info, checkpoint, padded, quiet,
+                     watchdog_s):
+    """The workload's ``fit_one``, bounded by a dispatch watchdog.
 
     With ``watchdog_s`` unset this is a plain call.  Otherwise the fit
     runs in a daemon worker thread joined with the timeout, so a hang
@@ -426,14 +437,14 @@ def _fit_one_guarded(gt, queue, info, checkpoint, padded, get_toas_kw,
     has settled the archive; injected hangs release themselves as
     :class:`~..testing.faults.InjectedFault` (testing/faults.py), and
     a genuinely wedged dispatch never returns and dies with the
-    process.  Returns ``(state, gt_poisoned)``: ``gt_poisoned`` means
-    the bucket's GetTOAs instance may still be touched by the
-    abandoned worker and must be discarded by the caller.
+    process.  Returns ``(final_state, state_poisoned)``:
+    ``state_poisoned`` means the bucket's warm state (e.g. the toas
+    GetTOAs instance) may still be touched by the abandoned worker and
+    must be discarded by the caller.
     """
     if not watchdog_s:
-        return _fit_one(gt, queue, info, checkpoint, padded,
-                        get_toas_kw, quiet,
-                        narrowband=narrowband), False
+        return wl.fit_one(state, queue, info, checkpoint, padded,
+                          quiet), False
     cancelled = threading.Event()
     box = {}
     # the watchdog worker is a fresh thread: adopt this archive's
@@ -443,10 +454,9 @@ def _fit_one_guarded(gt, queue, info, checkpoint, padded, get_toas_kw,
     def _work():
         try:
             with tracing.activate(ctx):
-                box["state"] = _fit_one(gt, queue, info, checkpoint,
-                                        padded, get_toas_kw, quiet,
-                                        cancelled=cancelled,
-                                        narrowband=narrowband)
+                box["state"] = wl.fit_one(state, queue, info,
+                                          checkpoint, padded, quiet,
+                                          cancelled=cancelled)
         except BaseException as e:
             box["err"] = e
 
@@ -470,12 +480,22 @@ def _fit_one_guarded(gt, queue, info, checkpoint, padded, get_toas_kw,
             info.path,
             "watchdog: dispatch exceeded %.1fs" % watchdog_s)
         obs.event("runner_archive", archive=info.path,
+                  workload=queue.workload,
                   state=rec["state"], attempts=rec.get("attempts", 0),
                   reason=rec.get("reason"))
         return rec["state"], True
     if "err" in box:
         raise box["err"]
     return box.get("state"), False
+
+
+# per-archive record fields surfaced in survey manifests/status: the
+# engine's own state plus every workload's result fields
+_MANIFEST_FIELDS = ("state", "attempts", "reason", "n_toas", "owner",
+                    "lease_expires_at", "ckpt", "takeover_from",
+                    "prev_owner", "workload", "pre_fit", "n_zapped",
+                    "n_proposed", "n_rows", "part", "skipped", "model",
+                    "kind")
 
 
 def _write_survey_manifest(path, pid, nproc, queue, plan, extra=None):
@@ -485,15 +505,14 @@ def _write_survey_manifest(path, pid, nproc, queue, plan, extra=None):
         "n_processes": nproc,
         "owner": queue.owner,
         "t": time.time(),
+        "workload": queue.workload,
         "counts": queue.counts(),
+        "workloads": queue.counts_by_workload(),
         "n_buckets": len(plan.buckets),
         "quarantined": [{"archive": a, "reason": r}
                         for a, r in queue.quarantined()],
         "archives": {k: {f: v for f, v in rec.items()
-                         if f in ("state", "attempts", "reason",
-                                  "n_toas", "owner",
-                                  "lease_expires_at", "ckpt",
-                                  "takeover_from", "prev_owner")}
+                         if f in _MANIFEST_FIELDS}
                      for k, rec in queue.entries.items()},
     }
     doc.update(extra or {})
@@ -505,12 +524,16 @@ def _write_survey_manifest(path, pid, nproc, queue, plan, extra=None):
     return doc
 
 
-def _merge_survey_manifests(workdir, out_path):
+def _merge_survey_manifests(workdir, out_path,
+                            workload=DEFAULT_WORKLOAD):
     """Fold the per-process survey manifests into one survey.json.
 
     Counts/states come from a readonly union replay of every ledger
     shard (the single source of truth) — summing per-shard counts
     would double-count archives that several shards have seen.
+    ``workload`` picks whose per-archive records ``counts``/
+    ``archives`` describe (the workload just run); ``workloads``
+    always breaks the whole workdir down.
     """
     n_shards = 0
     for name in sorted(os.listdir(workdir)):
@@ -519,20 +542,19 @@ def _merge_survey_manifests(workdir, out_path):
             stem = name[len("survey."):-len(".json")]
             if stem.isdigit():
                 n_shards += 1
-    q = WorkQueue(None, readonly=True, union_dir=workdir)
+    q = WorkQueue(None, readonly=True, union_dir=workdir,
+                  workload=workload)
     try:
         doc = {"schema": "pptpu-survey-run-v1",
                "n_processes": n_shards,
                "t": time.time(),
+               "workload": q.workload,
                "counts": q.counts(),
+               "workloads": q.counts_by_workload(),
                "quarantined": [{"archive": a, "reason": r}
                                for a, r in q.quarantined()],
                "archives": {k: {f: v for f, v in rec.items()
-                                if f in ("state", "attempts", "reason",
-                                         "n_toas", "owner",
-                                         "lease_expires_at", "ckpt",
-                                         "takeover_from",
-                                         "prev_owner")}
+                                if f in _MANIFEST_FIELDS}
                             for k, rec in q.entries.items()}}
     finally:
         q.close()
@@ -549,7 +571,8 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                use_mesh=False, mesh=None, merge=True, max_archives=None,
                trace_bucket=False, watchdog_s=None,
                barrier_timeout_s=600.0, lease_s=600.0,
-               narrowband=False, quiet=True, **get_toas_kw):
+               narrowband=False, workload=None, workload_opts=None,
+               quiet=True, **get_toas_kw):
     """Execute (or resume) one process's share of a survey plan.
 
     ``plan`` is a SurveyPlan or a path to a saved plan.json.  All
@@ -613,21 +636,32 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
     where the device time went.  ``GetTOAs``'s own per-archive capture
     degrades to ``trace_skipped`` events inside the bucket capture
     (the profiler is a process-wide singleton).
+
+    ``workload`` selects what each claimed archive means
+    (runner/workloads.py): ``None``/"toas" (the default TOA survey),
+    "zap", "align", "modelfit", a registered name, or a ``Workload``
+    instance; ``workload_opts`` are constructor keywords for named
+    workloads.  A multi-pass workload (align with ``niter > 1``) runs
+    its passes sequentially under per-pass ledger workload labels
+    ("align", "align.i2", ...) inside this one call, each pass ending
+    with its reduce once the union ledger shows every archive settled
+    — the reduce is idempotent, so any process of any topology may
+    perform it.  ``**get_toas_kw`` is accepted only for ``toas``.
     """
     if isinstance(plan, str):
         plan = SurveyPlan.load(plan)
     modelfile = modelfile or plan.modelfile
-    if modelfile is None:
-        raise ValueError("run_survey needs a modelfile (argument or "
-                         "recorded on the plan)")
+    from .workloads import resolve_workload
+
+    wl = resolve_workload(workload, modelfile=modelfile,
+                          narrowband=narrowband,
+                          get_toas_kw=get_toas_kw, opts=workload_opts)
+    n_passes = max(1, int(wl.n_passes(plan)))
     pid, nproc, simulated = _resolve_process(process_index,
                                              process_count)
     os.makedirs(workdir, exist_ok=True)
     paths = _paths(workdir, pid)
     owner = "p%d@%d.%d" % (pid, os.getpid(), next(_RUN_SEQ))
-    queue = WorkQueue(paths["ledger"], max_attempts=max_attempts,
-                      backoff_s=backoff_s, union_dir=workdir,
-                      owner=owner, lease_s=lease_s, process_index=pid)
 
     from ..parallel.multihost import (BarrierTimeout, barrier,
                                       partition_indices,
@@ -642,13 +676,6 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
     in_pref = set(pref)
     order_idx = pref + [i for i in range(len(ordered))
                         if i not in in_pref]
-    queue.add([info.path for info, _ in ordered])
-    for path, reason in plan.unreadable:
-        # any process may quarantine plan-time unreadables (a survey
-        # resumed without process 0 must still record them)
-        if queue.state(path) != QUARANTINED:
-            queue.quarantine(path, "unreadable at plan time: %s"
-                             % reason)
 
     fitter = None
     if use_mesh:
@@ -688,12 +715,16 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
     except ValueError:
         prev_handlers = {}  # not the main thread: no graceful drain
 
-    hb = _LeaseHeartbeat(queue, lease_s / 3.0) if lease_s else None
+    queue = None
+    hb = None
+    checkpoint = None
     revoked = []
     try:
         with obs.run("ppsurvey", base_dir=paths["obs"],
                      config={"process": pid, "n_processes": nproc,
                              "owner": owner,
+                             "workload": wl.name,
+                             "n_passes": n_passes,
                              "n_archives": len(ordered),
                              "n_buckets": len(plan.buckets),
                              "modelfile": modelfile,
@@ -703,205 +734,289 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                              "narrowband": bool(narrowband),
                              "trace_bucket": bool(trace_bucket)}) as rec:
             t0 = time.perf_counter()
-            _reconcile(queue, workdir, pid,
-                       [info.path for info, _ in ordered], quiet)
-            gts = {}
             n_fit = 0
             stop = False
-            stalled = 0
-            tracer = contextlib.ExitStack()
-            cur_bucket = None
-            try:
-                while True:
-                    ran = 0
-                    for idx in order_idx:
-                        info, bucket = ordered[idx]
-                        if drain["sig"]:
-                            stop = True
-                        if stop or queue.state(info.path) in \
-                                (DONE, QUARANTINED):
-                            continue
-                        if not queue.ready(info.path):
-                            continue
-                        # -- lease claim (union-replay protocol) -----
-                        # sync the union view first: a sibling may have
-                        # claimed or even completed this archive since
-                        # the last refresh, and a claim layered on top
-                        # of an unseen ``done`` would win the (t, owner)
-                        # order and refit it
-                        blabel = "%dx%d" % bucket.key
-                        t_arch0 = time.perf_counter()
-                        # each archive's claim->fit->checkpoint runs
-                        # under its own trace (obs/tracing.py): the
-                        # ledger transitions and the .tim pp_done
-                        # marker carry the trace id, and the fit's
-                        # phase spans become children of the root
-                        # "archive" span emitted below
-                        trace_ctx = (tracing.new_trace_id(),
-                                     tracing.new_span_id())
-                        with tracing.activate(trace_ctx):
-                            queue.refresh()
-                            if queue.state(info.path) in \
-                                    (DONE, QUARANTINED) \
-                                    or not queue.ready(info.path):
+            pass_complete = True
+            for ipass in range(n_passes):
+                wlabel = wl.pass_label(ipass)
+                checkpoint = wl.checkpoint_path(workdir, pid, ipass)
+                # each pass gets its own ledger view (same shard
+                # files, per-pass ``workload`` label — pass k's
+                # records never contend with pass k-1's) and a
+                # heartbeat bound to that view
+                if hb is not None:
+                    hb.stop()
+                if queue is not None:
+                    queue.close()
+                queue = WorkQueue(paths["ledger"],
+                                  max_attempts=max_attempts,
+                                  backoff_s=backoff_s,
+                                  union_dir=workdir, owner=owner,
+                                  lease_s=lease_s, process_index=pid,
+                                  workload=wlabel)
+                hb = _LeaseHeartbeat(queue, lease_s / 3.0) \
+                    if lease_s else None
+                queue.add([info.path for info, _ in ordered])
+                for path, reason in plan.unreadable:
+                    # any process may quarantine plan-time unreadables
+                    # (a survey resumed without process 0 must still
+                    # record them)
+                    if queue.state(path) != QUARANTINED:
+                        queue.quarantine(
+                            path, "unreadable at plan time: %s"
+                            % reason)
+                _reconcile(wl, queue, checkpoint, pid,
+                           [info.path for info, _ in ordered], quiet)
+                wl.begin_pass(ipass, plan, workdir, quiet=quiet)
+                states = {}
+                stalled = 0
+                tracer = contextlib.ExitStack()
+                cur_bucket = None
+                try:
+                    while True:
+                        ran = 0
+                        for idx in order_idx:
+                            info, bucket = ordered[idx]
+                            if drain["sig"]:
+                                stop = True
+                            if stop or queue.state(info.path) in \
+                                    (DONE, QUARANTINED):
                                 continue
-                            prev_rec = queue.record(info.path) or {}
-                            was_held = prev_rec.get("state") == RUNNING
-                            claim = queue.claim(info.path)
-                            queue.refresh()
-                            if not queue.owns(info.path):
-                                # double-claim lost: the deterministic
-                                # (t, owner) union order elected the
-                                # other claimant — abandon with NO
-                                # transition
-                                obs.event("lease_claim_lost",
+                            if not queue.ready(info.path):
+                                continue
+                            # -- lease claim (union-replay protocol) -
+                            # sync the union view first: a sibling may
+                            # have claimed or even completed this
+                            # archive since the last refresh, and a
+                            # claim layered on top of an unseen
+                            # ``done`` would win the (t, owner) order
+                            # and refit it
+                            blabel = "%dx%d" % bucket.key
+                            t_arch0 = time.perf_counter()
+                            # each archive's claim->fit->checkpoint
+                            # runs under its own trace
+                            # (obs/tracing.py): the ledger transitions
+                            # and the checkpoint block carry the trace
+                            # id, and the fit's phase spans become
+                            # children of the root "archive" span
+                            # emitted below
+                            trace_ctx = (tracing.new_trace_id(),
+                                         tracing.new_span_id())
+                            with tracing.activate(trace_ctx):
+                                queue.refresh()
+                                if queue.state(info.path) in \
+                                        (DONE, QUARANTINED) \
+                                        or not queue.ready(info.path):
+                                    continue
+                                prev_rec = queue.record(info.path) \
+                                    or {}
+                                was_held = prev_rec.get("state") \
+                                    == RUNNING
+                                claim = queue.claim(
+                                    info.path,
+                                    **wl.claim_fields(queue, info))
+                                queue.refresh()
+                                if not queue.owns(info.path):
+                                    # double-claim lost: the
+                                    # deterministic (t, owner) union
+                                    # order elected the other claimant
+                                    # — abandon with NO transition
+                                    obs.event("lease_claim_lost",
+                                              archive=info.path,
+                                              owner=owner,
+                                              winner=(queue.record(
+                                                  info.path)
+                                                  or {}).get("owner"))
+                                    obs.counter("lease_claims_lost")
+                                    continue
+                                if was_held:
+                                    obs.event(
+                                        "lease_expired",
+                                        archive=info.path,
+                                        prev_owner=prev_rec.get(
+                                            "owner"),
+                                        lease_expires_at=prev_rec.get(
+                                            "lease_expires_at"))
+                                    obs.counter("leases_expired")
+                                takeover = claim.get("takeover_from")
+                                n_scrubbed = 0
+                                if takeover:
+                                    ppid = owner_pid(takeover)
+                                    if ppid is not None \
+                                            and ppid != pid:
+                                        # the previous owner may have
+                                        # died between its checkpoint
+                                        # flush and the ledger append:
+                                        # scrub its block so the refit
+                                        # cannot double-write
+                                        n_scrubbed = wl.drop_blocks(
+                                            wl.checkpoint_path(
+                                                workdir, ppid, ipass),
+                                            [info.path])
+                                    obs.counter("lease_takeovers")
+                                obs.event("lease_claimed",
                                           archive=info.path,
                                           owner=owner,
-                                          winner=(queue.record(
-                                              info.path)
-                                              or {}).get("owner"))
-                                obs.counter("lease_claims_lost")
-                                continue
-                            if was_held:
-                                obs.event(
-                                    "lease_expired", archive=info.path,
-                                    prev_owner=prev_rec.get("owner"),
-                                    lease_expires_at=prev_rec.get(
-                                        "lease_expires_at"))
-                                obs.counter("leases_expired")
-                            takeover = claim.get("takeover_from")
-                            n_scrubbed = 0
-                            if takeover:
-                                ppid = owner_pid(takeover)
-                                if ppid is not None and ppid != pid:
-                                    # the previous owner may have died
-                                    # between its checkpoint flush and
-                                    # the ledger append: scrub its
-                                    # block so the refit cannot
-                                    # double-write
-                                    n_scrubbed = drop_checkpoint_blocks(
-                                        _ckpt_path(workdir, ppid),
-                                        [info.path])
-                                obs.counter("lease_takeovers")
-                            obs.event("lease_claimed",
-                                      archive=info.path,
-                                      owner=owner,
-                                      lease_expires_at=claim.get(
-                                          "lease_expires_at"),
-                                      takeover_from=takeover,
-                                      blocks_scrubbed=n_scrubbed
-                                      or None,
-                                      attempts=claim.get("attempts",
-                                                         0))
-                            obs.counter("leases_claimed")
-                            # claim latency: union refresh + ledger
-                            # append + takeover scrub for this archive
-                            claim_s = time.perf_counter() - t_arch0
-                            metrics.observe(PHASE_HISTOGRAM, claim_s,
-                                            phase="claim",
-                                            bucket=blabel)
-                            tracing.emit_span("claim", claim_s,
-                                              archive=info.path)
-                            # -- bucketed fit ------------------------
-                            gt = gts.get(bucket.key)
-                            if gt is None:
-                                gt = _BucketedGetTOAs(
-                                    [i.path for i, b in ordered
-                                     if b.key == bucket.key],
-                                    modelfile, bucket.key, quiet=quiet)
-                                gt.fit_batch = fitter
-                                gts[bucket.key] = gt
-                            if trace_base is not None \
-                                    and bucket.key != cur_bucket:
-                                tracer.close()  # stop + ingest prev
-                                tracer = contextlib.ExitStack()
-                                tracer.enter_context(obs.trace_capture(
-                                    "bucket_%dx%d" % bucket.key,
-                                    base_dir=trace_base))
-                                cur_bucket = bucket.key
-                            padded = (info.nchan,
-                                      info.nbin) != bucket.key
-                            hold = hb.hold(info.path) \
-                                if hb is not None \
-                                else contextlib.nullcontext()
-                            with hold:
-                                with metrics.timed(PHASE_HISTOGRAM,
-                                                   phase="fit",
-                                                   bucket=blabel), \
-                                        obs.span("fit",
-                                                 archive=info.path,
-                                                 bucket=blabel):
-                                    _, gt_poisoned = _fit_one_guarded(
-                                        gt, queue, info,
-                                        paths["checkpoint"], padded,
-                                        get_toas_kw, quiet, watchdog_s,
-                                        narrowband=narrowband)
-                            arch_s = time.perf_counter() - t_arch0
-                            metrics.observe(PHASE_HISTOGRAM, arch_s,
-                                            phase="archive",
-                                            bucket=blabel)
-                            # the root span of this archive's trace:
-                            # children (claim/fit/...) reference its
-                            # pre-allocated id
-                            tracing.emit_span(
-                                "archive", arch_s,
-                                ctx=(trace_ctx[0], None),
-                                span_id=trace_ctx[1],
-                                archive=info.path, bucket=blabel,
-                                owner=owner)
-                        if gt_poisoned:
-                            # the abandoned worker may still touch this
-                            # instance; retries get a fresh one
-                            gts.pop(bucket.key, None)
-                        ran += 1
-                        n_fit += 1
-                        if max_archives is not None \
-                                and n_fit >= max_archives:
-                            stop = True
-                    outstanding = queue.outstanding()
-                    metrics.set_gauge("pps_outstanding",
-                                      len(outstanding))
-                    if stop or drain["sig"] or not outstanding:
-                        break
-                    if ran:
-                        stalled = 0
-                        continue
-                    # everything left is backing off or leased to
-                    # another process; wait for the earliest retry or
-                    # lease expiry (so a survivor takes over a dead
-                    # sibling's work IN this run), unless nothing will
-                    # ever become ready.  Sleep in slices so a drain
-                    # signal is honored promptly.
-                    now = time.time()
-                    waits = []
-                    for k in outstanding:
-                        entry = queue.entries[k]
-                        if entry["state"] == FAILED:
-                            waits.append(entry.get("retry_at", 0.0)
-                                         - now)
-                        elif entry["state"] == RUNNING \
-                                and entry.get("owner") != owner:
-                            exp = entry.get("lease_expires_at")
-                            waits.append(0.0 if exp is None
-                                         else exp - now)
-                    if not waits:
-                        break
-                    deadline = now + max(0.0, min(waits))
-                    while time.time() < deadline \
-                            and not drain["sig"]:
-                        time.sleep(min(0.2, deadline - time.time()))
-                    n_new = queue.refresh()
-                    # a live sibling renewing or completing IS
-                    # progress; only a dead-still union view counts
-                    # toward the stall cap (a backstop against claim
-                    # ping-pong, never hit in healthy runs)
-                    stalled = 0 if n_new else stalled + 1
-                    if stalled > max(8, 2 * queue.max_attempts + 4):
-                        obs.event("runner_stalled",
-                                  outstanding=len(outstanding))
-                        break
-            finally:
-                tracer.close()  # stop + ingest the last bucket capture
+                                          lease_expires_at=claim.get(
+                                              "lease_expires_at"),
+                                          takeover_from=takeover,
+                                          blocks_scrubbed=n_scrubbed
+                                          or None,
+                                          attempts=claim.get(
+                                              "attempts", 0))
+                                obs.counter("leases_claimed")
+                                # claim latency: union refresh +
+                                # ledger append + takeover scrub
+                                claim_s = time.perf_counter() - t_arch0
+                                metrics.observe(PHASE_HISTOGRAM,
+                                                claim_s, phase="claim",
+                                                bucket=blabel,
+                                                workload=wlabel)
+                                tracing.emit_span("claim", claim_s,
+                                                  archive=info.path)
+                                # -- bucketed fit --------------------
+                                # warm per-bucket state (the toas
+                                # GetTOAs + fitter; None for
+                                # stateless workloads) — at most one
+                                # compiled program set per (workload,
+                                # shape bucket)
+                                if bucket.key not in states:
+                                    states[bucket.key] = \
+                                        wl.make_bucket_state(
+                                            bucket, ordered, fitter,
+                                            quiet=quiet)
+                                if trace_base is not None \
+                                        and bucket.key != cur_bucket:
+                                    tracer.close()  # stop+ingest prev
+                                    tracer = contextlib.ExitStack()
+                                    tracer.enter_context(
+                                        obs.trace_capture(
+                                            "bucket_%dx%d"
+                                            % bucket.key,
+                                            base_dir=trace_base))
+                                    cur_bucket = bucket.key
+                                padded = (info.nchan,
+                                          info.nbin) != bucket.key
+                                hold = hb.hold(info.path) \
+                                    if hb is not None \
+                                    else contextlib.nullcontext()
+                                with hold:
+                                    with metrics.timed(
+                                            PHASE_HISTOGRAM,
+                                            phase="fit",
+                                            bucket=blabel,
+                                            workload=wlabel), \
+                                            obs.span(
+                                                "fit",
+                                                archive=info.path,
+                                                bucket=blabel,
+                                                workload=wlabel):
+                                        _, st_poisoned = \
+                                            _fit_one_guarded(
+                                                wl,
+                                                states[bucket.key],
+                                                queue, info,
+                                                checkpoint, padded,
+                                                quiet, watchdog_s)
+                                arch_s = time.perf_counter() - t_arch0
+                                metrics.observe(PHASE_HISTOGRAM,
+                                                arch_s,
+                                                phase="archive",
+                                                bucket=blabel,
+                                                workload=wlabel)
+                                # the root span of this archive's
+                                # trace: children (claim/fit/...)
+                                # reference its pre-allocated id
+                                tracing.emit_span(
+                                    "archive", arch_s,
+                                    ctx=(trace_ctx[0], None),
+                                    span_id=trace_ctx[1],
+                                    archive=info.path, bucket=blabel,
+                                    workload=wlabel, owner=owner)
+                            if st_poisoned:
+                                # the abandoned worker may still touch
+                                # this state; retries get a fresh one
+                                states.pop(bucket.key, None)
+                            ran += 1
+                            n_fit += 1
+                            if max_archives is not None \
+                                    and n_fit >= max_archives:
+                                stop = True
+                        outstanding = queue.outstanding()
+                        metrics.set_gauge("pps_outstanding",
+                                          len(outstanding))
+                        if stop or drain["sig"] or not outstanding:
+                            break
+                        if ran:
+                            stalled = 0
+                            continue
+                        # everything left is backing off or leased to
+                        # another process; wait for the earliest retry
+                        # or lease expiry (so a survivor takes over a
+                        # dead sibling's work IN this run), unless
+                        # nothing will ever become ready.  Sleep in
+                        # slices so a drain signal is honored
+                        # promptly.
+                        now = time.time()
+                        waits = []
+                        for k in outstanding:
+                            entry = queue.entries[k]
+                            if entry["state"] == FAILED:
+                                waits.append(entry.get("retry_at", 0.0)
+                                             - now)
+                            elif entry["state"] == RUNNING \
+                                    and entry.get("owner") != owner:
+                                exp = entry.get("lease_expires_at")
+                                waits.append(0.0 if exp is None
+                                             else exp - now)
+                        if not waits:
+                            break
+                        deadline = now + max(0.0, min(waits))
+                        while time.time() < deadline \
+                                and not drain["sig"]:
+                            time.sleep(min(0.2,
+                                           deadline - time.time()))
+                        n_new = queue.refresh()
+                        # a live sibling renewing or completing IS
+                        # progress; only a dead-still union view
+                        # counts toward the stall cap (a backstop
+                        # against claim ping-pong, never hit in
+                        # healthy runs)
+                        stalled = 0 if n_new else stalled + 1
+                        if stalled > max(8,
+                                         2 * queue.max_attempts + 4):
+                            obs.event("runner_stalled",
+                                      outstanding=len(outstanding))
+                            break
+                finally:
+                    tracer.close()  # stop + ingest last bucket capture
+                # -- end of pass: the reduce --------------------------
+                # a pass is settled once the union ledger shows no
+                # pending/running/failed archive for its workload
+                # label; only then may the (idempotent) reduce run —
+                # every process that observes completion performs it,
+                # so the output exists regardless of which processes
+                # survive.  An unsettled pass (drain, max_archives,
+                # stall) stops the pass chain; resume continues it.
+                queue.refresh()
+                pcounts = queue.counts()
+                pass_complete = not (pcounts.get("pending", 0)
+                                     or pcounts.get("running", 0)
+                                     or pcounts.get("failed", 0))
+                if pass_complete:
+                    if wl.has_reduce:
+                        with metrics.timed(PHASE_HISTOGRAM,
+                                           phase="reduce",
+                                           workload=wlabel), \
+                                obs.span("reduce", workload=wlabel,
+                                         iteration=ipass + 1):
+                            wl.end_pass(ipass, plan, workdir, queue,
+                                        pid, quiet=quiet)
+                    else:
+                        wl.end_pass(ipass, plan, workdir, queue, pid,
+                                    quiet=quiet)
+                if stop or drain["sig"] or not pass_complete:
+                    break
             if drain["sig"]:
                 obs.event("sigterm_drain", signal=drain["sig"],
                           n_fit_attempts=n_fit, **queue.counts())
@@ -922,7 +1037,7 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                 obs.gauge("device_utilization",
                           round(dev_s / wall, 4) if wall > 0 else 0.0)
             obs.event("runner_summary", process=pid, owner=owner,
-                      **queue.counts())
+                      workload=wl.name, **queue.counts())
             run_dir = rec.dir if rec is not None else None
 
         if run_dir is not None:
@@ -949,8 +1064,12 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                       "available shards" % (e, len(revoked)),
                       file=sys.stderr)
 
-        extra = {"checkpoint": paths["checkpoint"],
+        extra = {"checkpoint": checkpoint,
                  "obs_run": run_dir, "n_fit_attempts": n_fit}
+        if n_passes > 1:
+            extra["n_passes"] = n_passes
+            extra["pass_complete"] = pass_complete
+        extra.update(wl.summary_extra())
         if drain["sig"]:
             extra["drained"] = drain["sig"]
         if barrier_timeout:
@@ -970,7 +1089,8 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
             except FileNotFoundError:
                 pass
             merged = _merge_survey_manifests(workdir,
-                                             paths["survey_merged"])
+                                             paths["survey_merged"],
+                                             workload=queue.workload)
             summary["merged_counts"] = merged["counts"]
         return summary
     finally:
@@ -989,19 +1109,33 @@ def survey_status(workdir, now=None):
     per-archive states}, per-owner state counts, the lease table for
     every ``running`` entry, and the expired-but-unreclaimed leases a
     resume (of any process count) would take over.  Readonly — a live
-    run may own the shards."""
+    run may own the shards.
+
+    ``counts`` aggregates across every workload the workdir has seen
+    (identical to the toas counts for a plain TOA survey);
+    ``workloads`` breaks them down per workload, and lease rows carry
+    their workload.  ``archives`` keeps its original shape: the toas
+    records (back-compat for toas-only consumers)."""
     q = WorkQueue(None, readonly=True, union_dir=workdir)
     try:
         if not q.shards_seen:
             raise FileNotFoundError(f"no ledger shards under {workdir}")
         now = time.time() if now is None else now
+        per_wl = q.counts_by_workload()
+        counts = {}
+        for wl_counts in per_wl.values():
+            for state, n in wl_counts.items():
+                counts[state] = counts.get(state, 0) + n
+        for state in q.counts():  # keep every state key present
+            counts.setdefault(state, 0)
         owners = {}
-        for rec in q.entries.values():
+        for rec in q.all_entries.values():
             o = rec.get("owner") or "(unowned)"
             per = owners.setdefault(o, {})
             per[rec["state"]] = per.get(rec["state"], 0) + 1
-        leases = q.leases(now=now)
-        return {"counts": q.counts(),
+        leases = q.leases(now=now, all_workloads=True)
+        return {"counts": counts,
+                "workloads": per_wl,
                 "quarantined": q.quarantined(),
                 "archives": dict(q.entries),
                 "owners": owners,

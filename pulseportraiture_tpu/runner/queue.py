@@ -48,6 +48,20 @@ static partition — is the single source of truth for work ownership:
 * ``refresh()`` tails every shard incrementally (byte offsets, torn
   tails never consumed) so a live process observes other processes'
   claims/completions without rereading whole files.
+
+Workload dimension (runner/workloads.py)
+----------------------------------------
+
+A workdir may host several sequential workload passes (zap -> align ->
+toas) sharing the same shard files.  Every record written by this
+queue carries ``workload``; records **without** the field (ledgers
+written before the workload engine existed) replay as ``"toas"``, so
+old workdirs resume unchanged.  ``entries``/``ready``/``claim``/
+``counts`` and every other single-workload API are filtered to this
+queue's own workload — two workloads never contend for the same
+archive — while ``all_entries`` keeps the cross-workload union for
+``record_for``/``counts_by_workload``/``workloads_seen`` (the status
+and pre-fit-chain views).
 """
 
 import hashlib
@@ -61,7 +75,8 @@ from ..obs import tracing
 from ..testing import faults
 
 __all__ = ["WorkQueue", "PENDING", "RUNNING", "DONE", "FAILED",
-           "QUARANTINED", "owner_pid"]
+           "QUARANTINED", "owner_pid", "DEFAULT_WORKLOAD",
+           "record_workload"]
 
 PENDING = "pending"
 RUNNING = "running"
@@ -73,6 +88,15 @@ _STATES = (PENDING, RUNNING, DONE, FAILED, QUARANTINED)
 
 _LEDGER_RE = re.compile(r"^ledger\.(\d+)\.jsonl$")
 _OWNER_RE = re.compile(r"^p(\d+)@")
+
+# records written before the workload engine existed have no
+# ``workload`` field — they are TOA surveys by construction
+DEFAULT_WORKLOAD = "toas"
+
+
+def record_workload(rec):
+    """Workload a ledger record belongs to (back-compat default)."""
+    return str(rec.get("workload") or DEFAULT_WORKLOAD)
 
 
 def owner_pid(owner):
@@ -133,7 +157,8 @@ class WorkQueue:
 
     def __init__(self, path, max_attempts=3, backoff_s=1.0,
                  readonly=False, union_dir=None, owner=None,
-                 lease_s=600.0, process_index=None):
+                 lease_s=600.0, process_index=None,
+                 workload=DEFAULT_WORKLOAD):
         self.path = path
         self.max_attempts = int(max_attempts)
         self.backoff_s = float(backoff_s)
@@ -141,10 +166,12 @@ class WorkQueue:
         self.union_dir = union_dir
         self.owner = owner
         self.lease_s = float(lease_s)
+        self.workload = str(workload or DEFAULT_WORKLOAD)
         if process_index is None:
             process_index = owner_pid(owner)
         self.process_index = process_index
-        self.entries = {}      # realpath -> latest record (dict)
+        self.entries = {}      # realpath -> latest record, own workload
+        self.all_entries = {}  # (workload, realpath) -> latest record
         self._order = []       # insertion order of first sighting
         self._seq = 0          # per-process record sequence (union tie-break)
         self._offsets = {}     # shard path -> bytes consumed
@@ -195,24 +222,35 @@ class WorkQueue:
                 key = rec.get("archive")
                 if key is None or rec.get("state") not in _STATES:
                     continue
-                if key not in self.entries:
-                    self._order.append(key)
-                self.entries[key] = rec
+                wl = record_workload(rec)
+                self.all_entries[(wl, key)] = rec
+                if wl == self.workload:
+                    if key not in self.entries:
+                        self._order.append(key)
+                    self.entries[key] = rec
                 self._seq = max(self._seq, int(rec.get("seq") or 0))
 
     def _apply(self, rec, shard):
-        """Merge one replayed record: max ``_rec_key`` per archive wins
-        (idempotent, shard-read-order independent)."""
+        """Merge one replayed record: max ``_rec_key`` per (workload,
+        archive) wins (idempotent, shard-read-order independent).
+        Only this queue's own workload feeds ``entries``/``_order`` —
+        other workloads' records are visible through ``all_entries``
+        but never contend for claims."""
         key = rec.get("archive")
         if key is None or rec.get("state") not in _STATES:
             return
+        wl = record_workload(rec)
+        wkey = (wl, key)
+        prev = self.all_entries.get(wkey)
+        if prev is not None and _rec_key(rec) < _rec_key(prev):
+            return
+        self.all_entries[wkey] = rec
+        if wl != self.workload:
+            return
         if key not in self.entries:
             self._order.append(key)
-            self.entries[key] = rec
-            self._shard_of[key] = shard
-        elif _rec_key(rec) >= _rec_key(self.entries[key]):
-            self.entries[key] = rec
-            self._shard_of[key] = shard
+        self.entries[key] = rec
+        self._shard_of[key] = shard
 
     def _read_shard(self, path, shard):
         """Tail one shard from its consumed offset; never consume an
@@ -275,7 +313,10 @@ class WorkQueue:
             except (faults.InjectedFault, OSError):
                 self.scan_errors += 1
                 continue
-        for rec in self.entries.values():
+        for rec in self.all_entries.values():
+            # seq must be monotone across EVERY workload sharing the
+            # shard files, or a later pass's records would lose the
+            # union tie-break to an earlier pass's
             self._seq = max(self._seq, int(rec.get("seq") or 0))
         return n
 
@@ -293,7 +334,8 @@ class WorkQueue:
         with self._iolock:
             self._seq += 1
             rec = {"t": round(time.time(), 6), "archive": key,
-                   "state": state, "seq": self._seq}
+                   "state": state, "seq": self._seq,
+                   "workload": self.workload}
             if trace_id is not None:
                 rec["trace"] = trace_id
             if self.owner is not None:
@@ -308,6 +350,7 @@ class WorkQueue:
                 if key not in self.entries:
                     self._order.append(key)
                 self.entries[key] = rec
+                self.all_entries[(self.workload, key)] = rec
             self._fh.write(json.dumps(rec) + "\n")
             self._fh.flush()
         return rec
@@ -356,7 +399,7 @@ class WorkQueue:
             if key not in self.entries:
                 self._append(key, PENDING, path=path)
 
-    def claim(self, path, lease_s=None):
+    def claim(self, path, lease_s=None, **extra_fields):
         """Claim an archive for this owner.
 
         Without an owner this is the legacy bare ``running`` append.
@@ -367,11 +410,13 @@ class WorkQueue:
         The caller must re-check :meth:`owns` after a
         :meth:`refresh` — a concurrent double-claim is resolved by the
         deterministic ``(t, owner)`` union order and the loser must
-        abandon with no further transition.
+        abandon with no further transition.  ``extra_fields`` ride on
+        the claim record (the toas workload stamps the upstream zap
+        decision chain here — runner/workloads.py).
         """
         key = self.key_for(path)
         if self.owner is None:
-            return self._append(key, RUNNING)
+            return self._append(key, RUNNING, **extra_fields)
         prev = self.entries.get(key)
         fields = {"lease_expires_at": round(
             time.time() + (self.lease_s if lease_s is None
@@ -389,6 +434,7 @@ class WorkQueue:
                     and prev.get("prev_owner") != self.owner:
                 # claimed straight off a revocation/recovery record
                 fields["takeover_from"] = prev.get("prev_owner")
+        fields.update(extra_fields)
         return self._append(key, RUNNING, **fields)
 
     def renew(self, path):
@@ -543,22 +589,54 @@ class WorkQueue:
             out[rec["state"]] += 1
         return out
 
-    def leases(self, now=None):
-        """[{archive, owner, lease_expires_at, expires_in, expired}]
-        for every ``running`` entry — the ``ppsurvey status`` lease
-        table."""
+    def leases(self, now=None, all_workloads=False):
+        """[{archive, workload, owner, lease_expires_at, expires_in,
+        expired}] for every ``running`` entry — the ``ppsurvey
+        status`` lease table.  ``all_workloads`` widens the scan to
+        every workload sharing the workdir."""
         now = time.time() if now is None else now
+        if all_workloads:
+            recs = [(k, self.all_entries[(wl, k)])
+                    for wl, k in sorted(self.all_entries)]
+        else:
+            recs = [(k, self.entries[k]) for k in self._order]
         out = []
-        for k in self._order:
-            rec = self.entries[k]
+        for k, rec in recs:
             if rec["state"] != RUNNING:
                 continue
             exp = rec.get("lease_expires_at")
             out.append({
                 "archive": k,
+                "workload": record_workload(rec),
                 "owner": rec.get("owner"),
                 "lease_expires_at": exp,
                 "expires_in": None if exp is None
                 else round(exp - now, 3),
                 "expired": exp is None or now >= exp})
+        return out
+
+    # -- cross-workload queries (runner/workloads.py, status views) -----
+
+    def workloads_seen(self):
+        """Sorted workload names present anywhere in the union view."""
+        return sorted({wl for wl, _ in self.all_entries})
+
+    def record_for(self, workload, path):
+        """Latest record for an archive under ANY workload (the toas
+        pass reads the zap pass's decisions through this)."""
+        return self.all_entries.get(
+            (str(workload), self.key_for(path)))
+
+    def entries_for(self, workload):
+        """{realpath: record} snapshot of one workload's entries."""
+        workload = str(workload)
+        return {k: rec for (wl, k), rec in self.all_entries.items()
+                if wl == workload}
+
+    def counts_by_workload(self):
+        """{workload: {state: n}} across the whole union view."""
+        out = {}
+        for (wl, _), rec in self.all_entries.items():
+            per = out.setdefault(wl, {s: 0 for s in _STATES})
+            per[rec["state"]] += 1
         return out

@@ -1,0 +1,60 @@
+"""J002 fixtures: workload-engine API misuse inside jit.
+
+The workload subsystem (pulseportraiture_tpu.runner.workloads) is
+host-side engine plumbing by contract — registry lookups resolve
+Python factories, JSONL checkpoint appends are locked file IO, and
+``fit_one``/``end_pass`` drive ledger transitions; none of it has any
+meaning inside a trace.  This corpus proves the workload entry points
+are unreachable inside a jit trace without the linter firing.
+docs/RUNNER.md "Workloads".
+"""
+
+import jax
+
+from pulseportraiture_tpu import runner
+from pulseportraiture_tpu.runner import resolve_workload
+from pulseportraiture_tpu.runner.workloads import (
+    append_jsonl_checkpoint, read_jsonl_checkpoint)
+
+
+@jax.jit
+def bad_resolve_in_jit(x):
+    wl = runner.resolve_workload("zap")  # EXPECT: J002
+    return x * len(wl.name)
+
+
+@jax.jit
+def bad_bare_resolve(x):
+    resolve_workload("align", modelfile="t.fits")  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_registry_in_jit(x):
+    runner.get_workload("modelfit")  # EXPECT: J002
+    return x + len(runner.workload_names())  # EXPECT: J002
+
+
+@jax.jit
+def bad_checkpoint_read(x):
+    done = read_jsonl_checkpoint("/tmp/zap.0.jsonl")  # EXPECT: J002
+    return x + len(done)
+
+
+@jax.jit
+def bad_checkpoint_append(x):
+    append_jsonl_checkpoint("/tmp/zap.0.jsonl",  # EXPECT: J002
+                            {"archive": "a.fits"})
+    return x
+
+
+def ok_host_side(plan, workdir):
+    # outside jit: exactly how run_survey resolves its workload
+    wl = resolve_workload("zap", opts={"nstd": 3.0})
+    return runner.run_survey(plan, workdir, workload=wl)
+
+
+@jax.jit
+def ok_unrelated_name(x, workload_weights):
+    # an array merely NAMED workload-ish must not trip the rule
+    return workload_weights.sum() + x

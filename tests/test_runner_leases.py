@@ -200,6 +200,111 @@ def test_ledger_scan_fault_degrades_to_stale_view(tmp_path):
     q.close()
 
 
+# -- workload dimension compat (ISSUE 11 satellite) ---------------------
+
+def test_legacy_records_without_workload_replay_as_toas(tmp_path):
+    """Forward/backward compat: a pre-workload ledger (no ``workload``
+    field on any record) replays as the ``toas`` workload — same
+    entries, same counts, same claimability — so old workdirs resume
+    unchanged under the workload engine."""
+    from pulseportraiture_tpu.runner.queue import DEFAULT_WORKLOAD
+
+    wd = str(tmp_path)
+    _write_shard(os.path.join(wd, "ledger.0.jsonl"), [
+        {"t": 1.0, "seq": 1, "archive": "A", "state": "pending"},
+        {"t": 2.0, "seq": 2, "archive": "A", "state": "done",
+         "owner": "p0@1.1", "n_toas": 2, "ckpt": 0},
+        {"t": 3.0, "seq": 3, "archive": "B", "state": "pending"},
+    ])
+    q = WorkQueue(None, readonly=True, union_dir=wd)
+    assert q.workload == DEFAULT_WORKLOAD == "toas"
+    assert q.workloads_seen() == ["toas"]
+    assert q.entries["A"]["state"] == DONE
+    assert q.all_entries[("toas", "A")]["state"] == DONE
+    assert q.counts_by_workload() == {
+        "toas": {"pending": 1, "running": 0, "done": 1, "failed": 0,
+                 "quarantined": 0}}
+    q.close()
+    # ...and a live queue claims the legacy pending entry normally,
+    # stamping the workload on the new record only
+    q2 = WorkQueue(os.path.join(wd, "ledger.1.jsonl"), union_dir=wd,
+                   owner="p1@2.1", process_index=1)
+    rec = q2.claim("B")
+    assert rec["workload"] == "toas"
+    q2.close()
+
+
+def test_mixed_workload_union_ledger_keeps_workloads_apart(tmp_path):
+    """One workdir, several workloads: records only contend within
+    their own workload label.  A zap done-record leaves the same
+    archive pending for toas; per-workload queues see disjoint states
+    over the SAME shard files, and the cross-workload queries read
+    through."""
+    wd = str(tmp_path)
+    qz = WorkQueue(os.path.join(wd, "ledger.0.jsonl"), union_dir=wd,
+                   owner="p0@1.1", process_index=0, workload="zap")
+    qz.add(["a.fits", "b.fits"])
+    qz.claim("a.fits")
+    qz.complete("a.fits", n_zapped=3)
+    qt = WorkQueue(os.path.join(wd, "ledger.0.jsonl"), union_dir=wd,
+                   owner="p0@1.1", process_index=0, workload="toas")
+    qt.add(["a.fits", "b.fits"])
+    # zap's done does not leak into toas state
+    assert qt.entries[WorkQueue.key_for("a.fits")]["state"] == PENDING
+    assert qt.ready("a.fits")
+    # the cross-workload read the toas pass's pre_fit chain uses
+    zrec = qt.record_for("zap", "a.fits")
+    assert zrec["state"] == DONE and zrec["n_zapped"] == 3
+    assert qt.workloads_seen() == ["toas", "zap"]
+    cw = qt.counts_by_workload()
+    assert cw["zap"]["done"] == 1 and cw["toas"]["pending"] == 2
+    qz.close()
+    qt.close()
+
+
+def test_mixed_workload_union_resumes_any_process_count(survey,
+                                                        tmp_path):
+    """A workdir holding a finished 2-shard zap pass resumes as a
+    SINGLE-process toas survey: the zap records neither block nor
+    duplicate the toas work, every archive ends done exactly once per
+    workload, and the toas claims carry the zap pre_fit chain."""
+    wd = str(tmp_path / "wd")
+    os.makedirs(wd)
+    plan = plan_survey(survey.files, modelfile=survey.gm)
+    keys = [info.path for info, _ in plan.archives()]
+    # a previous 2-process zap pass, one shard per process
+    for pid, share in ((0, keys[:2]), (1, keys[2:])):
+        qz = WorkQueue(os.path.join(wd, "ledger.%d.jsonl" % pid),
+                       union_dir=wd, owner="p%d@1.1" % pid,
+                       process_index=pid, workload="zap")
+        qz.add(keys)
+        for k in share:
+            qz.claim(k)
+            qz.complete(k, n_zapped=2)
+        qz.close()
+
+    s = run_survey(plan, wd, process_index=0, process_count=1,
+                   bary=False, backoff_s=0.0, merge=True)
+    assert s["counts"]["done"] == 4
+    assert s["merged_counts"]["done"] == 4
+    recs = _union_ledger(wd)
+    for wl in ("zap", "toas"):
+        per = {}
+        for r in recs:
+            if r.get("workload", "toas") == wl \
+                    and r["state"] == "done":
+                per[r["archive"]] = per.get(r["archive"], 0) + 1
+        assert per == {WorkQueue.key_for(k): 1 for k in keys}, wl
+    # the toas claims narrate the zap stage they resumed over
+    chains = [r for r in recs if r.get("workload") == "toas"
+              and str(r.get("reason", "")).startswith("pre_fit zap:")]
+    assert {r["archive"] for r in chains} \
+        == {WorkQueue.key_for(k) for k in keys}
+    st = survey_status(wd)
+    assert st["workloads"]["zap"]["done"] == 4
+    assert st["workloads"]["toas"]["done"] == 4
+
+
 # -- lease lifecycle ----------------------------------------------------
 
 def test_lease_claim_expiry_and_visible_takeover(tmp_path):

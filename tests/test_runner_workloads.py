@@ -1,0 +1,429 @@
+"""The workload engine (ISSUE 11 tentpole): zap, align, modelfit and
+toas all run behind the claim→fit→checkpoint→reconcile runner.
+
+docs/RUNNER.md "Workloads" contract: every workload inherits the
+engine's machinery — union-ledger leases, per-archive fault isolation,
+checkpoint/ledger reconcile, obs shards, elastic resume — and a
+zap→align→toas chain through ONE workdir is exactly-once per
+(archive, workload), with the zap decisions surfaced in the toas
+pass's claim reason chain and the whole chain visible in one merged
+obs report.
+"""
+
+import json
+import os
+import shutil
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.io.archive import load_data, make_fake_pulsar
+from pulseportraiture_tpu.io.gmodel import write_model
+from pulseportraiture_tpu.pipelines.align import align_archives
+from pulseportraiture_tpu.runner.execute import run_survey, survey_status
+from pulseportraiture_tpu.runner.plan import plan_survey
+from pulseportraiture_tpu.runner.queue import WorkQueue
+from pulseportraiture_tpu.runner.workloads import (
+    AlignWorkload, ToasWorkload, Workload, get_workload,
+    read_jsonl_checkpoint, register_workload, resolve_workload,
+    workload_names)
+from pulseportraiture_tpu.testing import faults
+
+MODEL_PARAMS = np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5])
+HOT_CHAN = 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("PPTPU_FAULTS", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def ws(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("runner_workloads")
+    gm = str(tmp / "w.gmodel")
+    write_model(gm, "w", "000", 1500.0, MODEL_PARAMS, np.ones(8, int),
+                -4.0, 0, quiet=True)
+    par = str(tmp / "w.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    # one hot (high-noise) channel so the zap workload has real work;
+    # nbin=128 keeps clear of test_runner_execute's acceptance buckets
+    noise = np.full(8, 0.01)
+    noise[HOT_CHAN] = 0.08
+    files = []
+    for i in range(4):
+        out = str(tmp / f"w{i}.fits")
+        make_fake_pulsar(gm, par, out, nsub=2, nchan=8, nbin=128,
+                         nu0=1500.0, bw=400.0, tsub=60.0,
+                         phase=0.02 * (i + 1), dDM=5e-4,
+                         noise_stds=noise, dedispersed=False,
+                         seed=150 + i, quiet=True)
+        files.append(out)
+    tmpl = str(tmp / "tmpl.fits")
+    make_fake_pulsar(gm, par, tmpl, nsub=1, nchan=8, nbin=128,
+                     nu0=1500.0, bw=400.0, tsub=60.0, noise_stds=0.004,
+                     dedispersed=True, seed=7, quiet=True)
+    return SimpleNamespace(tmp=tmp, gm=gm, par=par, files=files,
+                           tmpl=tmpl)
+
+
+def _copies(ws, dst):
+    os.makedirs(str(dst), exist_ok=True)
+    out = []
+    for f in ws.files:
+        t = os.path.join(str(dst), os.path.basename(f))
+        shutil.copy(f, t)
+        out.append(t)
+    return out
+
+
+def _union_ledger(workdir):
+    recs = []
+    for name in sorted(os.listdir(workdir)):
+        if name.startswith("ledger.") and name.endswith(".jsonl"):
+            with open(os.path.join(workdir, name)) as fh:
+                recs.extend(json.loads(ln) for ln in fh if ln.strip())
+    return recs
+
+
+def _done_by_archive(recs, workload):
+    out = {}
+    for r in recs:
+        if r.get("workload", "toas") == workload \
+                and r.get("state") == "done":
+            out[r["archive"]] = out.get(r["archive"], 0) + 1
+    return out
+
+
+def _toa_lines(ckpt):
+    if not os.path.isfile(ckpt):
+        return []
+    return [ln for ln in open(ckpt)
+            if ln.split() and ln.split()[0] not in ("FORMAT", "C", "#")]
+
+
+# -- registry + resolution ---------------------------------------------
+
+def test_registry_and_resolution_errors():
+    assert workload_names() == ["align", "modelfit", "toas", "zap"]
+    with pytest.raises(ValueError, match="unknown workload 'nope'"):
+        get_workload("nope")
+    # toas (and None) keep the original modelfile requirement verbatim
+    with pytest.raises(ValueError, match="needs a modelfile"):
+        resolve_workload(None)
+    with pytest.raises(ValueError, match="needs a modelfile"):
+        resolve_workload("toas")
+    # get_toas keywords only make sense for toas
+    with pytest.raises(TypeError, match="unexpected get_toas"):
+        resolve_workload("zap", get_toas_kw={"bary": False})
+    # align needs a template; -m doubles as the initial guess
+    with pytest.raises(ValueError, match="initial_guess"):
+        resolve_workload("align")
+    wl = resolve_workload("align", modelfile="t.fits")
+    assert isinstance(wl, AlignWorkload)
+    assert wl.initial_guess == "t.fits"
+    # a Workload instance passes through untouched
+    assert resolve_workload(wl) is wl
+    # third-party registration resolves by name
+    class Probe(Workload):
+        name = "probe"
+    register_workload("probe", Probe)
+    try:
+        assert isinstance(resolve_workload("probe"), Probe)
+    finally:
+        from pulseportraiture_tpu.runner import workloads as _w
+
+        _w._REGISTRY.pop("probe")
+
+
+def test_pass_labels_and_checkpoint_paths(tmp_path):
+    wl = AlignWorkload(initial_guess="t.fits", niter=3)
+    assert [wl.pass_label(i) for i in range(3)] == \
+        ["align", "align.i2", "align.i3"]
+    assert wl.checkpoint_path(str(tmp_path), 1, 2) == \
+        os.path.join(str(tmp_path), "align.i3.1.jsonl")
+    tw = ToasWorkload(modelfile="m.gmodel")
+    assert tw.checkpoint_path(str(tmp_path), 0) == \
+        os.path.join(str(tmp_path), "toas.0.tim")
+
+
+# -- zap through the engine (satellite: load_data roundtrip) -----------
+
+def test_zap_workload_roundtrip(ws, tmp_path):
+    """A zap survey zero-weights the hot channel IN the archives (the
+    load_data roundtrip), records the decision on the ledger done
+    record AND in a JSONL checkpoint block — exactly one of each per
+    archive."""
+    files = _copies(ws, tmp_path / "arch")
+    wd = str(tmp_path / "wd")
+    plan = plan_survey(files, modelfile=ws.gm)
+    s = run_survey(plan, wd, workload="zap",
+                   workload_opts={"all_subs": True}, process_index=0,
+                   process_count=1, backoff_s=0.0, merge=True)
+    assert s["workload"] == "zap"
+    assert s["counts"]["done"] == 4
+    assert s["counts"].get("failed", 0) == 0
+    # ledger: one done record per archive, carrying the decision
+    done = _done_by_archive(_union_ledger(wd), "zap")
+    assert done == {WorkQueue.key_for(f): 1 for f in files}
+    for r in _union_ledger(wd):
+        if r.get("state") == "done":
+            assert r["workload"] == "zap"
+            assert r["n_zapped"] >= 2  # hot channel x 2 subints
+            assert r["n_proposed"] >= 1
+    # checkpoint: one complete JSONL block per archive
+    blocks = read_jsonl_checkpoint(os.path.join(wd, "zap.0.jsonl"))
+    assert set(blocks) == {os.path.realpath(f) for f in files}
+    for b in blocks.values():
+        assert any(HOT_CHAN in z for z in b["zap_channels"])
+    # the roundtrip: zapped channels come back zero-weighted
+    for f in files:
+        d = load_data(f, pscrunch=True, quiet=True)
+        assert np.all(d.weights[:, HOT_CHAN] == 0.0)
+        assert np.any(d.weights[:, 0] > 0.0)
+    # merged survey manifest breaks counts down per workload
+    merged = json.load(open(os.path.join(wd, "survey.json")))
+    assert merged["workloads"]["zap"]["done"] == 4
+    # re-zapping is idempotent: a fresh pass proposes nothing
+    wd2 = str(tmp_path / "wd2")
+    s2 = run_survey(plan, wd2, workload="zap",
+                    workload_opts={"all_subs": True}, process_index=0,
+                    process_count=1, backoff_s=0.0, merge=False)
+    assert s2["counts"]["done"] == 4
+    recs2 = [r for r in _union_ledger(wd2) if r.get("state") == "done"]
+    assert all(r["n_zapped"] == 0 for r in recs2)
+
+
+# -- align through the engine (satellite: parity + kill/resume) --------
+
+def test_align_workload_parity_with_direct_call(ws, tmp_path):
+    """Engine-run align equals a direct align_archives call: same
+    accumulated portrait and total weights within float-association
+    tolerance (the per-row math is identical; only the batching
+    differs)."""
+    files = _copies(ws, tmp_path / "arch")
+    direct_out = str(tmp_path / "direct.fits")
+    _, direct_port, direct_w = align_archives(
+        files, ws.tmpl, fit_dm=True, niter=1, outfile=direct_out,
+        quiet=True)
+    wd = str(tmp_path / "wd")
+    s = run_survey(plan_survey(files), wd, workload="align",
+                   workload_opts={"initial_guess": ws.tmpl},
+                   process_index=0, process_count=1, backoff_s=0.0,
+                   merge=False)
+    assert s["counts"]["done"] == 4
+    assert s["aligned"] == os.path.join(wd, "aligned.fits")
+    with np.load(os.path.join(wd, "align.result.npz")) as res:
+        np.testing.assert_allclose(res["total_weights"], direct_w,
+                                   rtol=1e-6, atol=1e-9)
+        np.testing.assert_allclose(res["aligned_port"], direct_port,
+                                   rtol=1e-5, atol=1e-8)
+    d = load_data(s["aligned"], quiet=True)
+    assert d.nbin == 128 and d.DM == 0.0 and d.dmc is False
+    assert d.prof_SNR > 50  # genuinely aligned, not noise
+
+
+def test_align_kill_resume_refits_nothing(ws, tmp_path):
+    """A 2-iteration align survey killed mid-iteration-2 (max_archives
+    bounds the fit attempts, the deterministic stand-in for SIGKILL)
+    resumes refitting NOTHING already accumulated: pass-1 parts,
+    template and checkpoint blocks are byte-for-byte untouched and the
+    resume performs exactly the two missing fits."""
+    files = _copies(ws, tmp_path / "arch")
+    wd = str(tmp_path / "wd")
+    plan = plan_survey(files)
+    opts = {"initial_guess": ws.tmpl, "niter": 2}
+    s1 = run_survey(plan, wd, workload="align", workload_opts=opts,
+                    process_index=0, process_count=1, backoff_s=0.0,
+                    merge=False, max_archives=6)
+    assert s1["n_passes"] == 2 and s1["pass_complete"] is False
+    assert s1["n_fit_attempts"] == 6
+    ck1 = os.path.join(wd, "align.0.jsonl")
+    ck2 = os.path.join(wd, "align.i2.0.jsonl")
+    assert len(read_jsonl_checkpoint(ck1)) == 4
+    assert len(read_jsonl_checkpoint(ck2)) == 2
+    tmpl2 = os.path.join(wd, "align.template.2.fits")
+    assert os.path.isfile(tmpl2)
+    assert not os.path.isfile(os.path.join(wd, "aligned.fits"))
+
+    def _sig(path):
+        st = os.stat(path)
+        return (st.st_mtime_ns, st.st_size)
+
+    parts1 = sorted(os.listdir(os.path.join(wd, "align_parts",
+                                            "align")))
+    assert len(parts1) == 4
+    before = {p: _sig(os.path.join(wd, "align_parts", "align", p))
+              for p in parts1}
+    before[tmpl2] = _sig(tmpl2)
+    done2 = read_jsonl_checkpoint(ck2)
+    for rec in done2.values():
+        before[rec["part"]] = _sig(rec["part"])
+
+    s2 = run_survey(plan, wd, workload="align", workload_opts=opts,
+                    process_index=0, process_count=1, backoff_s=0.0,
+                    merge=False)
+    assert s2["pass_complete"] is True
+    assert s2["counts"]["done"] == 4
+    assert s2["n_fit_attempts"] == 2  # only the two missing archives
+    for path, sig in before.items():
+        p = path if os.path.isabs(path) \
+            else os.path.join(wd, "align_parts", "align", path)
+        assert _sig(p) == sig, "resume touched %s" % p
+    # no duplicated checkpoint blocks: one line per archive per pass
+    assert sum(1 for _ in open(ck1)) == 4
+    assert sum(1 for _ in open(ck2)) == 4
+    assert os.path.isfile(os.path.join(wd, "aligned.fits"))
+    assert os.path.isfile(os.path.join(wd, "align.result.npz"))
+
+
+def test_align_quarantines_mismatched_nbin(ws, tmp_path):
+    """An archive whose nbin differs from the template is a permanent
+    skip — quarantined with the reason, not retried, and the reduce
+    proceeds over the rest."""
+    files = _copies(ws, tmp_path / "arch")[:2]
+    bad = str(tmp_path / "arch" / "bad_nbin.fits")
+    make_fake_pulsar(ws.gm, ws.par, bad, nsub=1, nchan=8, nbin=64,
+                     nu0=1500.0, bw=400.0, tsub=60.0, noise_stds=0.01,
+                     dedispersed=False, seed=99, quiet=True)
+    wd = str(tmp_path / "wd")
+    s = run_survey(plan_survey(files + [bad]), wd, workload="align",
+                   workload_opts={"initial_guess": ws.tmpl},
+                   process_index=0, process_count=1, backoff_s=0.0,
+                   merge=False)
+    assert s["counts"]["done"] == 2
+    assert s["counts"]["quarantined"] == 1
+    (q,) = s["quarantined"]
+    assert q["archive"] == WorkQueue.key_for(bad)
+    assert "nbin mismatch" in q["reason"]
+    assert os.path.isfile(os.path.join(wd, "aligned.fits"))
+
+
+# -- modelfit through the engine ---------------------------------------
+
+def test_modelfit_workload_gauss(ws, tmp_path):
+    files = [ws.tmpl]
+    wd = str(tmp_path / "wd")
+    s = run_survey(plan_survey(files), wd, workload="modelfit",
+                   workload_opts={"kind": "gauss",
+                                  "model_kw": {"auto_gauss": 0.05,
+                                               "niter": 1}},
+                   process_index=0, process_count=1, backoff_s=0.0,
+                   merge=False)
+    assert s["counts"]["done"] == 1
+    out = os.path.join(wd, "models", "tmpl.gmodel")
+    assert os.path.isfile(out)
+    from pulseportraiture_tpu.io.gmodel import read_model
+
+    name, code, nu_ref, ngauss, params, flags, alpha, fita = \
+        read_model(out)
+    assert ngauss >= 1
+    (rec,) = [r for r in _union_ledger(wd) if r["state"] == "done"]
+    assert rec["workload"] == "modelfit"
+    assert rec["model"] == out and rec["kind"] == "gauss"
+    blocks = read_jsonl_checkpoint(os.path.join(wd,
+                                                "modelfit.0.jsonl"))
+    assert list(blocks.values())[0]["model"] == out
+
+
+# -- the acceptance chain ----------------------------------------------
+
+def test_chain_zap_align_toas_exactly_once(ws, tmp_path):
+    """ISSUE 11 acceptance: zap→align→toas through ONE engine in ONE
+    workdir — exactly one done record and one checkpoint block per
+    (archive, workload) across an injected read fault and a simulated
+    2-process zap run; the zap decisions surface in the toas pass's
+    claim reason chain; status, the merged survey manifest and the
+    merged obs report all show every workload."""
+    files = _copies(ws, tmp_path / "arch")
+    wd = str(tmp_path / "wd")
+    plan = plan_survey(files, modelfile=ws.gm)
+
+    # -- zap, simulated 2-process, under an injected archive_read
+    # fault (one load fails once, retried to done: the chaos surface
+    # behaves identically under every workload)
+    faults.configure("site:archive_read@nth=2")
+    s0 = run_survey(plan, wd, workload="zap",
+                    workload_opts={"all_subs": True}, process_index=0,
+                    process_count=2, backoff_s=0.0, merge=False)
+    faults.reset()
+    s1 = run_survey(plan, wd, workload="zap",
+                    workload_opts={"all_subs": True}, process_index=1,
+                    process_count=2, backoff_s=0.0, merge=False)
+    assert s0["counts"]["done"] + s1["counts"]["done"] >= 4
+    recs = _union_ledger(wd)
+    assert any(r.get("state") == "failed" and "InjectedFault"
+               in str(r.get("reason")) for r in recs)
+
+    # -- align (single iteration) over the zapped archives
+    sa = run_survey(plan, wd, workload="align",
+                    workload_opts={"initial_guess": ws.tmpl},
+                    process_index=0, process_count=1, backoff_s=0.0,
+                    merge=False)
+    assert sa["counts"]["done"] == 4
+
+    # -- toas, the original API untouched
+    st = run_survey(plan, wd, process_index=0, process_count=1,
+                    bary=False, backoff_s=0.0, merge=True)
+    assert st["counts"]["done"] == 4
+    assert st["merged_counts"]["done"] == 4
+
+    # exactly-once per (archive, workload)
+    recs = _union_ledger(wd)
+    keys = {WorkQueue.key_for(f) for f in files}
+    for wl in ("zap", "align", "toas"):
+        assert _done_by_archive(recs, wl) == {k: 1 for k in keys}, wl
+    # one checkpoint block per (archive, workload) across ALL shards
+    zap_blocks = {}
+    for pid in (0, 1):
+        for k in read_jsonl_checkpoint(
+                os.path.join(wd, "zap.%d.jsonl" % pid)):
+            zap_blocks[k] = zap_blocks.get(k, 0) + 1
+    assert zap_blocks == {os.path.realpath(f): 1 for f in files}
+    align_blocks = read_jsonl_checkpoint(
+        os.path.join(wd, "align.0.jsonl"))
+    assert set(align_blocks) == {os.path.realpath(f) for f in files}
+    per_arch = {}
+    for ln in _toa_lines(os.path.join(wd, "toas.0.tim")):
+        per_arch[ln.split()[0]] = per_arch.get(ln.split()[0], 0) + 1
+    assert per_arch == {f: 2 for f in files}  # nsub=2, one block each
+
+    # the zap decisions narrate the toas pass's claims
+    chains = [r for r in recs if r.get("workload") == "toas"
+              and str(r.get("reason", "")).startswith("pre_fit zap:")]
+    assert {r["archive"] for r in chains} == keys
+    for r in chains:
+        assert r["pre_fit"]["zap"]["n_zapped"] >= 2
+        assert r["pre_fit"]["zap"]["owner"]
+
+    # status and the merged manifest break it down per workload
+    status = survey_status(wd)
+    for wl in ("zap", "align", "toas"):
+        assert status["workloads"][wl]["done"] == 4
+    assert status["counts"]["done"] == 12
+    merged = json.load(open(os.path.join(wd, "survey.json")))
+    assert set(merged["workloads"]) >= {"zap", "align", "toas"}
+
+    # one merged obs report covers the whole chain (shard rotation:
+    # the zap/align runs' shards survive the later runs' write_shard)
+    ev_path = os.path.join(wd, "obs_merged", "events.jsonl")
+    evs = [json.loads(ln) for ln in open(ev_path) if ln.strip()]
+    summaries = {e.get("workload") for e in evs
+                 if e.get("name") == "runner_summary"}
+    assert {"zap", "align", "toas"} <= summaries
+    archive_wls = {e.get("workload") for e in evs
+                   if e.get("name") == "runner_archive"}
+    assert {"zap", "align", "toas"} <= archive_wls
+
+    # the toas outputs themselves: every surviving channel fit, the
+    # zapped channel contributing nothing
+    for f in files:
+        d = load_data(f, pscrunch=True, quiet=True)
+        assert np.all(d.weights[:, HOT_CHAN] == 0.0)

@@ -1,0 +1,70 @@
+"""Measure align-workload throughput through the survey engine
+(PERF.md §6): 8 synthetic archives run twice in one process — a cold
+pass (pays the phase-fit kernel compiles) and a warm pass into a
+fresh workdir (the steady-state rate) — so the printed lines separate
+first-compile amortization from the engine's real per-archive cost
+(ledger + lease heartbeat + JSONL checkpoint + part file + reduce).
+
+Run:  env JAX_PLATFORMS=cpu python -m tools.align_perf
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def main():
+    workroot = tempfile.mkdtemp(prefix="pptpu_align_perf_")
+    try:
+        from pulseportraiture_tpu.io.archive import make_fake_pulsar
+        from pulseportraiture_tpu.io.gmodel import write_model
+        from pulseportraiture_tpu.runner import plan_survey, run_survey
+
+        gm = os.path.join(workroot, "p.gmodel")
+        write_model(gm, "p", "000", 1500.0,
+                    np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0,
+                              -0.5]),
+                    np.ones(8, int), -4.0, 0, quiet=True)
+        par = os.path.join(workroot, "p.par")
+        with open(par, "w") as f:
+            f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                    "PEPOCH 56000.0\nDM 30.0\n")
+        n = 8
+        files = []
+        for i in range(n):
+            fits = os.path.join(workroot, "a%d.fits" % i)
+            make_fake_pulsar(gm, par, fits, nsub=2, nchan=8, nbin=128,
+                             nu0=1500.0, bw=400.0, tsub=60.0,
+                             phase=0.01 * (i + 1), dDM=5e-4,
+                             noise_stds=0.01, dedispersed=False,
+                             seed=300 + i, quiet=True)
+            files.append(fits)
+        tmpl = os.path.join(workroot, "t.fits")
+        make_fake_pulsar(gm, par, tmpl, nsub=1, nchan=8, nbin=128,
+                         nu0=1500.0, bw=400.0, tsub=60.0,
+                         noise_stds=0.004, dedispersed=True, seed=7,
+                         quiet=True)
+        plan = plan_survey(files, modelfile=gm)
+
+        for label, wd in (("cold", "wd1"), ("warm", "wd2")):
+            wdp = os.path.join(workroot, wd)
+            t0 = time.perf_counter()
+            s = run_survey(plan, wdp, workload="align",
+                           workload_opts={"initial_guess": tmpl},
+                           process_index=0, process_count=1,
+                           backoff_s=0.0, merge=False)
+            dt = time.perf_counter() - t0
+            assert s["counts"]["done"] == n, s["counts"]
+            print("%s engine: %.2f s  %.2f archives/s"
+                  % (label, dt, n / dt))
+        return 0
+    finally:
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,26 +22,33 @@
 #                 1 and then 3 processes — zero lost and zero
 #                 duplicated archives (docs/RUNNER.md Elasticity,
 #                 testing/faults.py)
-#   7. service smoke — a real warmed ppserve daemon under an injected
+#   7. workload smoke — the workload engine end to end: a
+#                 zap→align→toas chain through one workdir (3 good
+#                 archives + 1 corrupt, under an injected read fault)
+#                 must be exactly-once per (archive, workload), carry
+#                 the zap decisions into the toas claim chain, and
+#                 merge into ONE obs report showing all three
+#                 workloads (docs/RUNNER.md "Workloads")
+#   8. service smoke — a real warmed ppserve daemon under an injected
 #                 read fault + mid-request SIGTERM: 2 done + 1
 #                 quarantined across 2 tenants, drain exits 0, zero
 #                 post-warm compiles, per-request audit trail
 #                 (docs/SERVICE.md)
-#   8. loadgen smoke — pploadgen against a real warmed daemon: a
+#   9. loadgen smoke — pploadgen against a real warmed daemon: a
 #                 lenient SLO spec must pass (exit 0) and client/server
 #                 latency histograms must agree within bucket
 #                 resolution; a second daemon under an injected
 #                 dispatch fault must BREACH the SLO gate (nonzero
 #                 exit) — the live-telemetry/SLO plane end to end
 #                 (docs/SERVICE.md, docs/OBSERVABILITY.md)
-#   9. trace smoke — distributed tracing end to end: a p99 histogram
+#  10. trace smoke — distributed tracing end to end: a p99 histogram
 #                 exemplar pulled from a warmed daemon's metrics
 #                 snapshot must resolve via tools/obs_trace.py to a
 #                 complete orphan-free span tree (client submit ->
 #                 daemon lifecycle -> combined-dispatch span links ->
 #                 checkpoint) whose critical path sums to the recorded
 #                 total within 10% (docs/OBSERVABILITY.md)
-#  10. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
+#  11. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
 #
 # Exit status is non-zero when any stage fails.
 set -u
@@ -107,6 +114,17 @@ if [ $? -ne 0 ]; then
     fail=1
 else
     tail -1 /tmp/_chaos_smoke.log
+fi
+
+echo
+echo "== workload smoke (zap->align->toas chain, docs/RUNNER.md Workloads) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu PPTPU_OBS_DIR="" PPTPU_FAULTS="" \
+    python -m tools.workload_smoke >/tmp/_workload_smoke.log 2>&1
+if [ $? -ne 0 ]; then
+    tail -40 /tmp/_workload_smoke.log
+    fail=1
+else
+    tail -1 /tmp/_workload_smoke.log
 fi
 
 echo

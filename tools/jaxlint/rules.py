@@ -89,10 +89,16 @@ _DEVTIME_API_NAMES = {"record_devtime", "summarize_region",
 # orchestration by contract — file IO (header scans, JSONL ledger
 # appends, checkpoint rewrites) and process partitioning have no
 # meaning inside a trace.  Matched as ``runner.<name>`` or the bare
-# imported entry points.
+# imported entry points.  The workload subsystem (runner/workloads.py)
+# is part of the same contract: registry lookups, JSONL checkpoint
+# appends and ledger transitions are host-side engine plumbing.
 _RUNNER_API_NAMES = {"plan_survey", "run_survey", "scan_archive_header",
                      "pad_databunch", "canonical_shape", "survey_status",
-                     "merge_obs_shards", "WorkQueue"}
+                     "merge_obs_shards", "WorkQueue",
+                     "resolve_workload", "get_workload",
+                     "register_workload", "workload_names",
+                     "read_jsonl_checkpoint", "append_jsonl_checkpoint",
+                     "drop_jsonl_checkpoint_blocks"}
 
 # chaos harness (pulseportraiture_tpu.testing.faults): fault sites are
 # host-only by construction — a check() under jit would fire once at

@@ -387,6 +387,7 @@ def summarize_latency(snapshot):
 
     by_phase = {}
     by_tenant = {}
+    by_workload = {}
     for key, h in (snapshot.get("histograms") or {}).items():
         name, labels = parse_series(key)
         if name != PHASE_HISTOGRAM:
@@ -403,6 +404,12 @@ def summarize_latency(snapshot):
                 by_tenant[t].merge(Histogram.from_snapshot(h))
             else:
                 by_tenant[t] = Histogram.from_snapshot(h)
+        if labels.get("workload"):
+            k2 = (labels["workload"], phase)
+            if k2 in by_workload:
+                by_workload[k2].merge(Histogram.from_snapshot(h))
+            else:
+                by_workload[k2] = Histogram.from_snapshot(h)
     if not by_phase:
         return None
     rows = []
@@ -427,6 +434,23 @@ def summarize_latency(snapshot):
         lines.append("per-tenant end-to-end (total):")
         lines.append(_table(["tenant", "n", "p50_s", "p99_s", "max_s"],
                             trows))
+    if len({wl for wl, _ in by_workload}) > 1:
+        # a chained-workload workdir (zap→align→toas): break each
+        # phase out per workload label so the table answers where each
+        # pipeline's time went, not just the union's
+        wrows = []
+        for wl, phase in sorted(
+                by_workload,
+                key=lambda k: (k[0], _latency_phase_key(k[1]))):
+            h = by_workload[(wl, phase)]
+            wrows.append([wl, phase, h.count,
+                          _fmt_lat_s(h.quantile(0.5)),
+                          _fmt_lat_s(h.quantile(0.99)),
+                          _fmt_lat_s(h.max)])
+        lines.append("")
+        lines.append("per-workload phases:")
+        lines.append(_table(["workload", "phase", "n", "p50_s",
+                             "p99_s", "max_s"], wrows))
     return "\n".join(lines)
 
 

@@ -1,0 +1,169 @@
+"""Workload-engine smoke gate: a zap→align→toas chain through ONE
+engine in ONE workdir must be exactly-once per (archive, workload)
+under a corrupt archive and an injected read fault (wired into
+tools/check.sh).
+
+Builds 4 archives — three good ones sharing a shape bucket (each with
+a deliberately hot channel so zap has real work) plus one corrupt file
+— and a clean template, then drives the chain docs/RUNNER.md
+"Workloads" describes: a zap survey (under a transient injected
+``archive_read`` fault that must retry to done), an align survey over
+the zapped archives, and a toas survey whose claims surface the zap
+decisions as a ``pre_fit`` stage.  Asserts the ISSUE 11 acceptance
+contract: one done record and one checkpoint block per (archive,
+workload), the corrupt archive quarantined under every workload, and
+ONE merged obs report covering all three workloads (shard-chain
+rotation) with the per-workload latency table rendered.
+
+Run:  env JAX_PLATFORMS=cpu python -m tools.workload_smoke
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as np
+
+
+def _union_ledger(workdir):
+    recs = []
+    for name in sorted(os.listdir(workdir)):
+        if name.startswith("ledger.") and name.endswith(".jsonl"):
+            with open(os.path.join(workdir, name)) as fh:
+                recs.extend(json.loads(ln) for ln in fh if ln.strip())
+    return recs
+
+
+def main():
+    workroot = tempfile.mkdtemp(prefix="pptpu_workload_smoke_")
+    try:
+        from pulseportraiture_tpu.io.archive import (load_data,
+                                                     make_fake_pulsar)
+        from pulseportraiture_tpu.io.gmodel import write_model
+        from pulseportraiture_tpu.runner import (WorkQueue, plan_survey,
+                                                 run_survey,
+                                                 survey_status)
+        from pulseportraiture_tpu.runner.workloads import \
+            read_jsonl_checkpoint
+        from pulseportraiture_tpu.testing import faults
+
+        gm = os.path.join(workroot, "smoke.gmodel")
+        write_model(gm, "smoke", "000", 1500.0,
+                    np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5]),
+                    np.ones(8, int), -4.0, 0, quiet=True)
+        par = os.path.join(workroot, "smoke.par")
+        with open(par, "w") as f:
+            f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                    "PEPOCH 56000.0\nDM 30.0\n")
+        noise = np.full(8, 0.01)
+        noise[3] = 0.08  # hot channel: zap must find real work
+        files = []
+        for i in range(3):
+            fits = os.path.join(workroot, "good%d.fits" % i)
+            make_fake_pulsar(gm, par, fits, nsub=2, nchan=8, nbin=64,
+                             nu0=1500.0, bw=400.0, tsub=60.0,
+                             phase=0.02 * (i + 1), dDM=5e-4,
+                             noise_stds=noise, dedispersed=False,
+                             seed=21 + i, quiet=True)
+            files.append(fits)
+        corrupt = os.path.join(workroot, "corrupt.fits")
+        with open(corrupt, "wb") as f:
+            f.write(b"SIMPLE  =                    T" + b"\x00" * 64)
+        tmpl = os.path.join(workroot, "tmpl.fits")
+        make_fake_pulsar(gm, par, tmpl, nsub=1, nchan=8, nbin=64,
+                         nu0=1500.0, bw=400.0, tsub=60.0,
+                         noise_stds=0.004, dedispersed=True, seed=5,
+                         quiet=True)
+
+        workdir = os.path.join(workroot, "wd")
+        plan = plan_survey(files + [corrupt], modelfile=gm)
+        assert plan.n_archives == 3, plan.to_dict()
+        assert [p for p, _ in plan.unreadable] == [corrupt]
+
+        # -- 1. zap, under a transient injected read fault that must
+        # fail->retry->done inside the same run
+        faults.configure("site:archive_read@nth=2")
+        try:
+            sz = run_survey(plan, workdir, workload="zap",
+                            workload_opts={"all_subs": True},
+                            process_index=0, process_count=1,
+                            backoff_s=0.0, merge=False)
+        finally:
+            faults.reset()
+        assert sz["counts"]["done"] == 3, sz["counts"]
+        assert sz["counts"]["quarantined"] == 1, sz["counts"]
+        recs = _union_ledger(workdir)
+        assert any(r.get("state") == "failed"
+                   and "InjectedFault" in str(r.get("reason"))
+                   for r in recs), "injected read fault left no trace"
+        for f in files:
+            d = load_data(f, pscrunch=True, quiet=True)
+            assert np.all(d.weights[:, 3] == 0.0), f
+
+        # -- 2. align over the zapped archives
+        sa = run_survey(plan, workdir, workload="align",
+                        workload_opts={"initial_guess": tmpl},
+                        process_index=0, process_count=1,
+                        backoff_s=0.0, merge=False)
+        assert sa["counts"]["done"] == 3, sa["counts"]
+        assert os.path.isfile(sa["aligned"]), sa
+
+        # -- 3. toas, claims narrating the zap stage
+        st = run_survey(plan, workdir, process_index=0,
+                        process_count=1, bary=False, backoff_s=0.0,
+                        merge=True)
+        assert st["counts"]["done"] == 3, st["counts"]
+
+        # exactly-once per (archive, workload) + the corrupt archive
+        # quarantined under every workload
+        recs = _union_ledger(workdir)
+        keys = {WorkQueue.key_for(f) for f in files}
+        for wl in ("zap", "align", "toas"):
+            done = {}
+            for r in recs:
+                if r.get("workload", "toas") == wl \
+                        and r["state"] == "done":
+                    done[r["archive"]] = done.get(r["archive"], 0) + 1
+            assert done == {k: 1 for k in keys}, (wl, done)
+        status = survey_status(workdir)
+        for wl in ("zap", "align", "toas"):
+            assert status["workloads"][wl]["done"] == 3, status
+            assert status["workloads"][wl]["quarantined"] == 1, status
+        zb = read_jsonl_checkpoint(os.path.join(workdir,
+                                                "zap.0.jsonl"))
+        ab = read_jsonl_checkpoint(os.path.join(workdir,
+                                                "align.0.jsonl"))
+        assert set(zb) == set(ab) == {os.path.realpath(f)
+                                      for f in files}
+        chains = [r for r in recs if r.get("workload") == "toas"
+                  and str(r.get("reason", "")).startswith(
+                      "pre_fit zap:")]
+        assert {r["archive"] for r in chains} == keys, chains
+
+        # -- one merged obs report covers the whole chain
+        merged = st.get("obs_merged")
+        assert merged and os.path.isfile(
+            os.path.join(merged, "events.jsonl")), st
+        with open(os.path.join(merged, "events.jsonl")) as fh:
+            evs = [json.loads(ln) for ln in fh if ln.strip()]
+        wls = {e.get("workload") for e in evs
+               if e.get("name") == "runner_summary"}
+        assert {"zap", "align", "toas"} <= wls, wls
+
+        from tools.obs_report import summarize
+
+        text = summarize(merged)
+        assert "per-workload phases:" in text, text
+        for wl in ("zap", "align", "toas"):
+            assert wl in text, "workload %s missing from report" % wl
+        print("workload smoke OK: zap->align->toas exactly-once over "
+              "3 archives (+1 quarantined), merged run at " + merged)
+        return 0
+    finally:
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
