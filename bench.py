@@ -496,6 +496,20 @@ def _bench():
             "backend_fallback": ns.backend_fallback,
         },
     }
+    # memory watermarks of the bench run so far (obs/memory.py): on
+    # device backends the allocator peak, on CPU the RSS footprint —
+    # committed BENCH lines become memory-regression baselines too
+    wm = obs.memory.watermarks()
+    if wm is not None:
+        result["extra"]["peak_host_rss_bytes"] = wm["host_rss_bytes"]
+        if "device_peak_bytes" in wm:
+            result["extra"]["peak_device_bytes"] = \
+                wm["device_peak_bytes"]
+        else:
+            st = obs.current().memory_state()
+            if st is not None:
+                result["extra"]["peak_device_bytes"] = \
+                    st.run_peak_bytes
     obs.event("result", payload=result)
     return result
 

@@ -20,6 +20,10 @@ Layout:
   parsing, self-time reduction, ``jax.named_scope`` (``pp_*``) stage
   attribution, the per-region ``devtime`` events the phase table's
   device column is built from
+* :mod:`.memory`   — memory observability: live device/host watermark
+  sampler (``pps_device_bytes_in_use`` / ``pps_device_peak_bytes`` /
+  ``pps_host_rss_bytes`` gauges), per-span ``peak_bytes`` watermarks,
+  ``device_memory_profile`` OOM dumps
 * :mod:`.metrics`  — live telemetry plane: label-keyed counters/
   gauges + log-bucketed latency histograms with exact deterministic
   merge, periodic ``metrics.jsonl`` snapshots, Prometheus text
@@ -38,7 +42,7 @@ contract (jaxlint J002 enforces it statically; ``fit_telemetry``
 additionally passes tracers through untouched at runtime).
 """
 
-from . import devtime, metrics, monitor, tracing  # noqa: F401
+from . import devtime, memory, metrics, monitor, tracing  # noqa: F401
 from .core import (Recorder, configure, counter, current, enabled,
                    event, fit_telemetry, gauge, list_event_files,
                    obs_dir, obs_max_bytes, phases, run, scoped_run,
@@ -48,6 +52,6 @@ from .trace import trace_capture, trace_dir
 
 __all__ = ["Recorder", "configure", "counter", "current", "devtime",
            "enabled", "event", "fit_telemetry", "gauge",
-           "list_event_files", "merge_obs_shards", "metrics",
+           "list_event_files", "memory", "merge_obs_shards", "metrics",
            "obs_dir", "obs_max_bytes", "phases", "run", "scoped_run",
            "span", "trace_capture", "trace_dir", "monitor", "tracing"]

@@ -183,6 +183,9 @@ class Recorder:
         # metrics.jsonl
         self._metrics = None
         self._metrics_exporter = None
+        # memory watermark sampler (obs/memory.py): created lazily on
+        # the first span boundary, same gating as the exporter above
+        self._memory = None
         self._closed = False
 
     def metrics_registry(self):
@@ -199,6 +202,22 @@ class Recorder:
                 self._metrics_exporter = MetricsExporter(
                     self._metrics, self.dir)
             return self._metrics
+
+    def memory_state(self):
+        """The run's memory watermark sampler (obs/memory.py), created
+        on first use; None when creation failed — never fatal."""
+        st = self._memory
+        if st is not None:
+            return st
+        from .memory import MemoryState
+
+        with self._lock:
+            if self._memory is None and not self._closed:
+                try:
+                    self._memory = MemoryState(self)
+                except Exception:
+                    return None
+            return self._memory
 
     # -- event stream ---------------------------------------------------
 
@@ -315,6 +334,10 @@ class Recorder:
                 return
             self._closed = True
         monitor.unsubscribe(self._mon_cb)
+        if self._memory is not None:
+            # stop the sampler BEFORE the exporter: the final memory
+            # gauges must land in the final metrics.jsonl snapshot
+            self._memory.stop()
         if self._metrics_exporter is not None:
             # final cumulative snapshot: even a run closed before the
             # first periodic tick leaves one metrics.jsonl line
@@ -455,6 +478,10 @@ def span(name, **attrs):
     # of whatever request/archive trace this thread is working for,
     # and its own id is ambient for nested spans — zero caller churn
     saved_ctx, trace_fields = _trace_child()
+    # memory watermark bracket (obs/memory.py): peak footprint over
+    # the span's extent rides along as the event's ``peak_bytes``
+    mem = rec.memory_state()
+    mtok = mem.mark() if mem is not None else None
     t0 = time.perf_counter()
     err = None
     try:
@@ -481,6 +508,10 @@ def span(name, **attrs):
             fields.update(trace_fields)
         if err is not None:
             fields["error"] = err
+        if mtok is not None:
+            pk = mem.peak(mtok)
+            if pk:
+                fields["peak_bytes"] = pk
         rec.emit("span", name=name, path=path, dur_s=round(dur, 6),
                  **fields)
 
@@ -515,11 +546,14 @@ class phases:
         self._block = None
         self._saved_ctx = None
         self._trace_fields = None
+        self._mem = None
+        self._mtok = None
 
     def enter(self, name, **attrs):
         """Close the current phase (if any) and open ``name``."""
         self._finish()
-        if _active is None:
+        rec = _active
+        if rec is None:
             return
         self._sp = _Span(name)
         self._extra = dict(attrs)
@@ -527,6 +561,8 @@ class phases:
         # each phase is a child span of the ambient trace context, and
         # ambient for its own extent (same contract as obs.span)
         self._saved_ctx, self._trace_fields = _trace_child()
+        self._mem = rec.memory_state()
+        self._mtok = self._mem.mark() if self._mem is not None else None
         self._t0 = time.perf_counter()
 
     def block(self, value):
@@ -564,12 +600,18 @@ class phases:
             stack.remove(sp)
         else:
             path = sp.name
+        mem, self._mem = self._mem, None
+        mtok, self._mtok = self._mtok, None
+        pk = mem.peak(mtok) if mem is not None and mtok is not None \
+            else None
         rec = _active
         if rec is not None:
             fields = dict(self._attrs)
             fields.update(self._extra)
             if trace_fields is not None:
                 fields.update(trace_fields)
+            if pk:
+                fields["peak_bytes"] = pk
             rec.emit("span", name=sp.name, path=path,
                      dur_s=round(dur, 6), **fields)
         self._extra = {}

@@ -52,13 +52,14 @@ import gzip
 import json
 import os
 import re
+import struct
 
 from . import core
 
 __all__ = ["SCOPE_PREFIX", "SCOPE_PHASES", "find_capture",
            "parse_chrome_trace", "self_times", "parse_xplane",
-           "parse_xplane_scopes", "scopes_of", "summarize_region",
-           "summarize_trace_dir", "record_devtime"]
+           "parse_xplane_scopes", "parse_xplane_memory", "scopes_of",
+           "summarize_region", "summarize_trace_dir", "record_devtime"]
 
 # named-scope convention: any scope segment starting with this prefix
 # is an attribution scope (everything else in the op_name path —
@@ -375,6 +376,127 @@ def parse_xplane_scopes(path):
     return parse_xplane(path)[1]
 
 
+# -- xplane memory ingestion ----------------------------------------------
+#
+# Allocator activity lands in the xplane as XEvents whose stats carry
+# the BFC/TPU allocator gauges (watermark stats below) plus, on
+# allocation rows, the requesting op ("tf_op" — a named-scope path on
+# jax programs).  CPU captures typically carry none of these; the
+# parser then returns None and every consumer degrades to absent.
+
+# point-in-time watermark stats: a capture's memory peak is their max
+_MEM_WATERMARK_STATS = frozenset((
+    "peak_bytes_in_use", "bytes_in_use", "bytes_reserved",
+    "heap_allocated_bytes", "stack_reserved_bytes"))
+# per-allocation size stats: summed per pp_* scope for attribution
+_MEM_ALLOC_STATS = frozenset((
+    "allocation_bytes", "requested_bytes", "bytes_allocated"))
+_MEM_STAT_NAMES = _MEM_WATERMARK_STATS | _MEM_ALLOC_STATS
+
+
+def _stat_scalar(wt, v):
+    """Numeric value of one XStat payload field (int64/uint64 varints
+    arrive decoded; double_value is 8 raw bytes), or None."""
+    if wt == 0 and isinstance(v, int):
+        return v
+    if wt == 1 and isinstance(v, bytes) and len(v) == 8:
+        return struct.unpack("<d", v)[0]
+    return None
+
+
+def _plane_memory(pf, agg):
+    """Fold one plane's memory-carrying XEvents into ``agg``."""
+    stat_names = {}                               # stat metadata id->name
+    for entry in _sub(pf, 5):                     # .stat_metadata{}
+        for sm in _sub(_fields(entry), 2):
+            smf = _fields(sm)
+            ids, names = _sub(smf, 1), _sub(smf, 2)
+            if ids and names:
+                try:
+                    stat_names[ids[0]] = names[0].decode()
+                except UnicodeDecodeError:
+                    pass
+    mem_ids = {i: n for i, n in stat_names.items()
+               if n in _MEM_STAT_NAMES}
+    if not mem_ids:
+        return  # plane carries no allocator stats (CPU, python tracer)
+    op_ids = {i for i, n in stat_names.items() if n == "tf_op"}
+    for line_buf in _sub(pf, 3):                  # XPlane.lines
+        lf = _fields(line_buf)
+        for ev_buf in _sub(lf, 4):                # XLine.events
+            ef = _fields(ev_buf)
+            vals = {}
+            op_name = None
+            for stat_buf in _sub(ef, 4):          # XEvent.stats
+                for fn, wt, v in _fields(stat_buf):
+                    if fn == 1 and wt == 0:       # XStat.metadata_id
+                        sid = v
+                        break
+                else:
+                    continue
+                name = mem_ids.get(sid)
+                for fn, wt, v in _fields(stat_buf):
+                    if name and fn in (2, 3, 4):  # int64/uint64/double
+                        num = _stat_scalar(0 if fn != 4 else 1, v)
+                        if num is not None:
+                            vals[name] = int(num)
+                    elif sid in op_ids and fn == 5 \
+                            and isinstance(v, bytes):  # str_value
+                        try:
+                            op_name = v.decode()
+                        except UnicodeDecodeError:
+                            pass
+            if not vals:
+                continue
+            agg["n_events"] += 1
+            for name in _MEM_WATERMARK_STATS:
+                got = vals.get(name)
+                if got is not None and got > agg["watermarks"].get(
+                        name, 0):
+                    agg["watermarks"][name] = got
+            alloc = max((vals.get(n, 0) for n in _MEM_ALLOC_STATS),
+                        default=0)
+            if alloc:
+                key = "/".join(scopes_of(op_name)) or "unattributed"
+                agg["scopes"][key] = agg["scopes"].get(key, 0) + alloc
+
+
+def parse_xplane_memory(path):
+    """Allocator-memory summary of one ``*.xplane.pb``, or None.
+
+    Returns ``{"peak_bytes_in_use", "watermarks": {stat: max},
+    "scopes": {pp-scope-path: allocated bytes}, "n_events"}`` when the
+    capture carries allocator stats (TPU/GPU backends); None when it
+    carries none (CPU captures) or the file is missing/corrupt — the
+    same degrade-to-absent contract as :func:`parse_xplane`.
+    """
+    try:
+        with open(path, "rb") as fh:
+            buf = fh.read()
+    except OSError:
+        return None
+    agg = {"watermarks": {}, "scopes": {}, "n_events": 0}
+    try:
+        for plane_buf in _sub(_fields(buf), 1):   # XSpace.planes
+            pf = _fields(plane_buf)
+            names = _sub(pf, 2)                   # XPlane.name
+            pname = names[0].decode() if names else ""
+            if not pname.endswith(":metadata"):
+                _plane_memory(pf, agg)
+    except (ValueError, IndexError, UnicodeDecodeError):
+        pass  # torn/foreign protobuf: degrade to what was parsed
+    if not agg["n_events"]:
+        return None
+    wm = agg["watermarks"]
+    peak = max([wm.get("peak_bytes_in_use", 0),
+                wm.get("bytes_in_use", 0)] or [0])
+    return {"peak_bytes_in_use": peak,
+            "watermarks": wm,
+            "scopes": dict(sorted(agg["scopes"].items(),
+                                  key=lambda kv: -kv[1])),
+            "n_events": agg["n_events"]}
+
+
 # a pp_* scope possibly wrapped in transform decorations the lowering
 # applies per segment: "pp_coarse", "vmap(pp_coarse)", "jit(pp_x)" ...
 _SCOPE_SEG_RE = re.compile(r"\b(%s[A-Za-z0-9_]+)" % SCOPE_PREFIX)
@@ -451,7 +573,7 @@ def summarize_region(region_dir, top=10):
         return round(us / 1e6, 6)
 
     top = dict(sorted(top_ops.items(), key=lambda kv: -kv[1])[:top])
-    return {
+    out = {
         "trace": trace_path or xplane_path,
         "device_total_s": s(total_us),
         "unattributed_s": s(unattr_us),
@@ -460,6 +582,14 @@ def summarize_region(region_dir, top=10):
         "top_ops": {k: s(v) for k, v in top.items()},
         "n_ops": n_ops,
     }
+    if xplane_path:
+        # allocator-memory ingestion (PR 12): peak HBM + per-scope
+        # allocation attribution next to the device seconds; absent
+        # (not null) when the capture carries no allocator stats (CPU)
+        mem = parse_xplane_memory(xplane_path)
+        if mem is not None:
+            out["memory"] = mem
+    return out
 
 
 def summarize_trace_dir(trace_root, top=10):
@@ -504,4 +634,14 @@ def record_devtime(region, region_dir):
     rec.emit("devtime", region=region, **summary)
     rec.bump("devtime_regions")
     rec.bump("device_seconds_total", summary["device_total_s"])
+    mem = summary.get("memory")
+    if mem:
+        # run-level capture watermark: the max peak any ingested
+        # capture observed (manifest gauge, next to the sampler's)
+        prev = rec.gauges.get("capture_peak_bytes_in_use", 0)
+        rec.set_gauge("capture_peak_bytes_in_use",
+                      max(int(prev or 0), mem["peak_bytes_in_use"]))
+        # latest per-scope attribution, kept for OOM forensics
+        # (obs.memory.record_oom attaches it to the ``oom`` event)
+        rec.memory_scopes = mem.get("scopes") or None
     return summary

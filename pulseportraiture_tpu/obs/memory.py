@@ -1,0 +1,371 @@
+"""Live memory observability: device/host watermarks, per-span peaks.
+
+The obs plane measured only *time* until PR 12; this module makes
+memory a first-class observable with the same activation contract as
+everything else in ``obs``:
+
+* **Live sampler** — one daemon thread per run (gated exactly like the
+  ``metrics.jsonl`` exporter: created lazily on the first span, cadence
+  ``PPTPU_MEMORY_INTERVAL`` seconds, default the metrics interval, 0
+  disables the thread) polls ``device.memory_stats()`` plus host RSS
+  and publishes the ``pps_device_bytes_in_use`` /
+  ``pps_device_peak_bytes`` / ``pps_host_rss_bytes`` gauges into the
+  run's streaming-metrics registry — the ``--watch`` views and the
+  Prometheus rendering get a memory row for free.
+* **Per-span peak watermarks** — :meth:`MemoryState.mark` /
+  :meth:`MemoryState.peak` bracket every ``obs.span`` /
+  ``obs.phases`` extent (wired in ``obs/core.py``), so each span event
+  carries a ``peak_bytes`` field: the maximum *footprint* observed
+  between entry and exit (every sample — boundary or periodic — folds
+  into all open marks, so a peak reached mid-phase by the sampler
+  thread is attributed to the phase that was open).
+* **Footprint semantics** — ``footprint_bytes`` is device
+  ``bytes_in_use`` summed over local devices when the backend exposes
+  allocator stats (TPU/GPU), else host RSS (CPU: XLA buffers live in
+  the process heap, so RSS is the honest watermark).  Which one a
+  sample used is recorded (``source``: ``device`` / ``host``).
+* **OOM forensics** — :func:`device_memory_dump` wraps
+  ``jax.profiler.device_memory_profile()`` into a run-dir file; the
+  runner/service OOM handlers attach the path plus the last sampled
+  watermarks to their ``oom`` events (docs/OBSERVABILITY.md).
+
+Never fatal, host-side only (jaxlint J002 rejects ``memory.*`` calls
+inside jit), and disabled = free: with no run active every module-level
+helper is one attribute read + ``None`` check.
+"""
+
+import itertools
+import os
+import sys
+import threading
+
+from . import core as _core
+from . import metrics as _metrics
+
+__all__ = ["GAUGE_IN_USE", "GAUGE_PEAK", "GAUGE_HOST_RSS",
+           "memory_interval", "host_rss_bytes", "sample",
+           "watermarks", "last", "is_oom", "record_oom",
+           "device_memory_dump", "MemoryState"]
+
+# the streaming-metrics gauge names the sampler publishes (and the
+# --watch memory row / obs_report read back)
+GAUGE_IN_USE = "pps_device_bytes_in_use"
+GAUGE_PEAK = "pps_device_peak_bytes"
+GAUGE_HOST_RSS = "pps_host_rss_bytes"
+
+
+def memory_interval():
+    """$PPTPU_MEMORY_INTERVAL: sampler cadence in seconds (default:
+    the metrics snapshot interval; 0 disables the thread — boundary
+    samples at span entry/exit still run)."""
+    v = os.environ.get("PPTPU_MEMORY_INTERVAL", "").strip()
+    try:
+        return max(0.0, float(v)) if v else _metrics.metrics_interval()
+    except ValueError:
+        return _metrics.metrics_interval()
+
+
+_page_size = None
+
+
+def host_rss_bytes():
+    """Resident set size of this process in bytes (0 when /proc is
+    unavailable — never fatal)."""
+    global _page_size
+    try:
+        if _page_size is None:
+            _page_size = os.sysconf("SC_PAGE_SIZE")
+        with open("/proc/self/statm", "rb") as fh:
+            return int(fh.read().split()[1]) * _page_size
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+# device-allocator probe cache: None = unprobed, () = backend exposes
+# no allocator stats (CPU), tuple = the devices to poll.  Probing once
+# keeps the steady-state sample at one /proc read on CPU backends.
+_dev_lock = threading.Lock()
+_dev_cache = None
+
+
+def _devices_with_stats():
+    global _dev_cache
+    devs = _dev_cache
+    if devs is not None:
+        return devs
+    if "jax" not in sys.modules:
+        # the sampler must never be the thing that imports jax and
+        # initializes a backend; probe again once the pipeline has
+        return ()
+    with _dev_lock:
+        if _dev_cache is None:
+            try:
+                import jax
+
+                _dev_cache = tuple(
+                    d for d in jax.local_devices()
+                    if (d.memory_stats() or {}).get("bytes_in_use")
+                    is not None)
+            except Exception:
+                _dev_cache = ()
+        return _dev_cache
+
+
+def _reset_device_cache():
+    """Test hook: force the allocator-stats probe to rerun."""
+    global _dev_cache
+    with _dev_lock:
+        _dev_cache = None
+
+
+def sample():
+    """One point-in-time watermark sample.
+
+    Returns ``{"host_rss_bytes", "footprint_bytes", "source"}`` plus,
+    when the backend exposes allocator stats,
+    ``device_bytes_in_use`` / ``device_peak_bytes`` (summed over local
+    devices).  ``footprint_bytes`` is the number per-span peaks track:
+    device in-use when available, else host RSS.
+    """
+    out = {"host_rss_bytes": host_rss_bytes()}
+    devs = _devices_with_stats()
+    if devs:
+        in_use = peak = 0
+        for d in devs:
+            try:
+                st = d.memory_stats() or {}
+            except Exception:
+                st = {}
+            bi = int(st.get("bytes_in_use", 0) or 0)
+            in_use += bi
+            peak += int(st.get("peak_bytes_in_use", bi) or bi)
+        out["device_bytes_in_use"] = in_use
+        out["device_peak_bytes"] = max(peak, in_use)
+        out["footprint_bytes"] = in_use
+        out["source"] = "device"
+    else:
+        out["footprint_bytes"] = out["host_rss_bytes"]
+        out["source"] = "host"
+    return out
+
+
+class _Mark:
+    """One open watermark bracket (a span's extent)."""
+
+    __slots__ = ("peak",)
+
+    def __init__(self, peak):
+        self.peak = peak
+
+
+class MemoryState:
+    """Per-recorder sampler thread + watermark bookkeeping.
+
+    Created lazily by :meth:`~.core.Recorder.memory_state` on the first
+    span boundary (a run that never opens a span costs nothing), and
+    stopped by ``Recorder.close()`` *before* the metrics exporter so
+    the final gauges land in the final ``metrics.jsonl`` snapshot.
+    """
+
+    def __init__(self, recorder, interval_s=None):
+        self._recorder = recorder
+        self.interval_s = memory_interval() if interval_s is None \
+            else float(interval_s)
+        self._lock = threading.Lock()
+        self._marks = {}
+        self._mark_seq = itertools.count(1)
+        self._last = None
+        self.run_peak_bytes = 0
+        self.n_samples = 0
+        self._stop = threading.Event()
+        self._thread = None
+        self.sample_now(publish=False)
+        # the footprint when sampling began: on CPU backends the
+        # estimator compares against peak GROWTH over this baseline
+        # (the interpreter + jax runtime dominate absolute RSS)
+        self.baseline_footprint_bytes = self._last["footprint_bytes"]
+        if self.interval_s > 0:
+            self._thread = threading.Thread(
+                target=self._loop, name="pptpu-memory-sampler",
+                daemon=True)
+            self._thread.start()
+
+    # -- sampling -------------------------------------------------------
+
+    def sample_now(self, publish=True):
+        """Take one sample, fold it into every open mark, optionally
+        publish the gauges; returns the sample dict."""
+        s = sample()
+        fp = s["footprint_bytes"]
+        with self._lock:
+            self._last = s
+            self.n_samples += 1
+            if fp > self.run_peak_bytes:
+                self.run_peak_bytes = fp
+            for m in self._marks.values():
+                if fp > m.peak:
+                    m.peak = fp
+        if publish:
+            self._publish(s)
+        return s
+
+    def last(self):
+        """The most recent sample (never None after construction)."""
+        with self._lock:
+            return dict(self._last) if self._last else None
+
+    def _publish(self, s):
+        # gauges go through the run's streaming-metrics registry (the
+        # --watch / Prometheus surface); creating it here is exactly
+        # the metrics-exporter activation the sampler is gated like
+        try:
+            reg = self._recorder.metrics_registry()
+        except Exception:
+            return
+        reg.set_gauge(GAUGE_HOST_RSS, s["host_rss_bytes"])
+        if "device_bytes_in_use" in s:
+            reg.set_gauge(GAUGE_IN_USE, s["device_bytes_in_use"])
+            reg.set_gauge(GAUGE_PEAK, s["device_peak_bytes"])
+        else:
+            # CPU backend: the footprint gauges mirror RSS so the
+            # watch row / regression gates read one schema everywhere
+            reg.set_gauge(GAUGE_IN_USE, s["footprint_bytes"])
+            with self._lock:
+                peak = self.run_peak_bytes
+            reg.set_gauge(GAUGE_PEAK, peak)
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            self.sample_now()
+
+    # -- span watermarks ------------------------------------------------
+
+    def mark(self):
+        """Open a watermark bracket (span entry); returns a token."""
+        self.sample_now(publish=False)
+        with self._lock:
+            tok = next(self._mark_seq)
+            self._marks[tok] = _Mark(self._last["footprint_bytes"])
+        return tok
+
+    def peak(self, tok):
+        """Close a bracket (span exit); returns its peak footprint in
+        bytes, or None for an unknown token."""
+        self.sample_now(publish=False)
+        with self._lock:
+            m = self._marks.pop(tok, None)
+        return None if m is None else m.peak
+
+    # -- lifecycle ------------------------------------------------------
+
+    def stop(self):
+        """Stop the thread, take a final sample, publish final gauges
+        (only when a metrics registry already exists — stopping must
+        not create one), and record the run-level peak gauges."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(2.0)
+            self._thread = None
+        self.sample_now(publish=False)
+        s = self.last() or {}
+        rec = self._recorder
+        if rec._metrics is not None:
+            self._publish(s)
+        # manifest gauges: the run-level summary obs_report / bench
+        # read back without parsing metrics.jsonl
+        rec.set_gauge("peak_footprint_bytes", self.run_peak_bytes)
+        rec.set_gauge("baseline_footprint_bytes",
+                      self.baseline_footprint_bytes)
+        rec.set_gauge("host_rss_bytes", s.get("host_rss_bytes", 0))
+        if "device_peak_bytes" in s:
+            rec.set_gauge("device_peak_bytes", s["device_peak_bytes"])
+
+
+# -- module-level helpers (the instrumented-code API) -------------------
+
+
+def _state():
+    rec = _core._active
+    if rec is None:
+        return None
+    return rec.memory_state()
+
+
+def watermarks():
+    """A fresh watermark sample of the active run (fed into the run's
+    open marks), or None when no run is active."""
+    st = _state()
+    return None if st is None else st.sample_now(publish=False)
+
+
+def last():
+    """The active run's most recent sample without taking a new one
+    (the OOM-forensics read), or None when no run is active."""
+    st = _state()
+    return None if st is None else st.last()
+
+
+def is_oom(err):
+    """True when ``err`` (an exception or its message string) looks
+    like a device out-of-memory failure.  XLA surfaces allocator
+    exhaustion as ``XlaRuntimeError: RESOURCE_EXHAUSTED: ...`` (often
+    with an "Out of memory" detail line); both markers are matched so
+    the string form recorded in ``failed_datafiles`` classifies the
+    same as the live exception."""
+    text = str(err)
+    return ("RESOURCE_EXHAUSTED" in text
+            or "out of memory" in text.lower())
+
+
+def record_oom(where, err, **fields):
+    """OOM forensics: emit an ``oom`` event into the active run.
+
+    The event carries the error text, a final watermark sample (plus
+    the run peak so far), the per-scope HBM attribution from the most
+    recent profiler capture when one ran (``parse_xplane_memory`` via
+    ``record_devtime``), and the path of a fresh
+    ``jax.profiler.device_memory_profile()`` dump.  Returns the event
+    fields, or None when no run is active.  Never fatal — forensics
+    must not mask the failure being recorded.
+    """
+    rec = _core._active
+    if rec is None:
+        return None
+    try:
+        ev = dict(fields)
+        ev["where"] = where
+        ev["error"] = str(err)[:500]
+        st = rec.memory_state()
+        if st is not None:
+            ev["watermarks"] = st.sample_now(publish=False)
+            ev["run_peak_bytes"] = st.run_peak_bytes
+        scopes = getattr(rec, "memory_scopes", None)
+        if scopes:
+            ev["scopes"] = scopes
+        dump = device_memory_dump(rec.dir)
+        if dump:
+            ev["memory_profile"] = dump
+        rec.emit("oom", **ev)
+        rec.bump("oom_events")
+        return ev
+    except Exception:
+        return None
+
+
+def device_memory_dump(run_dir, tag="oom"):
+    """Write ``jax.profiler.device_memory_profile()`` (a gzipped pprof
+    protobuf) into ``run_dir``; returns the path, or None when the
+    profiler/dir is unavailable.  Never fatal."""
+    try:
+        import jax.profiler
+
+        blob = jax.profiler.device_memory_profile()
+    except Exception:
+        return None
+    path = os.path.join(run_dir, "%s_memory.prof" % tag)
+    try:
+        with open(path, "wb") as fh:
+            fh.write(blob)
+    except (OSError, TypeError):
+        return None
+    return path

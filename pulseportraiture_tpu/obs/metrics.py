@@ -782,6 +782,40 @@ def _fmt_lat(v):
     return "%.2fs" % v
 
 
+def _fmt_bytes(n):
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return ("%d%s" % (n, unit)) if unit == "B" \
+                else "%.1f%s" % (n, unit)
+        n /= 1024.0
+
+
+def _memory_row(gauges):
+    """The ``--watch`` memory line from the sampler's gauges
+    (obs/memory.py), summed over any ``p<proc>/`` merge prefixes;
+    None when the snapshot carries no memory gauges (pre-memory runs
+    must keep their original frame)."""
+    sums = {}
+    for key, v in gauges.items():
+        base = key.rsplit("/", 1)[-1]
+        if base in ("pps_device_bytes_in_use", "pps_device_peak_bytes",
+                    "pps_host_rss_bytes"):
+            try:
+                sums[base] = sums.get(base, 0.0) + float(v)
+            except (TypeError, ValueError):
+                continue
+    if not sums:
+        return None
+    return "memory: device in-use %s  peak %s  host RSS %s" % (
+        _fmt_bytes(sums.get("pps_device_bytes_in_use")),
+        _fmt_bytes(sums.get("pps_device_peak_bytes")),
+        _fmt_bytes(sums.get("pps_host_rss_bytes")))
+
+
 def render_watch(snap, prev=None, title=""):
     """A terminal dashboard frame from one snapshot (pptop-style).
 
@@ -864,6 +898,10 @@ def render_watch(snap, prev=None, title=""):
                 _fmt_lat(h.quantile(0.99)), _fmt_lat(h.max)))
 
     gauges = snap.get("gauges") or {}
+    mem = _memory_row(gauges)
+    if mem:
+        lines.append("")
+        lines.append(mem)
     if gauges:
         lines.append("")
         lines.append("gauges: " + "  ".join(

@@ -59,7 +59,7 @@ import time
 import numpy as np
 
 from .. import obs
-from ..obs import metrics, tracing
+from ..obs import memory, metrics, tracing
 from ..obs.merge import merge_obs_shards, write_shard
 from ..obs.metrics import PHASE_HISTOGRAM
 from ..pipelines.toas import GetTOAs, drop_checkpoint_blocks
@@ -389,8 +389,16 @@ def _fit_one(gt, queue, info, checkpoint, padded, get_toas_kw, quiet,
         if not queue.owns(info.path, refresh=True):
             _lease_lost(queue, info, checkpoint, wrote_block=False)
             return None
-        rec = queue.fail(info.path,
-                         "%s: %s" % (type(e).__name__, e))
+        reason = "%s: %s" % (type(e).__name__, e)
+        if memory.is_oom(e):
+            # allocator exhaustion is deterministic for the shape that
+            # caused it — burning retries repeats the OOM; quarantine
+            # with forensics (watermarks + dump) instead
+            memory.record_oom("fit_one", e, archive=info.path,
+                              workload=queue.workload)
+            rec = queue.quarantine(info.path, "oom: %s" % reason[:400])
+        else:
+            rec = queue.fail(info.path, reason)
     else:
         if cancelled is not None and cancelled.is_set():
             return None
@@ -401,8 +409,18 @@ def _fit_one(gt, queue, info, checkpoint, padded, get_toas_kw, quiet,
                         wrote_block=len(gt.order) > n_ord0)
             return None
         if len(gt.failed_datafiles) > n_fail0:
-            # transient device/tunnel failure GetTOAs already isolated
-            rec = queue.fail(info.path, gt.failed_datafiles[-1][1])
+            reason = gt.failed_datafiles[-1][1]
+            if memory.is_oom(reason):
+                # GetTOAs isolated a device OOM into failed_datafiles;
+                # same quarantine-not-retry policy as the except path
+                memory.record_oom("fit_one", reason, archive=info.path,
+                                  workload=queue.workload)
+                rec = queue.quarantine(info.path,
+                                       "oom: %s" % str(reason)[:400])
+            else:
+                # transient device/tunnel failure GetTOAs already
+                # isolated
+                rec = queue.fail(info.path, reason)
         elif len(gt.poisoned_datafiles) > n_poison0:
             # non-finite guard refusal: retrying poisoned data is
             # pointless — quarantine directly with the guard's reason
@@ -734,6 +752,12 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                              "narrowband": bool(narrowband),
                              "trace_bucket": bool(trace_bucket)}) as rec:
             t0 = time.perf_counter()
+            if rec is not None and plan.buckets:
+                # analytical footprint ceiling (runner/plan.py): the
+                # largest per-bucket estimate the plan will dispatch;
+                # obs_report / memory_smoke compare it to measured peak
+                obs.gauge("plan_est_bytes",
+                          max(b.est_bytes() for b in plan.buckets))
             n_fit = 0
             stop = False
             pass_complete = True
@@ -1036,6 +1060,15 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                 obs.gauge("device_total_s", round(dev_s, 6))
                 obs.gauge("device_utilization",
                           round(dev_s / wall, 4) if wall > 0 else 0.0)
+            if rec is not None:
+                # run-level memory peak, recorded while the run is
+                # still open (close() re-records the final value; this
+                # one makes it visible to the runner_summary consumers)
+                st = rec.memory_state()
+                if st is not None:
+                    st.sample_now(publish=False)
+                    obs.gauge("peak_footprint_bytes",
+                              st.run_peak_bytes)
             obs.event("runner_summary", process=pid, owner=owner,
                       workload=wl.name, **queue.counts())
             run_dir = rec.dir if rec is not None else None

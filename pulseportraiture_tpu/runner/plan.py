@@ -36,7 +36,8 @@ from ..io.fits import BLOCK, CARD, Header
 from ..testing import faults
 
 __all__ = ["ArchiveInfo", "ShapeBucket", "SurveyPlan", "canonical_shape",
-           "pad_databunch", "plan_survey", "scan_archive_header"]
+           "estimate_archive_bytes", "pad_databunch", "plan_survey",
+           "scan_archive_header"]
 
 PLAN_SCHEMA = "pptpu-survey-plan-v1"
 
@@ -156,6 +157,35 @@ def scan_archive_header(path):
     raise ValueError(f"{path}: no SUBINT HDU found")
 
 
+# -- analytical footprint model (obs/memory.py regression gates) ----------
+#
+# Per-archive device bytes of one bucketed fit, from shapes and dtypes
+# alone: the data-domain arrays (subints, masks, model portrait, noise
+# working copy) are f64 [nsub, npol, nchan, nbin]; the harmonic-domain
+# arrays (data FT, model FT, residual) are c128 [nsub, nchan,
+# nbin//2+1]; the solver multiplies that by a temporaries factor
+# (jacobian rows, line-search copies).  It is a *planning* estimate —
+# checked against measured peaks by tools/memory_smoke.py (within 2x),
+# not a buffer-assignment readback.
+_DTYPE_BYTES = 8        # f64 data-domain arrays
+_COMPLEX_BYTES = 16     # c128 harmonic-domain arrays
+_DATA_ARRAYS = 4        # subints, masks, model, noise working copy
+_HARMONIC_ARRAYS = 3    # data FT, model FT, solver residual
+_SOLVER_OVERHEAD = 1.5  # solver temporaries (jacobian, line search)
+
+
+def estimate_archive_bytes(nchan, nbin, nsub=1, npol=1):
+    """Estimated peak device bytes to fit one archive at the canonical
+    shape its ``(nchan, nbin)`` pads up to."""
+    nchan, nbin = canonical_shape(nchan, nbin)
+    nsub = max(1, int(nsub))
+    npol = max(1, int(npol))
+    data = nsub * npol * nchan * nbin * _DTYPE_BYTES * _DATA_ARRAYS
+    harm = nsub * nchan * (nbin // 2 + 1) * _COMPLEX_BYTES \
+        * _HARMONIC_ARRAYS
+    return int(_SOLVER_OVERHEAD * (data + harm))
+
+
 class ShapeBucket:
     """One canonical (nchan_pad, nbin_pad) group of archives."""
 
@@ -168,12 +198,23 @@ class ShapeBucket:
     def key(self):
         return (self.nchan, self.nbin)
 
+    def est_bytes(self):
+        """Estimated peak device bytes of this bucket's costliest
+        archive (the admission/regression-gate number)."""
+        nsub = max((a.nsub for a in self.archives), default=1)
+        npol = max((a.npol for a in self.archives), default=1)
+        return estimate_archive_bytes(self.nchan, self.nbin,
+                                      nsub=nsub, npol=npol)
+
     def to_dict(self):
         return {"nchan": self.nchan, "nbin": self.nbin,
+                "est_bytes": self.est_bytes(),
                 "archives": [a.to_dict() for a in self.archives]}
 
     @classmethod
     def from_dict(cls, d):
+        # tolerate pre-PR-12 plans: ``est_bytes`` is recomputed from
+        # shapes, so its absence (or staleness) never breaks a load
         return cls(d["nchan"], d["nbin"],
                    [ArchiveInfo.from_dict(a) for a in d["archives"]])
 

@@ -61,12 +61,12 @@ import time
 
 from .. import obs
 from ..io.timfile import format_toa_line
-from ..obs import metrics, tracing
+from ..obs import memory, metrics, tracing
 from ..obs.metrics import PHASE_HISTOGRAM
 from ..obs.core import Recorder
 from ..runner.execute import _BucketedGetTOAs, _fit_one
 from ..runner.plan import SurveyPlan, canonical_shape, \
-    scan_archive_header
+    estimate_archive_bytes, scan_archive_header
 from ..runner.queue import DONE, FAILED, QUARANTINED, WorkQueue
 from ..testing import faults
 from .batcher import MicroBatcher
@@ -232,8 +232,8 @@ class TOAService:
                  batch_window_s=0.25, batch_max=8,
                  tenant_max_inflight=4, tenant_max_queue=64,
                  max_attempts=3, backoff_s=0.0, run_dirs_max=None,
-                 run_bytes_max=None, return_toa_lines=True,
-                 get_toas_kw=None, quiet=True):
+                 run_bytes_max=None, mem_budget_bytes=None,
+                 return_toa_lines=True, get_toas_kw=None, quiet=True):
         self.modelfile = modelfile
         self.workdir = workdir
         if isinstance(plan, str):
@@ -250,6 +250,11 @@ class TOAService:
             if run_dirs_max is None else int(run_dirs_max)
         self.run_bytes_max = _env_int("PPTPU_SERVE_MAX_RUN_BYTES", 0) \
             if run_bytes_max is None else int(run_bytes_max)
+        # memory-aware admission: a request whose analytical footprint
+        # estimate (runner/plan.estimate_archive_bytes) exceeds this
+        # device budget is rejected at intake (0 = disabled)
+        self.mem_budget_bytes = _env_int("PPTPU_SERVE_MEM_BUDGET", 0) \
+            if mem_budget_bytes is None else int(mem_budget_bytes)
         self.return_toa_lines = bool(return_toa_lines)
         self.get_toas_kw = dict(get_toas_kw or {})
         self.quiet = quiet
@@ -295,7 +300,8 @@ class TOAService:
                     "tenant_max_queue": self.tenant_max_queue,
                     "max_attempts": self.max_attempts,
                     "run_dirs_max": self.run_dirs_max,
-                    "run_bytes_max": self.run_bytes_max}))
+                    "run_bytes_max": self.run_bytes_max,
+                    "mem_budget_bytes": self.mem_budget_bytes}))
         self._recover_tenants()
         self._thread = threading.Thread(target=self._dispatcher,
                                         name="ppserve-dispatcher",
@@ -494,14 +500,47 @@ class TOAService:
                 rq = self._new_request(t, path, key, config,
                                        traceparent=traceparent)
                 obs.counter("service_requests")
-        if rq.bucket is None and not self._classify(rq):
-            # header scan failed: quarantined at intake, like the
-            # survey planner's unreadable-archive path
-            pass
+        if rq.bucket is None:
+            if self._classify(rq):
+                rejection = self._memory_admission(rq)
+                if rejection is not None:
+                    return rejection
+            # else: header scan failed — quarantined at intake, like
+            # the survey planner's unreadable-archive path
         self._emit_request(rq, "submitted")
         if wait:
             rq.done_evt.wait(timeout)
         return rq.payload()
+
+    def _memory_admission(self, rq):
+        """Memory-aware admission (docs/SERVICE.md): settle a freshly
+        classified request at intake when its analytical footprint
+        estimate exceeds the configured device budget — dispatching it
+        would OOM deterministically, burning a device cycle and a
+        retry budget to learn what the plan already knows.  Returns
+        the ``rejected_memory`` payload, or None when admitted."""
+        budget = self.mem_budget_bytes
+        if budget <= 0 or rq.bucket is None:
+            return None
+        est = estimate_archive_bytes(rq.nchan, rq.nbin, nsub=rq.nsub)
+        if est <= budget:
+            return None
+        reason = ("memory: estimated %d bytes exceeds device budget %d"
+                  % (est, budget))
+        with self._lock, tracing.activate(rq.ctx()):
+            t = self._tenants[rq.tenant]
+            t.queue.quarantine(rq.path, reason)
+            self._finalize_locked(rq, QUARANTINED, reason)
+        metrics.inc("pps_requests_total", tenant=rq.tenant,
+                    outcome="rejected_memory")
+        obs.event("service_memory_reject", tenant=rq.tenant,
+                  archive=rq.path, request=rq.id, est_bytes=est,
+                  budget_bytes=budget, bucket="%dx%d" % rq.bucket,
+                  nsub=rq.nsub)
+        obs.counter("service_memory_rejections")
+        return {"ok": False, "error": "memory", "tenant": rq.tenant,
+                "archive": rq.path, "request_id": rq.id,
+                "est_bytes": est, "budget_bytes": budget}
 
     def _classify(self, rq):
         """Header-scan the archive into its shape bucket; quarantine on
@@ -699,7 +738,17 @@ class TOAService:
                                  t.checkpoint, padded, kw, self.quiet,
                                  narrowband=self.narrowband)
         except Exception as e:  # noqa: BLE001 — total per-request guard
-            rec = t.queue.fail(rq.path, "%s: %s" % (type(e).__name__, e))
+            reason = "%s: %s" % (type(e).__name__, e)
+            if memory.is_oom(e):
+                # _fit_one classifies OOMs it sees itself; this covers
+                # allocator exhaustion escaping around it (checkout
+                # machinery, batch glue) — same quarantine-not-retry
+                memory.record_oom("service_fit", e, request=rq.id,
+                                  tenant=rq.tenant, archive=rq.path)
+                rec = t.queue.quarantine(rq.path,
+                                         "oom: %s" % reason[:400])
+            else:
+                rec = t.queue.fail(rq.path, reason)
             state = rec["state"]
         finally:
             bucket.batcher.worker_done()

@@ -1,0 +1,87 @@
+"""J002 fixtures: memory-observability API misuse inside jit.
+
+obs.memory (the watermark sampler / OOM forensics plane,
+docs/OBSERVABILITY.md) is host-side by contract: a ``sample()`` reads
+/proc and device allocator stats (one trace-time value baked into
+every execution), ``watermarks()`` mutates the recorder's mark table
+under a lock, and ``device_memory_dump()`` writes a file — none of
+that can exist in compiled code.  This corpus proves the
+``memory.*`` / ``obs.memory.*`` surface is unreachable inside a jit
+trace without the linter firing.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from pulseportraiture_tpu import obs
+from pulseportraiture_tpu.obs import memory
+
+
+@jax.jit
+def bad_sample_in_jit(x):
+    s = memory.sample()  # EXPECT: J002
+    return x + s["host_rss_bytes"]
+
+
+@jax.jit
+def bad_watermarks_in_jit(x):
+    memory.watermarks()  # EXPECT: J002
+    return x * 2.0
+
+
+@jax.jit
+def bad_last_in_jit(x):
+    wm = memory.last()  # EXPECT: J002
+    return x + (0 if wm is None else 1)
+
+
+@jax.jit
+def bad_rss_in_jit(x):
+    return x + memory.host_rss_bytes()  # EXPECT: J002
+
+
+@jax.jit
+def bad_qualified_in_jit(x):
+    obs.memory.watermarks()  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_dump_in_jit(x):
+    memory.device_memory_dump("/tmp/run")  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_record_oom_in_jit(x):
+    memory.record_oom("kernel", "RESOURCE_EXHAUSTED")  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def ok_suppressed(x):
+    memory.watermarks()  # jaxlint: disable=J002
+    return x
+
+
+def ok_host_side(run_dir):
+    # outside jit: exactly how the runner's OOM handler reads the
+    # forensics — last sample, fresh watermarks, profile dump
+    wm = memory.watermarks() or memory.last()
+    path = memory.device_memory_dump(run_dir)
+    return wm, path
+
+
+@jax.jit
+def ok_unrelated_names(x, sample, watermarks):
+    # traced values merely NAMED like the API must not trip the rule
+    return x + sample.sum() + watermarks.mean()
+
+
+def ok_after_boundary(data):
+    # the documented pattern: sample around the jit boundary, after
+    # block_until_ready, so the watermark sees the real allocation
+    y = jnp.square(data)
+    jax.block_until_ready(y)
+    memory.watermarks()
+    return y

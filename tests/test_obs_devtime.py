@@ -276,7 +276,7 @@ def test_obs_report_renders_device_column(smoke_run):
         if not line.startswith("|"):
             continue
         parts = [c.strip() for c in line.strip("|").split("|")]
-        if len(parts) == 6 and parts[0] in ("solve", "polish"):
+        if len(parts) >= 6 and parts[0] in ("solve", "polish"):
             cells[parts[0]] = parts[5]
     assert set(cells) == {"solve", "polish"}, text
     for phase, cell in cells.items():

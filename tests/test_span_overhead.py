@@ -20,6 +20,7 @@ if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 
 from tools.span_overhead import (BUDGET_FRACTION, CALLS_PER_ARCHIVE,
+                                 MEMORY_CALLS_PER_ARCHIVE,
                                  METRICS_CALLS_PER_ARCHIVE,
                                  TRACING_CALLS_PER_ARCHIVE,
                                  measure)  # noqa: E402
@@ -30,7 +31,8 @@ def test_probe_schema_and_sanity():
     for name in ("span", "phases", "event", "fit_telemetry",
                  "metrics_observe", "metrics_timed", "metrics_inc",
                  "metrics_gauge", "tracing_current",
-                 "tracing_activate", "span_traced", "observe_traced"):
+                 "tracing_activate", "span_traced", "observe_traced",
+                 "memory_watermarks", "memory_last"):
         assert out["%s_off_s" % name] > 0.0
         assert out["%s_on_s" % name] > 0.0
     assert out["archive_off_s"] == pytest.approx(
@@ -43,6 +45,10 @@ def test_probe_schema_and_sanity():
         TRACING_CALLS_PER_ARCHIVE * out["tracing_current_off_s"])
     assert out["hot_fit_tracing_off_s"] == pytest.approx(
         out["hot_fit_off_s"] + out["tracing_archive_off_s"])
+    assert out["memory_archive_off_s"] == pytest.approx(
+        MEMORY_CALLS_PER_ARCHIVE * out["memory_watermarks_off_s"])
+    assert out["hot_fit_memory_off_s"] == pytest.approx(
+        out["hot_fit_tracing_off_s"] + out["memory_archive_off_s"])
     # disabled primitives are nanosecond-scale dict lookups; even a
     # very loaded CI box keeps them under 50 us/call
     assert out["span_off_s"] < 50e-6
@@ -55,6 +61,10 @@ def test_probe_schema_and_sanity():
     # disabled-tracing guard (ISSUE 9): reading the ambient context is
     # ONE thread-local lookup — priced like the other disabled paths
     assert out["tracing_current_off_s"] < 50e-6
+    # disabled-memory guard (ISSUE 12): with no run active a watermark
+    # read is one module-global read + None check
+    assert out["memory_watermarks_off_s"] < 50e-6
+    assert out["memory_last_off_s"] < 50e-6
 
 
 @pytest.mark.slow
@@ -109,3 +119,11 @@ def test_disabled_overhead_within_budget():
         (out["hot_fit_tracing_off_s"], fit_wall)
     assert out["tracing_archive_on_s"] < fit_wall, \
         (out["tracing_archive_on_s"], fit_wall)
+    # memory watermarks (ISSUE 12): the fully-instrumented disabled
+    # path — obs + metrics + tracing + every boundary sample memory
+    # would take — still fits the <2% budget, and even enabled
+    # /proc-backed sampling stays far below one archive's fit wall
+    assert out["hot_fit_memory_off_s"] < BUDGET_FRACTION * fit_wall, \
+        (out["hot_fit_memory_off_s"], fit_wall)
+    assert out["memory_archive_on_s"] < fit_wall, \
+        (out["memory_archive_on_s"], fit_wall)

@@ -48,7 +48,14 @@
 #                 daemon lifecycle -> combined-dispatch span links ->
 #                 checkpoint) whose critical path sums to the recorded
 #                 total within 10% (docs/OBSERVABILITY.md)
-#  11. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
+#  11. memory smoke — the memory-observability plane end to end: a
+#                 tiny survey must render the ## memory report section
+#                 with per-phase peak_bytes, the plan's footprint
+#                 estimate must be within tolerance of the measured
+#                 (warm) peak, an obs_diff --mem-rel self-diff must
+#                 pass, and a synthetic run with 2x-inflated peaks
+#                 must exit nonzero (docs/OBSERVABILITY.md Memory)
+#  12. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
 #
 # Exit status is non-zero when any stage fails.
 set -u
@@ -158,6 +165,17 @@ if [ $? -ne 0 ]; then
     fail=1
 else
     tail -1 /tmp/_trace_smoke.log
+fi
+
+echo
+echo "== memory smoke (watermarks + estimator + mem-rel gate, docs/OBSERVABILITY.md) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu PPTPU_OBS_DIR="" PPTPU_FAULTS="" \
+    python -m tools.memory_smoke >/tmp/_memory_smoke.log 2>&1
+if [ $? -ne 0 ]; then
+    tail -40 /tmp/_memory_smoke.log
+    fail=1
+else
+    tail -1 /tmp/_memory_smoke.log
 fi
 
 echo

@@ -82,8 +82,18 @@ _TRACE_ID_NAMES = {"trace_id", "span_id", "parent_span_id",
 # ``devtime.<name>`` / ``obs.devtime.<name>`` or the bare imports.
 _DEVTIME_API_NAMES = {"record_devtime", "summarize_region",
                       "summarize_trace_dir", "parse_chrome_trace",
-                      "parse_xplane_scopes", "self_times",
-                      "find_capture"}
+                      "parse_xplane_scopes", "parse_xplane_memory",
+                      "self_times", "find_capture"}
+
+# obs.memory (watermark sampler / OOM forensics): host-side by
+# contract — a sample() reads /proc and device allocator stats, a
+# watermarks() mutates the recorder's mark table under a lock, and a
+# device_memory_dump writes a file; none of that can exist in compiled
+# code, and under jit each would capture one trace-time value forever.
+# Matched as ``memory.<name>`` / ``obs.memory.<name>``.
+_MEMORY_API_NAMES = {"sample", "watermarks", "last", "host_rss_bytes",
+                     "record_oom", "is_oom", "device_memory_dump",
+                     "memory_interval", "MemoryState"}
 
 # survey-runner API (pulseportraiture_tpu.runner): host-side
 # orchestration by contract — file IO (header scans, JSONL ledger
@@ -469,6 +479,18 @@ class RuleVisitor(ast.NodeVisitor):
                           "file parsing; under jit it runs once at "
                           "trace time and cannot see the program it "
                           "is part of (docs/OBSERVABILITY.md)")
+            elif fname is not None and (
+                    fname.rsplit(".", 1)[-1] in _MEMORY_API_NAMES
+                    and fname.startswith(("memory.",
+                                          "obs.memory."))):
+                self._add("J002", node,
+                          "obs.memory call inside a jitted function "
+                          "— memory watermarks are host-side by "
+                          "contract: a sample reads /proc and "
+                          "allocator stats once at trace time, and "
+                          "the sampler's locks / dump-file IO cannot "
+                          "exist in compiled code; sample around the "
+                          "jit boundary (docs/OBSERVABILITY.md)")
             elif fname in ("jax.named_scope", "named_scope") and \
                     node.args and self._refs_traced(node.args[0]):
                 self._add("J002", node,

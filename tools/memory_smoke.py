@@ -1,0 +1,172 @@
+"""Memory smoke gate: the memory-observability plane end to end
+(wired into tools/check.sh).
+
+Drives the same tiny synthetic survey as tools/runner_smoke.py twice
+and asserts the memory contract docs/OBSERVABILITY.md names:
+
+* the merged run's ``tools/obs_report.py`` summary renders a
+  ``## memory`` section and a populated ``peak_bytes`` phase column
+  (the span watermarks obs/memory.py samples);
+* the plan's analytical footprint estimate
+  (``runner/plan.estimate_archive_bytes``) is within 2x of the
+  measured peak — on CPU the measured footprint is process RSS, so
+  the comparison is peak vs (sampler baseline + estimate): the
+  interpreter + jax runtime dominate absolute RSS and belong to the
+  baseline, the estimate models the *growth* the fit adds (on device
+  backends, where allocator stats exist, the estimate dominates);
+* an ``obs_diff --mem-rel`` self-diff of the two identical surveys
+  passes, while a synthetic run whose recorded peaks are inflated 2x
+  exits nonzero — the regression gate fails when memory regresses and
+  only then.
+
+Run:  env JAX_PLATFORMS=cpu python -m tools.memory_smoke
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as np
+
+MEM_REL = 0.25
+INFLATE = 2.0
+
+
+def _build_inputs(workroot):
+    from pulseportraiture_tpu.io.archive import make_fake_pulsar
+    from pulseportraiture_tpu.io.gmodel import write_model
+
+    gm = os.path.join(workroot, "smoke.gmodel")
+    write_model(gm, "smoke", "000", 1500.0,
+                np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5]),
+                np.ones(8, int), -4.0, 0, quiet=True)
+    par = os.path.join(workroot, "smoke.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    files = []
+    for i, (nchan, nbin) in enumerate([(8, 64), (8, 128)]):
+        fits = os.path.join(workroot, "good%d.fits" % i)
+        make_fake_pulsar(gm, par, fits, nsub=2, nchan=nchan, nbin=nbin,
+                         nu0=1500.0, bw=800.0, tsub=60.0, phase=0.05,
+                         dDM=5e-4, noise_stds=0.01, dedispersed=False,
+                         seed=11 + i, quiet=True)
+        files.append(fits)
+    meta = os.path.join(workroot, "survey.meta")
+    with open(meta, "w") as f:
+        f.write("\n".join(files) + "\n")
+    return meta, gm
+
+
+def _survey(meta, gm, workdir):
+    from pulseportraiture_tpu.runner import plan_survey, run_survey
+
+    plan = plan_survey(meta, modelfile=gm)
+    summary = run_survey(plan, workdir, process_index=0,
+                         process_count=1, bary=False)
+    assert summary["counts"]["done"] == 2, summary["counts"]
+    merged = summary.get("obs_merged")
+    assert merged and os.path.isdir(merged), summary
+    return plan, merged
+
+
+def _manifest(run_dir):
+    with open(os.path.join(run_dir, "manifest.json"),
+              encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _inflate_run(src, dst, factor=INFLATE):
+    """A synthetic regression: the same run with every recorded memory
+    peak multiplied — the gate must catch exactly this."""
+    shutil.copytree(src, dst)
+    epath = os.path.join(dst, "events.jsonl")
+    out = []
+    with open(epath, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            ev = json.loads(line)
+            if ev.get("kind") == "span" and ev.get("peak_bytes"):
+                ev["peak_bytes"] = int(ev["peak_bytes"] * factor)
+            out.append(json.dumps(ev))
+    with open(epath, "w", encoding="utf-8") as fh:
+        fh.write("\n".join(out) + "\n")
+    mpath = os.path.join(dst, "manifest.json")
+    manifest = _manifest(dst)
+    gauges = manifest.setdefault("gauges", {})
+    for key in list(gauges):
+        # merged manifests carry p<proc>/-prefixed gauge keys
+        if key.rsplit("/", 1)[-1] == "peak_footprint_bytes" \
+                and gauges[key]:
+            gauges[key] = int(gauges[key] * factor)
+    with open(mpath, "w", encoding="utf-8") as fh:
+        json.dump(manifest, fh)
+    return dst
+
+
+def main():
+    workroot = tempfile.mkdtemp(prefix="pptpu_memory_smoke_")
+    try:
+        from tools import obs_diff
+        from tools.obs_report import summarize
+
+        meta, gm = _build_inputs(workroot)
+        plan, run_a = _survey(meta, gm, os.path.join(workroot, "wd_a"))
+        _, run_b = _survey(meta, gm, os.path.join(workroot, "wd_b"))
+
+        # 1. the report renders the memory plane
+        text = summarize(run_a)
+        assert "## memory" in text, text
+        assert "peak_bytes" in text, text
+        assert "peak footprint:" in text, text
+
+        # 2. estimator vs measured (manifest gauges the sampler wrote).
+        # The WARM survey is the comparable one: the cold run's RSS
+        # growth is dominated by XLA compile machinery (an explicit
+        # estimator caveat, docs/OBSERVABILITY.md); with programs
+        # already resident the second survey's peak over its own
+        # baseline is the buffer footprint the estimate models.
+        from tools.obs_report import merged_gauge
+
+        gauges_a = _manifest(run_a).get("gauges") or {}
+        assert merged_gauge(gauges_a, "peak_footprint_bytes") \
+            >= merged_gauge(gauges_a, "baseline_footprint_bytes") > 0, \
+            gauges_a
+        gauges = _manifest(run_b).get("gauges") or {}
+        peak = merged_gauge(gauges, "peak_footprint_bytes")
+        base = merged_gauge(gauges, "baseline_footprint_bytes")
+        assert peak > 0 and base > 0, gauges
+        est = max(b.est_bytes() for b in plan.buckets)
+        assert est > 0, [b.to_dict() for b in plan.buckets]
+        expected = base + est
+        ratio = peak / expected
+        assert 0.5 <= ratio <= 2.0, \
+            "estimator out of tolerance: peak %d vs baseline %d + " \
+            "est %d (%.2fx)" % (peak, base, est, ratio)
+
+        # 3. identical surveys self-diff clean under the memory gate
+        rc = obs_diff.main([run_a, run_b, "--rel", "5.0", "--min-s",
+                            "1.0", "--mem-rel", str(MEM_REL)])
+        assert rc == 0, "self-diff flagged a memory regression (rc %d)" \
+            % rc
+
+        # 4. an inflated-peak synthetic run must fail the gate
+        bad = _inflate_run(run_a, os.path.join(workroot, "inflated"))
+        rc = obs_diff.main([run_a, bad, "--rel", "5.0", "--min-s",
+                            "1.0", "--mem-rel", str(MEM_REL)])
+        assert rc == 1, \
+            "gate missed a %.0fx inflated peak (rc %d)" % (INFLATE, rc)
+
+        print("memory smoke OK: report + estimator (%.2fx of "
+              "baseline+est) + mem-rel gate at %s" % (ratio, run_a))
+        return 0
+    finally:
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,7 +26,13 @@ What is compared (run-vs-run mode):
 * convergence: non-converged subints may not increase by more than
   ``--bad-allow``; the nfeval median obeys ``--rel``;
 * counters: ``fit_subints`` (work actually done) must match exactly —
-  a "faster" run that fit fewer subints is not faster.
+  a "faster" run that fit fewer subints is not faster;
+* memory (``--mem-rel``): per-phase peak bytes (the span watermarks —
+  obs/memory.py) and the run-level ``peak_footprint_bytes`` gauge.
+  Without the flag memory rows are informational only — process-level
+  watermarks jitter across unrelated runs; with it a candidate peak
+  more than ``--mem-rel`` above baseline fails (``--mem-min-bytes``
+  floors out tiny phases).
 
 Exit status: 0 = no regression, 1 = regression(s), 2 = usage/IO error.
 Wired into tools/check.sh as a smoke-vs-smoke self-diff stage (two
@@ -39,13 +45,14 @@ import os
 import sys
 
 from tools.obs_report import (devtime_phases, devtime_totals,
-                              find_run_dir, load_run, result_payload)
+                              find_run_dir, load_run, memory_phase_peaks,
+                              merged_gauge, result_payload)
 
 # metric-name direction heuristics for BENCH payload mode
 _LOWER_IS_WORSE = ("per_sec", "fits_per_sec", "toas_per_sec", "value",
                    "vs_baseline", "gflops")
 _HIGHER_IS_WORSE = ("_sec", "_s", "_ns", "duration", "overhead",
-                    "resid", "err")
+                    "resid", "err", "_bytes")
 
 
 def run_summary(run_dir):
@@ -76,6 +83,8 @@ def run_summary(run_dir):
         n_sub += int(e.get("batch") or 0)
     counters = {k: v for k, v in (manifest.get("counters") or {}).items()
                 if isinstance(v, (int, float))}
+    gauges = manifest.get("gauges") or {}
+    peak_fp = float(merged_gauge(gauges, "peak_footprint_bytes"))
     return {
         "run_dir": run_dir,
         "wall_s": float(manifest.get("wall_s") or 0.0),
@@ -83,6 +92,8 @@ def run_summary(run_dir):
         "phases": phases,
         "device_phases": devtime_phases(events),
         "device_total_s": devtime_totals(events)["device_total_s"],
+        "mem_phases": memory_phase_peaks(events),
+        "peak_footprint_bytes": peak_fp,
         "nfeval_median": (sorted(nfev)[len(nfev) // 2] if nfev else None),
         "n_bad": n_bad,
         "fit_subints": n_sub,
@@ -171,11 +182,35 @@ def _fmt(x):
 
 
 def diff_runs(a, b, rel=0.3, min_s=0.05, compile_rel=None,
-              bad_allow=0):
-    """Diff two run summaries; returns a :class:`Diff`."""
+              bad_allow=0, mem_rel=None, mem_min_bytes=1 << 20):
+    """Diff two run summaries; returns a :class:`Diff`.
+
+    ``mem_rel=None`` (the default) renders memory rows as
+    informational; a threshold gates per-phase peak bytes and the
+    run-level peak, with baselines under ``mem_min_bytes`` floored out.
+    """
     if compile_rel is None:
         compile_rel = max(rel, 1.0)
     d = Diff()
+    mem_a = a.get("mem_phases") or {}
+    mem_b = b.get("mem_phases") or {}
+    for phase in sorted(set(mem_a) | set(mem_b)):
+        if mem_rel is None:
+            d.rows.append(("phase.%s.peak_bytes" % phase,
+                           _fmt(mem_a.get(phase)),
+                           _fmt(mem_b.get(phase)), "-", "info"))
+        else:
+            d.check("phase.%s.peak_bytes" % phase, mem_a.get(phase),
+                    mem_b.get(phase), mem_rel, floor=mem_min_bytes)
+    pk_a = a.get("peak_footprint_bytes") or None
+    pk_b = b.get("peak_footprint_bytes") or None
+    if pk_a or pk_b:
+        if mem_rel is None:
+            d.rows.append(("peak_footprint_bytes", _fmt(pk_a),
+                           _fmt(pk_b), "-", "info"))
+        else:
+            d.check("peak_footprint_bytes", pk_a, pk_b, mem_rel,
+                    floor=mem_min_bytes)
     for phase in sorted(set(a["phases"]) | set(b["phases"])):
         d.check("phase.%s.wall_s" % phase, a["phases"].get(phase),
                 b["phases"].get(phase), rel, floor=min_s)
@@ -258,6 +293,16 @@ def build_parser():
     p.add_argument("--bad-allow", type=int, default=0, dest="bad_allow",
                    help="Allowed increase in non-converged subints "
                         "(default 0).")
+    p.add_argument("--mem-rel", type=float, default=None,
+                   dest="mem_rel",
+                   help="Gate per-phase peak bytes and the run peak "
+                        "footprint at this relative threshold (e.g. "
+                        "0.25 = 25%% growth fails); without it memory "
+                        "rows are informational only.")
+    p.add_argument("--mem-min-bytes", type=int, default=1 << 20,
+                   dest="mem_min_bytes",
+                   help="Memory baselines under this many bytes never "
+                        "fail (default 1MiB).")
     return p
 
 
@@ -281,7 +326,8 @@ def main(argv=None):
         d = diff_runs(run_summary(side_a), run_summary(side_b),
                       rel=args.rel, min_s=args.min_s,
                       compile_rel=args.compile_rel,
-                      bad_allow=args.bad_allow)
+                      bad_allow=args.bad_allow, mem_rel=args.mem_rel,
+                      mem_min_bytes=args.mem_min_bytes)
         print("# obs diff: %s vs %s" % (side_a, side_b))
     print(d.table())
     if d.regressions:

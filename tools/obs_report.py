@@ -138,6 +138,16 @@ def _fmt_dev(x):
     return "%.6f" % x
 
 
+def _fmt_bytes(n):
+    """Human-readable bytes (binary units, one decimal)."""
+    n = _num(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return ("%d%s" % (n, unit)) if unit == "B" \
+                else "%.1f%s" % (n, unit)
+        n /= 1024.0
+
+
 def _table(headers, rows):
     """Minimal markdown table."""
     out = ["| " + " | ".join(headers) + " |",
@@ -184,13 +194,44 @@ def devtime_totals(events):
             "n_regions": n, "scopes": scopes}
 
 
+def merged_gauge(gauges, name, agg="sum"):
+    """One value for a manifest gauge across merge prefixes: matches
+    ``name`` and every ``p<proc>/name`` shard key (obs/merge.py), so
+    single-process and merged runs read through one call.  ``agg`` is
+    "sum" (per-process footprints add) or "max"."""
+    vals = [_num(v) for k, v in (gauges or {}).items()
+            if k == name or k.rsplit("/", 1)[-1] == name]
+    if not vals:
+        return 0.0
+    return max(vals) if agg == "max" else sum(vals)
+
+
+def memory_phase_peaks(events):
+    """Peak footprint bytes per phase: the max ``peak_bytes`` any span
+    of that phase recorded (obs/memory.py watermarks).  Empty on runs
+    predating memory observability — absent, never broken."""
+    peaks = {}
+    for e in events:
+        if e.get("kind") != "span":
+            continue
+        pk = int(_num(e.get("peak_bytes")))
+        if pk <= 0:
+            continue
+        name = e.get("name") or "?"
+        if pk > peaks.get(name, 0):
+            peaks[name] = pk
+    return peaks
+
+
 def summarize_spans(events, dev_phases=None):
     """Aggregate span events by phase name; compile events synthesize
     their own phase row (duration reported by jax.monitoring).  The
     ``device_s`` column carries the named-scope-attributed device
-    seconds of each phase ("-" when no capture touched it)."""
+    seconds of each phase, ``peak_bytes`` the phase's memory watermark
+    (obs/memory.py) — "-" when no capture/sample touched it."""
     if dev_phases is None:
         dev_phases = devtime_phases(events)
+    mem_peaks = memory_phase_peaks(events)
     agg = {}
     for e in events:
         if e.get("kind") == "span":
@@ -210,13 +251,16 @@ def summarize_spans(events, dev_phases=None):
     for name in sorted(agg, key=_phase_key):
         a = agg[name]
         dev = dev_phases.get(name)
+        pk = mem_peaks.get(name)
         rows.append([name, a["count"], _fmt_s(a["total"]),
                      _fmt_s(a["total"] / a["count"]) if a["count"]
                      else "-",
                      _fmt_s(a["max"]),
-                     _fmt_dev(dev) if dev is not None else "-"])
+                     _fmt_dev(dev) if dev is not None else "-",
+                     _fmt_bytes(pk) if pk else "-"])
     return _table(["phase", "n", "total_s", "mean_s", "max_s",
-                   "device_s"], rows) if rows else "(no span events)"
+                   "device_s", "peak_bytes"], rows) \
+        if rows else "(no span events)"
 
 
 def summarize_devtime(events):
@@ -236,6 +280,82 @@ def summarize_devtime(events):
     else:
         lines.append("(no pp_* named scopes in the captures — device "
                      "time is unattributed)")
+    return "\n".join(lines)
+
+
+def summarize_memory(manifest, events):
+    """The ``## memory`` section: run-level watermarks, the per-phase
+    peak table, estimator-vs-measured, per-scope HBM attribution from
+    ingested captures, and any OOM forensics events
+    (docs/OBSERVABILITY.md).  Returns None for a run that recorded no
+    memory telemetry (pre-PR-12 streams) — absent, never broken."""
+    gauges = manifest.get("gauges") or {}
+    peaks = memory_phase_peaks(events)
+    ooms = [e for e in events if e.get("kind") == "oom"]
+    scopes = {}
+    cap_peak = 0
+    for e in events:
+        mem = e.get("memory") if e.get("kind") == "devtime" else None
+        if not isinstance(mem, dict):
+            continue
+        cap_peak = max(cap_peak,
+                       int(_num(mem.get("peak_bytes_in_use"))))
+        for k, v in (mem.get("scopes") or {}).items():
+            scopes[k] = scopes.get(k, 0) + int(_num(v))
+    run_peak = int(merged_gauge(gauges, "peak_footprint_bytes"))
+    if not (peaks or ooms or scopes or run_peak):
+        return None
+    lines = []
+    head = []
+    if run_peak:
+        head.append("peak footprint: %s" % _fmt_bytes(run_peak))
+    base = int(merged_gauge(gauges, "baseline_footprint_bytes"))
+    if base:
+        head.append("baseline: %s" % _fmt_bytes(base))
+    host = int(merged_gauge(gauges, "host_rss_bytes"))
+    if host:
+        head.append("final host RSS: %s" % _fmt_bytes(host))
+    devp = int(merged_gauge(gauges, "device_peak_bytes"))
+    if devp:
+        head.append("device peak: %s" % _fmt_bytes(devp))
+    if cap_peak:
+        head.append("capture peak in-use: %s" % _fmt_bytes(cap_peak))
+    if head:
+        lines.append("  ".join(head))
+    est = int(merged_gauge(gauges, "plan_est_bytes", agg="max"))
+    if est and run_peak:
+        # measured growth over the sampler's baseline is what the
+        # analytical estimate models; on CPU absolute RSS also carries
+        # the interpreter + jax runtime (docs/OBSERVABILITY.md caveats)
+        grown = max(0, run_peak - base)
+        ratio = (" (%.2fx of estimate)" % (grown / est)) if est else ""
+        lines.append("estimator: plan est %s vs measured growth %s%s"
+                     % (_fmt_bytes(est), _fmt_bytes(grown), ratio))
+    if peaks:
+        rows = [[name, _fmt_bytes(peaks[name])]
+                for name in sorted(peaks, key=_phase_key)]
+        lines.append(_table(["phase", "peak_bytes"], rows))
+    if scopes:
+        rows = [[k, _fmt_bytes(v)]
+                for k, v in sorted(scopes.items(),
+                                   key=lambda kv: -kv[1])[:10]]
+        lines.append("top scopes by allocation (captures):")
+        lines.append(_table(["scope", "alloc_bytes"], rows))
+    for e in ooms[:5]:
+        wm = e.get("watermarks") or {}
+        parts = ["- oom (%s): %s" % (e.get("where", "?"),
+                                     str(e.get("error", ""))[:120])]
+        if wm.get("footprint_bytes"):
+            parts.append("footprint %s"
+                         % _fmt_bytes(wm["footprint_bytes"]))
+        if e.get("run_peak_bytes"):
+            parts.append("run peak %s"
+                         % _fmt_bytes(e["run_peak_bytes"]))
+        if e.get("memory_profile"):
+            parts.append("dump %s" % e["memory_profile"])
+        lines.append("  ".join(parts))
+    if len(ooms) > 5:
+        lines.append("- ... %d more oom event(s)" % (len(ooms) - 5))
     return "\n".join(lines)
 
 
@@ -639,6 +759,11 @@ def summarize(run_dir):
                        "not hold per phase, see docs/OBSERVABILITY.md)"
                        % (_fmt_dev(tot), _fmt_s(wall),
                           100.0 * tot / wall))
+    mem = summarize_memory(manifest, events)
+    if mem:
+        out.append("")
+        out.append("## memory")
+        out.append(mem)
     comp = summarize_compiles(events)
     if comp:
         out.append("")

@@ -34,6 +34,10 @@ METRICS_CALLS_PER_ARCHIVE = 9
 # activate per request/archive plus the ambient-context reads the
 # span/metrics instrumentation performs (ISSUE 9 budget satellite)
 TRACING_CALLS_PER_ARCHIVE = 10
+# memory-watermark touch points per archive (obs/memory.py): every
+# span boundary folds a sample into the open marks — 2 boundary
+# samples per phase span (docs/OBSERVABILITY.md Memory)
+MEMORY_CALLS_PER_ARCHIVE = 10
 BUDGET_FRACTION = 0.02
 
 
@@ -50,7 +54,7 @@ def measure(n=2000):
     (obs/metrics.py: observe / timed / inc / gauge), with obs disabled
     and enabled."""
     from pulseportraiture_tpu import obs
-    from pulseportraiture_tpu.obs import metrics, tracing
+    from pulseportraiture_tpu.obs import memory, metrics, tracing
 
     fit_result = {"nfeval": np.full(8, 12),
                   "red_chi2": np.ones(8),
@@ -110,6 +114,16 @@ def measure(n=2000):
             metrics.observe("pps_phase_seconds", 0.25, phase="fit",
                             tenant="probe", bucket="64x256")
 
+    def one_memory_watermarks():
+        # the disabled-memory contract (ISSUE 12): with no run active
+        # this is one module-global read + None check; enabled it is
+        # one /proc read folded into the open marks under a lock
+        memory.watermarks()
+
+    def one_memory_last():
+        # the OOM-forensics read: most recent sample, no new probe
+        memory.last()
+
     probes = {"span": one_span, "phases": one_phases,
               "event": one_event, "fit_telemetry": one_fit_telemetry,
               "metrics_observe": one_metrics_observe,
@@ -119,7 +133,9 @@ def measure(n=2000):
               "tracing_current": one_tracing_current,
               "tracing_activate": one_tracing_activate,
               "span_traced": one_span_traced,
-              "observe_traced": one_observe_traced}
+              "observe_traced": one_observe_traced,
+              "memory_watermarks": one_memory_watermarks,
+              "memory_last": one_memory_last}
 
     out = {}
     saved = os.environ.pop("PPTPU_OBS_DIR", None)
@@ -165,6 +181,15 @@ def measure(n=2000):
         + 7 * out["observe_traced_on_s"])
     out["hot_fit_tracing_off_s"] = out["hot_fit_off_s"] \
         + out["tracing_archive_off_s"]
+    # memory watermarks (ISSUE 12): disabled = the no-run fast path of
+    # every boundary sample the span instrumentation would take;
+    # enabled = real /proc-backed samples at the same rate
+    out["memory_archive_off_s"] = (
+        MEMORY_CALLS_PER_ARCHIVE * out["memory_watermarks_off_s"])
+    out["memory_archive_on_s"] = (
+        MEMORY_CALLS_PER_ARCHIVE * out["memory_watermarks_on_s"])
+    out["hot_fit_memory_off_s"] = out["hot_fit_tracing_off_s"] \
+        + out["memory_archive_off_s"]
     return out
 
 
