@@ -496,6 +496,19 @@ def _bench():
             "backend_fallback": ns.backend_fallback,
         },
     }
+    # fit-quality fingerprint of the main timed config (obs/quality.py)
+    # — committed BENCH lines become scientific-correctness baselines:
+    # obs_diff payload mode gates red_chi2 / bad_fit / err fields as
+    # higher-is-worse
+    qfp = obs.quality.summarize(
+        np.asarray(out.red_chi2), np.asarray(out.phi_err) * P0 * 1e6,
+        snrs=np.asarray(out.snr), rcs=np.asarray(out.return_code),
+        phis=np.asarray(out.phi), phi_errs=np.asarray(out.phi_err))
+    for src, dst in (("median_red_chi2", "fit_median_red_chi2"),
+                     ("bad_fit_rate", "fit_bad_fit_rate"),
+                     ("median_toa_err_us", "fit_median_toa_err_us")):
+        if qfp.get(src) is not None:
+            result["extra"][dst] = qfp[src]
     # memory watermarks of the bench run so far (obs/memory.py): on
     # device backends the allocator peak, on CPU the RSS footprint —
     # committed BENCH lines become memory-regression baselines too

@@ -42,7 +42,7 @@ from .. import obs
 from ..config import (Dconst, F0_fact, as_fft_operand,
                       backend_supports_complex128)
 from ..debug import check_fit_result, retrace_budget
-from ..ops.fourier import rfft_pair
+from ..ops.fourier import data_operand_hook, rfft_pair
 from ..ops.noise import get_noise
 from ..ops.scattering import (
     abs_scattering_portrait_FT_2deriv,
@@ -814,7 +814,10 @@ def fit_portrait_full(data_port, model_port, init_params, P, freqs,
     bounds: optional [(lo, hi)] * 5 (None = unbounded); applied by
     projection (the reference applies bounds only in TNC mode).
     """
-    data_port = jnp.asarray(data_port)
+    # quality-gate test hook (identity unless $PPTPU_FOURIER_TRUNC_BITS
+    # is set): perturbs the data operand ahead of BOTH spectral paths —
+    # the pair DFT matmul and the complex rfft below
+    data_port = data_operand_hook(jnp.asarray(data_port))
     model_port = jnp.asarray(model_port)
     freqs = jnp.asarray(freqs)
     nbin = data_port.shape[-1]

@@ -24,6 +24,11 @@ Layout:
   sampler (``pps_device_bytes_in_use`` / ``pps_device_peak_bytes`` /
   ``pps_host_rss_bytes`` gauges), per-span ``peak_bytes`` watermarks,
   ``device_memory_profile`` OOM dumps
+* :mod:`.quality`  — fit-quality observability: per-run quality
+  fingerprints from the per-subint fit statistics (reduced chi^2 /
+  TOA-error / S/N distributions with fixed histogram geometry, exact
+  bad-fit counters, per-archive ``quality`` events with residual
+  whiteness) — the ``obs_diff --quality-rel`` drift gate's data plane
 * :mod:`.metrics`  — live telemetry plane: label-keyed counters/
   gauges + log-bucketed latency histograms with exact deterministic
   merge, periodic ``metrics.jsonl`` snapshots, Prometheus text
@@ -42,7 +47,8 @@ contract (jaxlint J002 enforces it statically; ``fit_telemetry``
 additionally passes tracers through untouched at runtime).
 """
 
-from . import devtime, memory, metrics, monitor, tracing  # noqa: F401
+from . import (devtime, memory, metrics, monitor, quality,  # noqa: F401
+               tracing)
 from .core import (Recorder, configure, counter, current, enabled,
                    event, fit_telemetry, gauge, list_event_files,
                    obs_dir, obs_max_bytes, phases, run, scoped_run,
@@ -53,5 +59,6 @@ from .trace import trace_capture, trace_dir
 __all__ = ["Recorder", "configure", "counter", "current", "devtime",
            "enabled", "event", "fit_telemetry", "gauge",
            "list_event_files", "memory", "merge_obs_shards", "metrics",
-           "obs_dir", "obs_max_bytes", "phases", "run", "scoped_run",
-           "span", "trace_capture", "trace_dir", "monitor", "tracing"]
+           "obs_dir", "obs_max_bytes", "phases", "quality", "run",
+           "scoped_run", "span", "trace_capture", "trace_dir",
+           "monitor", "tracing"]

@@ -186,6 +186,10 @@ class Recorder:
         # memory watermark sampler (obs/memory.py): created lazily on
         # the first span boundary, same gating as the exporter above
         self._memory = None
+        # fit-quality aggregation (obs/quality.py): created lazily on
+        # the first quality record — a run that fits nothing pays
+        # nothing
+        self._quality = None
         self._closed = False
 
     def metrics_registry(self):
@@ -218,6 +222,25 @@ class Recorder:
                 except Exception:
                     return None
             return self._memory
+
+    def quality_state(self):
+        """The run's fit-quality aggregator (obs/quality.py), created
+        on first use; None when creation failed — never fatal."""
+        st = self._quality
+        if st is not None:
+            return st
+        from .quality import QualityState
+
+        # materialize the registry first: QualityState.__init__ reads
+        # it, and self._lock is not reentrant
+        self.metrics_registry()
+        with self._lock:
+            if self._quality is None and not self._closed:
+                try:
+                    self._quality = QualityState(self)
+                except Exception:
+                    return None
+            return self._quality
 
     # -- event stream ---------------------------------------------------
 
@@ -338,6 +361,13 @@ class Recorder:
             # stop the sampler BEFORE the exporter: the final memory
             # gauges must land in the final metrics.jsonl snapshot
             self._memory.stop()
+        if self._quality is not None:
+            # same ordering: the run-level quality fingerprint gauges
+            # must make the manifest written below
+            try:
+                self._quality.stop()
+            except Exception:
+                pass
         if self._metrics_exporter is not None:
             # final cumulative snapshot: even a run closed before the
             # first periodic tick leaves one metrics.jsonl line

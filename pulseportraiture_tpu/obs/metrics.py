@@ -816,6 +816,44 @@ def _memory_row(gauges):
         _fmt_bytes(sums.get("pps_host_rss_bytes")))
 
 
+def _quality_row(snap):
+    """The ``--watch`` quality line (obs/quality.py): bad-fit rate
+    from the exact ``pps_quality_*_total`` counters (summed across any
+    ``p<proc>/`` merge prefixes — counters, never gauges: gauge merges
+    keep per-process values, which cannot be combined into a rate) and
+    the median reduced chi^2 from the merged fixed-geometry
+    distribution series; None when the snapshot carries no quality
+    series (pre-quality runs keep their original frame)."""
+    from . import quality as _q     # lazy: quality imports metrics
+
+    n = bad = 0
+    for key, v in (snap.get("counters") or {}).items():
+        base = key.rsplit("/", 1)[-1]
+        try:
+            if base == _q.CTR_SUBINTS:
+                n += int(v)
+            elif base == _q.CTR_BAD_SUBINTS:
+                bad += int(v)
+        except (TypeError, ValueError):
+            continue
+    if not n:
+        return None
+    chi2 = None
+    for key, h in (snap.get("histograms") or {}).items():
+        name, _labels = parse_series(key.rsplit("/", 1)[-1])
+        if name != _q.HIST_RED_CHI2:
+            continue
+        hh = Histogram.from_snapshot(h)
+        if chi2 is None:
+            chi2 = hh
+        else:
+            chi2.merge(hh)
+    med = chi2.quantile(0.5) if chi2 is not None else None
+    return "quality: bad-fit %.2f%% (%d/%d)  med chi2=%s" % (
+        100.0 * bad / n, bad, n,
+        "%.3g" % med if med is not None else "-")
+
+
 def render_watch(snap, prev=None, title=""):
     """A terminal dashboard frame from one snapshot (pptop-style).
 
@@ -902,6 +940,11 @@ def render_watch(snap, prev=None, title=""):
     if mem:
         lines.append("")
         lines.append(mem)
+    qual = _quality_row(snap)
+    if qual:
+        if not mem:
+            lines.append("")
+        lines.append(qual)
     if gauges:
         lines.append("")
         lines.append("gauges: " + "  ".join(

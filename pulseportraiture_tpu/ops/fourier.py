@@ -24,6 +24,7 @@ Design notes (TPU-first, not a translation):
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +34,8 @@ from ..config import Dconst, F0_fact, as_fft_operand, fft_real_dtype
 
 __all__ = [
     "nharm_for",
+    "truncate_mantissa",
+    "data_operand_hook",
     "rfft_portrait",
     "rfft_pair",
     "irfft_portrait",
@@ -52,6 +55,34 @@ __all__ = [
 def nharm_for(nbin):
     """Number of rFFT harmonics for an nbin-bin profile (nbin//2 + 1)."""
     return nbin // 2 + 1
+
+
+def truncate_mantissa(x, bits):
+    """Round ``x`` to ``bits`` mantissa bits (jit/vmap-safe, exponent
+    preserved): frexp -> round the mantissa on a 2**bits grid ->
+    ldexp.  ``bits=23`` reproduces float32 rounding semantics on f64
+    values; smaller values inject a controlled, deterministic
+    quantization error of ~2**-(bits+1) relative."""
+    m, e = jnp.frexp(x)
+    scale = 2.0 ** int(bits)
+    return jnp.ldexp(jnp.round(m * scale) / scale, e)
+
+
+def data_operand_hook(x):
+    """Test hook for the quality drift gate (tools/quality_smoke.py):
+    when ``$PPTPU_FOURIER_TRUNC_BITS`` is set, truncate the *data-side*
+    spectral operand to that many mantissa bits before the fit's
+    spectra are formed — a stand-in for the precision loss a future
+    reduced-precision data-side DFT kernel (ROADMAP: split-f32/Pallas)
+    would introduce.  Identity (and entirely free) when unset.
+
+    Read at trace time: a changed value needs a fresh process, not
+    just a fresh call — in-process jit caches bake the old value in.
+    """
+    v = os.environ.get("PPTPU_FOURIER_TRUNC_BITS", "").strip()
+    if not v:
+        return x
+    return truncate_mantissa(x, int(v))
 
 
 def rfft_portrait(port, zap_f0=True):

@@ -970,6 +970,14 @@ class GetTOAs:
             self.rcs.append(rcs)
             self.fit_durations.append(fit_duration)
             self.n_nonfinite_zapped.append(n_zap)
+            # fit-quality fingerprint (obs/quality.py): one record per
+            # archive, from the same host-side arrays the TOA lines
+            # were built from (strictly after the device_get boundary)
+            obs.quality.record_archive(
+                datafile, red_chi2s[ok], phi_errs[ok] * Ps_b * 1e6,
+                snrs=snrs[ok], rcs=rcs[ok], phis=phis[ok],
+                phi_errs=phi_errs[ok], n_zapped=int(n_zap), isubs=ok,
+                nsub=int(nsub), nchan=int(nchan))
             if checkpoint is not None:
                 ph.enter("write", checkpoint=checkpoint)
                 # chaos site: a flush failure here (full disk, kill)
@@ -1381,6 +1389,15 @@ class GetTOAs:
             self.rcs.append(rcs_a)
             self.fit_durations.append(fit_duration)
             self.n_nonfinite_zapped.append(n_zap)
+            # fit-quality fingerprint (obs/quality.py): per-channel
+            # fits count as the quality subunits here; isubs names the
+            # archive subint each (subint, channel) fit belongs to
+            obs.quality.record_archive(
+                datafile, red_chi2s_fit, phi_errs_fit * Psx * 1e6,
+                snrs=snrs_fit, rcs=rcs_a[sub_idx, cc], phis=phis_fit,
+                phi_errs=phi_errs_fit, n_zapped=int(n_zap),
+                isubs=sub_idx, narrowband=True, nsub=int(nsub),
+                nchan=int(nchan))
             if checkpoint is not None:
                 ph.enter("write", checkpoint=checkpoint)
                 # same protocol as the wideband driver: block + its

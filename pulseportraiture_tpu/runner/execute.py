@@ -59,7 +59,7 @@ import time
 import numpy as np
 
 from .. import obs
-from ..obs import memory, metrics, tracing
+from ..obs import memory, metrics, quality, tracing
 from ..obs.merge import merge_obs_shards, write_shard
 from ..obs.metrics import PHASE_HISTOGRAM
 from ..pipelines.toas import GetTOAs, drop_checkpoint_blocks
@@ -934,6 +934,9 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                                                 "fit",
                                                 archive=info.path,
                                                 bucket=blabel,
+                                                workload=wlabel), \
+                                            quality.context(
+                                                bucket=blabel,
                                                 workload=wlabel):
                                         _, st_poisoned = \
                                             _fit_one_guarded(
@@ -1069,6 +1072,15 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                     st.sample_now(publish=False)
                     obs.gauge("peak_footprint_bytes",
                               st.run_peak_bytes)
+            # per-process quality fingerprint (obs/quality.py): the
+            # run-level aggregate plus the per-(bucket, workload)
+            # breakdown the fit-context labels built up
+            qfp = quality.fingerprint()
+            qgroups = quality.group_fingerprints()
+            if qfp is not None:
+                obs.event("quality_summary", process=pid,
+                          workload=wl.name, fingerprint=qfp,
+                          groups=qgroups)
             obs.event("runner_summary", process=pid, owner=owner,
                       workload=wl.name, **queue.counts())
             run_dir = rec.dir if rec is not None else None
@@ -1103,6 +1115,10 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
             extra["n_passes"] = n_passes
             extra["pass_complete"] = pass_complete
         extra.update(wl.summary_extra())
+        if qfp is not None:
+            extra["quality"] = qfp
+            if qgroups:
+                extra["quality_groups"] = qgroups
         if drain["sig"]:
             extra["drained"] = drain["sig"]
         if barrier_timeout:

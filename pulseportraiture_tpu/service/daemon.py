@@ -61,7 +61,7 @@ import time
 
 from .. import obs
 from ..io.timfile import format_toa_line
-from ..obs import memory, metrics, tracing
+from ..obs import memory, metrics, quality, tracing
 from ..obs.metrics import PHASE_HISTOGRAM
 from ..obs.core import Recorder
 from ..runner.execute import _BucketedGetTOAs, _fit_one
@@ -102,9 +102,9 @@ class Request:
 
     __slots__ = ("id", "tenant", "path", "key", "config", "bucket",
                  "nsub", "nchan", "nbin", "state", "reason", "attempts",
-                 "n_toas", "toa_lines", "t_submit", "t_done", "done_evt",
-                 "recorder", "recovered", "batch_id", "trace_id",
-                 "parent_span_id", "span_id")
+                 "n_toas", "toa_lines", "quality", "t_submit", "t_done",
+                 "done_evt", "recorder", "recovered", "batch_id",
+                 "trace_id", "parent_span_id", "span_id")
 
     def __init__(self, req_id, tenant, path, key, config):
         self.id = req_id
@@ -119,6 +119,9 @@ class Request:
         self.attempts = 0
         self.n_toas = 0
         self.toa_lines = None
+        # fit-quality fingerprint of the request's archive
+        # (obs/quality.py gt_fingerprint, stamped before checkin)
+        self.quality = None
         self.t_submit = time.time()
         self.t_done = None
         self.done_evt = threading.Event()
@@ -150,6 +153,8 @@ class Request:
             out["reason"] = self.reason
         if self.state == DONE:
             out["n_toas"] = self.n_toas
+            if self.quality is not None:
+                out["quality"] = self.quality
             if self.toa_lines is not None:
                 out["toa_lines"] = self.toa_lines
         if self.t_done is not None:
@@ -733,7 +738,8 @@ class TOAService:
             with metrics.timed(PHASE_HISTOGRAM, phase="fit",
                                tenant=rq.tenant, bucket=blabel), \
                     obs.span("fit", request=rq.id, tenant=rq.tenant,
-                             bucket=blabel):
+                             bucket=blabel), \
+                    quality.context(bucket=blabel, tenant=rq.tenant):
                 state = _fit_one(gt, t.queue, _Info(rq.path),
                                  t.checkpoint, padded, kw, self.quiet,
                                  narrowband=self.narrowband)
@@ -755,6 +761,9 @@ class TOAService:
             n_toas = len(gt.TOA_list)
             lines = [format_toa_line(toa) for toa in gt.TOA_list] \
                 if self.return_toa_lines else None
+            # fingerprint BEFORE checkin: checkin resets the pooled
+            # instance's result arrays for the next request
+            rq.quality = quality.gt_fingerprint(gt)
             bucket.checkin(gt)
         self._settle(rq, state, n_toas, lines)
 
@@ -846,6 +855,8 @@ class TOAService:
             fields["n_toas"] = rq.n_toas
         if rq.t_done is not None:
             fields["wall_s"] = round(rq.t_done - rq.t_submit, 6)
+        if phase == "terminal" and rq.quality is not None:
+            fields["quality"] = rq.quality
         fields = {k: v for k, v in fields.items() if v is not None}
         obs.event("service_request", **fields)
         if rq.recorder is not None:

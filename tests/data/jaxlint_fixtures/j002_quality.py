@@ -1,0 +1,76 @@
+"""J002 fixtures: fit-quality API misuse inside jit.
+
+obs.quality (the fit-quality fingerprint plane,
+docs/OBSERVABILITY.md) is host-side by contract: ``record_archive``
+pulls per-subint arrays through numpy, bumps recorder counters under
+a lock and appends a ``quality`` event, and ``summarize`` /
+``gt_fingerprint`` build plain-dict fingerprints — none of that can
+exist in compiled code, and under jit each would fingerprint the
+tracer seen at trace time.  This corpus proves the ``quality.*`` /
+``obs.quality.*`` surface is unreachable inside a jit trace without
+the linter firing.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from pulseportraiture_tpu import obs
+from pulseportraiture_tpu.obs import quality
+
+
+@jax.jit
+def bad_record_in_jit(chi2, errs):
+    quality.record_archive("a.fits", chi2, errs)  # EXPECT: J002
+    return chi2 + errs
+
+
+@jax.jit
+def bad_summarize_in_jit(chi2, errs):
+    fp = quality.summarize(chi2, errs)  # EXPECT: J002
+    return chi2 + fp["n_bad"]
+
+
+@jax.jit
+def bad_fingerprint_in_jit(x):
+    quality.fingerprint()  # EXPECT: J002
+    return x * 2.0
+
+
+@jax.jit
+def bad_qualified_in_jit(x):
+    obs.quality.group_fingerprints()  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_whiteness_in_jit(phis, errs):
+    r1 = quality.whiteness_r1(phis, errs)  # EXPECT: J002
+    return phis + (0.0 if r1 is None else r1)
+
+
+@jax.jit
+def ok_suppressed(chi2, errs):
+    quality.record_archive("a.fits", chi2, errs)  # jaxlint: disable=J002
+    return chi2
+
+
+def ok_host_side(chi2, errs, snrs, rcs):
+    # outside jit: exactly how the GetTOAs drivers emit — per-subint
+    # arrays already on the host, after the device_get boundary
+    fp = quality.summarize(chi2, errs, snrs=snrs, rcs=rcs)
+    quality.record_archive("a.fits", chi2, errs, snrs=snrs, rcs=rcs)
+    return fp
+
+
+@jax.jit
+def ok_unrelated_names(x, summarize, fingerprint):
+    # traced values merely NAMED like the API must not trip the rule
+    return x + summarize.sum() + fingerprint.mean()
+
+
+def ok_after_boundary(data):
+    # the documented pattern: fingerprint after block_until_ready, on
+    # host-side numpy arrays
+    y = jnp.square(data)
+    jax.block_until_ready(y)
+    return quality.summarize(y, y)

@@ -1,0 +1,354 @@
+"""Fit-quality-plane tests (the ISSUE 13 acceptance scenarios).
+
+Covers the contracts docs/OBSERVABILITY.md "Quality" declares: the
+pure fingerprint math (bad-fit classification, whiteness, thresholds),
+disabled = one attribute read (no run, no state, no files),
+record_archive feeds the fixed-geometry distribution series + exact
+counters + per-archive events and the close-time manifest gauges, the
+``--watch`` quality row merges shard prefixes and stays absent on
+pre-quality snapshots, torn metrics tails keep the last good quality
+series, the ``--quality-rel`` diff gate fires on shifted distributions
+/ new bad fits and only then, and pre-quality runs render and diff
+exactly as before (absent, never broken).
+"""
+
+import json
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu import obs
+from pulseportraiture_tpu.obs import metrics, quality
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+
+def _events(run_dir):
+    out = []
+    for path in obs.list_event_files(run_dir):
+        with open(path, encoding="utf-8") as fh:
+            out.extend(json.loads(ln) for ln in fh if ln.strip())
+    return out
+
+
+def _manifest(run_dir):
+    with open(os.path.join(run_dir, "manifest.json"),
+              encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+# -- fingerprint math (pure, no recorder) -------------------------------
+
+
+def test_summarize_classifies_bad_fits():
+    fp = quality.summarize(
+        red_chi2s=[1.0, 1.2, 8.0, np.nan],
+        toa_errs_us=[0.2, 0.3, 0.4, 0.5],
+        rcs=[0, 1, 2, 3],
+        n_zapped=2, isubs=[0, 1, 2, 3])
+    assert fp["n_subints"] == 4
+    assert fp["n_bad_chi2"] == 1          # 8.0 > default 3.0
+    assert fp["n_nonfinite"] == 1         # the NaN
+    assert fp["n_bad_rc"] == 1            # rc 3 not in converged set
+    # bad = union, not sum: subint 3 is both nonfinite and rc-bad
+    assert fp["n_bad"] == 2
+    assert fp["bad_isubs"] == [2, 3]
+    assert fp["n_zapped"] == 2
+    assert fp["bad_fit_rate"] == pytest.approx(0.5)
+    assert fp["median_red_chi2"] == pytest.approx(1.2)
+    assert fp["median_toa_err_us"] == pytest.approx(0.35)
+    # error inflation: chi2 > 1.5 among finite subints (8.0 only)
+    assert fp["n_error_inflated"] == 1
+
+
+def test_summarize_thresholds_from_env(monkeypatch):
+    monkeypatch.setenv("PPTPU_QUALITY_CHI2_BAD", "10.0")
+    monkeypatch.setenv("PPTPU_QUALITY_CHI2_INFLATED", "0.5")
+    fp = quality.summarize([8.0, 1.0], [0.1, 0.1])
+    assert fp["n_bad_chi2"] == 0 and fp["n_bad"] == 0
+    assert fp["n_error_inflated"] == 2
+    assert fp["chi2_bad_threshold"] == 10.0
+    monkeypatch.setenv("PPTPU_QUALITY_CHI2_BAD", "garbage")
+    assert quality.chi2_bad_threshold() == 3.0
+
+
+def test_whiteness_r1_contract():
+    rng = np.random.default_rng(3)
+    white = rng.normal(size=256)
+    r1 = quality.whiteness_r1(white, np.ones(256))
+    assert abs(r1) < 0.2
+    # a slow drift leaves strongly correlated residuals
+    drift = np.linspace(-1.0, 1.0, 256)
+    assert quality.whiteness_r1(drift, np.ones(256)) > 0.9
+    # too few points / zero variance are not a statement
+    assert quality.whiteness_r1([0.1, 0.2]) is None
+    assert quality.whiteness_r1([1.0, 1.0, 1.0, 1.0]) is None
+
+
+def test_gt_fingerprint_wideband_shape():
+    class GT:
+        ok_isubs = [np.array([0, 2])]
+        red_chi2s = [np.array([1.1, 99.0, 1.3])]
+        phi_errs = [np.array([1e-4, 1.0, 2e-4])]
+        Ps = [np.array([5e-3, 5e-3, 5e-3])]
+        snrs = [np.array([40.0, 0.0, 30.0])]
+        rcs = [np.array([0, 0, 0])]
+        phis = [np.array([0.1, 0.0, 0.11])]
+        n_nonfinite_zapped = [3]
+
+    fp = quality.gt_fingerprint(GT())
+    assert fp["n_subints"] == 2          # only the ok subints
+    assert fp["n_bad"] == 0              # 99.0 was never fitted
+    assert fp["n_zapped"] == 3
+    assert fp["median_toa_err_us"] == pytest.approx(
+        np.median([1e-4 * 5e-3 * 1e6, 2e-4 * 5e-3 * 1e6]))
+    # an object that fitted nothing fingerprints to None, not a crash
+    class Empty:
+        ok_isubs = []
+    assert quality.gt_fingerprint(Empty()) is None
+    assert quality.gt_fingerprint(object()) is None
+
+
+# -- disabled path ------------------------------------------------------
+
+
+def test_disabled_quality_is_noop(tmp_path, monkeypatch):
+    monkeypatch.delenv("PPTPU_OBS_DIR", raising=False)
+    assert obs.current() is None
+    assert quality.record_archive("a.fits", [1.0], [0.1]) is None
+    assert quality.fingerprint() is None
+    assert quality.group_fingerprints() is None
+    assert list(tmp_path.iterdir()) == []
+    # the pure summarize primitive itself works anywhere
+    assert quality.summarize([1.0], [0.1])["n_subints"] == 1
+
+
+def test_quality_state_lazy_and_absent_until_recorded(tmp_path,
+                                                      monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    with obs.run("lazy") as rec:
+        # no quality recorded: the read helpers must not CREATE state
+        assert quality.fingerprint() is None
+        assert rec._quality is None
+        run_dir = rec.dir
+    man = _manifest(run_dir)
+    assert "quality_subints" not in (man.get("counters") or {})
+    assert not any(k.endswith("quality_bad_fit_rate")
+                   for k in (man.get("gauges") or {}))
+
+
+# -- record_archive end to end ------------------------------------------
+
+
+def test_record_archive_feeds_event_counters_and_gauges(tmp_path,
+                                                        monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    with obs.run("qrun") as rec:
+        with quality.context(bucket="8x64", workload="toas"):
+            fp = quality.record_archive(
+                "good0.fits", [1.0, 1.1, 9.0], [0.2, 0.25, 4.0],
+                snrs=[30.0, 28.0, 2.0], rcs=[0, 0, 0],
+                phis=[0.1, 0.11, 0.4], phi_errs=[1e-3, 1e-3, 2e-2],
+                n_zapped=1, isubs=[0, 1, 3])
+        assert fp is not None and fp["n_bad"] == 1
+        assert quality.fingerprint()["n_subints"] == 3
+        groups = quality.group_fingerprints()
+        assert "8x64|toas" in groups
+        assert groups["8x64|toas"]["n_bad"] == 1
+        run_dir = rec.dir
+    (ev,) = [e for e in _events(run_dir) if e.get("kind") == "quality"]
+    assert ev["archive"] == "good0.fits"
+    assert ev["bucket"] == "8x64" and ev["workload"] == "toas"
+    assert ev["bad_isubs"] == [3]
+    assert ev["median_red_chi2"] == pytest.approx(1.1)
+    man = _manifest(run_dir)
+    assert man["counters"]["quality_subints"] == 3
+    assert man["counters"]["quality_bad_subints"] == 1
+    assert man["counters"]["quality_zapped"] == 1
+    assert man["gauges"]["quality_bad_fit_rate"] == pytest.approx(
+        1.0 / 3, abs=1e-6)
+    assert man["gauges"]["quality_median_red_chi2"] is not None
+    snap = metrics.last_snapshot(run_dir)
+    assert (snap["counters"] or {})[quality.CTR_SUBINTS] == 3
+    hist = (snap["histograms"] or {})[quality.HIST_RED_CHI2]
+    assert hist["count"] == 3
+    assert hist["per_octave"] == quality.CHI2_PER_OCTAVE
+
+
+def test_record_archive_never_fatal_on_garbage(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    with obs.run("garbage"):
+        assert quality.record_archive("x", object(), object()) is None
+        # a good record still lands after the bad one
+        assert quality.record_archive("y", [1.0], [0.1]) is not None
+
+
+# -- watch row ----------------------------------------------------------
+
+
+def test_render_watch_quality_row_merged_and_absent():
+    h = metrics.Histogram(quality.CHI2_LO, quality.CHI2_HI,
+                          quality.CHI2_PER_OCTAVE)
+    for v in (0.9, 1.0, 1.1, 5.0):
+        h.observe(v)
+    snap = {"t": 0.0, "seq": 1, "uptime_s": 0.0,
+            "counters": {"p0/" + quality.CTR_SUBINTS: 3,
+                         "p1/" + quality.CTR_SUBINTS: 1,
+                         "p1/" + quality.CTR_BAD_SUBINTS: 1},
+            "histograms": {quality.HIST_RED_CHI2: h.to_snapshot()}}
+    frame = metrics.render_watch(snap)
+    # merged p<proc>/ prefixes sum into one rate
+    assert "quality: bad-fit 25.00% (1/4)" in frame
+    assert "med chi2=" in frame
+    # a snapshot with no quality series keeps its pre-quality frame
+    assert "quality:" not in metrics.render_watch(
+        {"t": 0.0, "seq": 1, "counters": {"pps_requests_total": 3}})
+
+
+def test_torn_metrics_tail_keeps_quality_series(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    with obs.run("torn") as rec:
+        quality.record_archive("a.fits", [1.0, 1.2], [0.1, 0.2])
+        run_dir = rec.dir
+    with open(os.path.join(run_dir, "metrics.jsonl"), "a",
+              encoding="utf-8") as fh:
+        fh.write('{"t": 1, "counters": {"pps_quality_')  # torn append
+    snap = metrics.last_snapshot(run_dir)
+    assert snap is not None
+    assert (snap.get("counters") or {})[quality.CTR_SUBINTS] == 2
+    assert "quality: bad-fit" in metrics.render_watch(snap)
+
+
+# -- diff gate ----------------------------------------------------------
+
+
+def _quality_run(base, name, chi2s, errs, rcs=None):
+    with obs.run(name, base_dir=str(base)) as rec:
+        with obs.span("solve"):
+            pass
+        quality.record_archive("a.fits", chi2s, errs, rcs=rcs)
+        return rec.dir
+
+
+GOOD_CHI2 = [0.9, 1.0, 1.05, 1.1, 0.95, 1.0, 1.02, 0.98]
+GOOD_ERR = [0.2, 0.21, 0.2, 0.22, 0.19, 0.2, 0.21, 0.2]
+
+
+def test_tv_distance_contract():
+    from tools.obs_diff import tv_distance
+
+    h1 = metrics.Histogram(quality.CHI2_LO, quality.CHI2_HI,
+                           quality.CHI2_PER_OCTAVE)
+    h2 = metrics.Histogram(quality.CHI2_LO, quality.CHI2_HI,
+                           quality.CHI2_PER_OCTAVE)
+    for v in GOOD_CHI2:
+        h1.observe(v)
+        h2.observe(v)
+    assert tv_distance(h1.to_snapshot(), h2.to_snapshot()) == 0.0
+    h3 = metrics.Histogram(quality.CHI2_LO, quality.CHI2_HI,
+                           quality.CHI2_PER_OCTAVE)
+    for v in GOOD_CHI2:
+        h3.observe(v * 100.0)       # fully disjoint buckets
+    assert tv_distance(h1.to_snapshot(),
+                       h3.to_snapshot()) == pytest.approx(1.0)
+    # geometry mismatch is a schema change, not a shift
+    h4 = metrics.Histogram(quality.CHI2_LO, quality.CHI2_HI, 4)
+    h4.observe(1.0)
+    assert tv_distance(h1.to_snapshot(), h4.to_snapshot()) is None
+    assert tv_distance(None, h1.to_snapshot()) is None
+
+
+def test_obs_diff_quality_rel_gates_only_when_asked(tmp_path):
+    from tools import obs_diff
+
+    a = _quality_run(tmp_path / "a", "base", GOOD_CHI2, GOOD_ERR)
+    b = _quality_run(tmp_path / "b", "cand", GOOD_CHI2, GOOD_ERR)
+    # a numerically drifted candidate: chi2 distribution shifted up,
+    # one new bad fit
+    drifted = [v * 2.5 for v in GOOD_CHI2[:-1]] + [7.0]
+    c = _quality_run(tmp_path / "c", "drift", drifted, GOOD_ERR)
+    loose = ["--rel", "10.0", "--min-s", "10.0"]
+    # identical runs pass with and without the quality gate
+    assert obs_diff.main([a, b] + loose) == 0
+    assert obs_diff.main([a, b] + loose
+                         + ["--quality-rel", "0.25"]) == 0
+    # drifted: informational without --quality-rel ...
+    assert obs_diff.main([a, c] + loose) == 0
+    # ... and a regression with it
+    assert obs_diff.main([a, c] + loose
+                         + ["--quality-rel", "0.25"]) == 1
+    # floor: the same drift is ignored under --quality-min-subints
+    assert obs_diff.main([a, c] + loose + [
+        "--quality-rel", "0.25", "--quality-min-subints", "999"]) == 0
+
+
+def test_obs_diff_quality_catches_new_bad_fits_alone(tmp_path):
+    """Bad-fit parity is exact: one new non-converged subint fails the
+    gate even when the distributions barely move."""
+    from tools import obs_diff
+
+    a = _quality_run(tmp_path / "a", "base", GOOD_CHI2, GOOD_ERR,
+                     rcs=[0] * 8)
+    b = _quality_run(tmp_path / "b", "cand", GOOD_CHI2, GOOD_ERR,
+                     rcs=[0] * 7 + [5])
+    loose = ["--rel", "10.0", "--min-s", "10.0"]
+    assert obs_diff.main([a, b] + loose) == 0
+    assert obs_diff.main([a, b] + loose
+                         + ["--quality-rel", "0.25"]) == 1
+
+
+# -- pre-quality runs: absent, never broken -----------------------------
+
+
+def _plain_run(base, name):
+    with obs.run(name, base_dir=str(base)) as rec:
+        with obs.span("solve"):
+            pass
+        return rec.dir
+
+
+def test_report_pre_quality_run_absent_not_broken(tmp_path):
+    from tools.obs_report import summarize
+
+    run = _plain_run(tmp_path / "a", "old")
+    text = summarize(run)
+    assert "## quality" not in text
+    assert "## phases" in text and "solve" in text
+
+
+def test_report_renders_quality_section(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    from tools.obs_report import summarize
+
+    with obs.run("new") as rec:
+        with quality.context(bucket="8x64", workload="toas"):
+            quality.record_archive("good0.fits", GOOD_CHI2, GOOD_ERR)
+            quality.record_archive("bad0.fits", [9.0, 11.0],
+                                   [4.0, 5.0], isubs=[0, 1])
+        run_dir = rec.dir
+    text = summarize(run_dir)
+    assert "## quality" in text
+    assert "bad fits: 2" in text
+    # worst-first attribution: the bad archive leads the table
+    qsec = text[text.index("## quality"):]
+    assert qsec.index("bad0.fits") < qsec.index("good0.fits")
+    assert "8x64" in qsec
+    assert "bad subints (bad0.fits): [0, 1]" in qsec
+    assert "red_chi2: p10" in qsec
+
+
+def test_diff_pre_quality_runs_have_no_quality_rows(tmp_path, capsys):
+    from tools import obs_diff
+
+    a = _plain_run(tmp_path / "a", "old_a")
+    b = _plain_run(tmp_path / "b", "old_b")
+    rc = obs_diff.main([a, b, "--rel", "10.0", "--min-s", "10.0",
+                        "--quality-rel", "0.25"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "quality." not in out

@@ -55,7 +55,16 @@
 #                 (warm) peak, an obs_diff --mem-rel self-diff must
 #                 pass, and a synthetic run with 2x-inflated peaks
 #                 must exit nonzero (docs/OBSERVABILITY.md Memory)
-#  12. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
+#  12. quality smoke — the fit-quality plane end to end: a tiny survey
+#                 must render the ## quality report section with
+#                 per-archive attribution and the --watch quality row,
+#                 an obs_diff --quality-rel self-diff must pass, and
+#                 the SAME survey re-run with a truncated-mantissa
+#                 data-side DFT ($PPTPU_FOURIER_TRUNC_BITS, a numeric-
+#                 drift stand-in) must fail the quality gate while
+#                 every time/memory gate stays green
+#                 (docs/OBSERVABILITY.md Quality)
+#  13. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
 #
 # Exit status is non-zero when any stage fails.
 set -u
@@ -176,6 +185,17 @@ if [ $? -ne 0 ]; then
     fail=1
 else
     tail -1 /tmp/_memory_smoke.log
+fi
+
+echo
+echo "== quality smoke (fingerprint + quality-rel drift gate, docs/OBSERVABILITY.md) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu PPTPU_OBS_DIR="" PPTPU_FAULTS="" \
+    python -m tools.quality_smoke >/tmp/_quality_smoke.log 2>&1
+if [ $? -ne 0 ]; then
+    tail -40 /tmp/_quality_smoke.log
+    fail=1
+else
+    tail -1 /tmp/_quality_smoke.log
 fi
 
 echo

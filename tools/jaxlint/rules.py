@@ -95,6 +95,17 @@ _MEMORY_API_NAMES = {"sample", "watermarks", "last", "host_rss_bytes",
                      "record_oom", "is_oom", "device_memory_dump",
                      "memory_interval", "MemoryState"}
 
+# obs.quality (fit-quality fingerprints): host-side by contract —
+# record_archive / summarize pull per-subint arrays through numpy,
+# bump recorder counters under a lock and append a JSONL event; under
+# jit each would fingerprint the tracer seen at trace time (and the
+# runtime _has_tracer guard degrades them to no-ops anyway — the call
+# is dead code inside a trace).  Matched as ``quality.<name>`` /
+# ``obs.quality.<name>``.
+_QUALITY_API_NAMES = {"record_archive", "summarize", "fingerprint",
+                      "group_fingerprints", "gt_fingerprint",
+                      "whiteness_r1", "QualityState"}
+
 # survey-runner API (pulseportraiture_tpu.runner): host-side
 # orchestration by contract — file IO (header scans, JSONL ledger
 # appends, checkpoint rewrites) and process partitioning have no
@@ -491,6 +502,18 @@ class RuleVisitor(ast.NodeVisitor):
                           "the sampler's locks / dump-file IO cannot "
                           "exist in compiled code; sample around the "
                           "jit boundary (docs/OBSERVABILITY.md)")
+            elif fname is not None and (
+                    fname.rsplit(".", 1)[-1] in _QUALITY_API_NAMES
+                    and fname.startswith(("quality.",
+                                          "obs.quality."))):
+                self._add("J002", node,
+                          "obs.quality call inside a jitted function "
+                          "— fit-quality fingerprints are host-side "
+                          "by contract: they pull per-subint arrays "
+                          "through numpy and append recorder events, "
+                          "none of which can exist in compiled code; "
+                          "record quality after the device_get "
+                          "boundary (docs/OBSERVABILITY.md)")
             elif fname in ("jax.named_scope", "named_scope") and \
                     node.args and self._refs_traced(node.args[0]):
                 self._add("J002", node,

@@ -32,7 +32,17 @@ What is compared (run-vs-run mode):
   Without the flag memory rows are informational only — process-level
   watermarks jitter across unrelated runs; with it a candidate peak
   more than ``--mem-rel`` above baseline fails (``--mem-min-bytes``
-  floors out tiny phases).
+  floors out tiny phases);
+* fit quality (``--quality-rel``): scientific-correctness gating from
+  the quality fingerprint (obs/quality.py).  Subints fitted and bad
+  fits must match exactly (a numerically drifted run shows up first
+  as new bad fits), the reduced-chi^2 / TOA-error medians obey the
+  threshold, and the fixed-geometry distribution series are compared
+  by **total-variation distance** (0.5 * sum |p_i - q_i| over
+  normalized bucket mass; identical reruns give exactly 0, so the
+  self-diff gate is bit-tight) against the same threshold.  Without
+  the flag quality rows are informational; runs predating the quality
+  plane contribute no rows at all.
 
 Exit status: 0 = no regression, 1 = regression(s), 2 = usage/IO error.
 Wired into tools/check.sh as a smoke-vs-smoke self-diff stage (two
@@ -45,14 +55,73 @@ import os
 import sys
 
 from tools.obs_report import (devtime_phases, devtime_totals,
-                              find_run_dir, load_run, memory_phase_peaks,
+                              find_run_dir, load_metrics_snapshot,
+                              load_run, memory_phase_peaks,
                               merged_gauge, result_payload)
 
 # metric-name direction heuristics for BENCH payload mode
 _LOWER_IS_WORSE = ("per_sec", "fits_per_sec", "toas_per_sec", "value",
                    "vs_baseline", "gflops")
 _HIGHER_IS_WORSE = ("_sec", "_s", "_ns", "duration", "overhead",
-                    "resid", "err", "_bytes")
+                    "resid", "err", "_bytes", "red_chi2", "bad_fit")
+
+
+def quality_slice(manifest, run_dir):
+    """The comparable fit-quality slice of one run (obs/quality.py):
+    exact counters from the manifest (summed across ``p<proc>/`` shard
+    prefixes) plus the fixed-geometry distribution snapshots from the
+    run's merged metrics stream.  None for a run that predates the
+    quality plane — its diffs carry no quality rows at all."""
+    from pulseportraiture_tpu.obs import quality as q
+
+    counters = manifest.get("counters") or {}
+    snap = load_metrics_snapshot(run_dir)
+    hists = (snap or {}).get("histograms") or {}
+
+    def ctr(name):
+        return int(merged_gauge(counters, name))
+
+    n = ctr("quality_subints")
+    qhists = {name: hists[name] for name in
+              (q.HIST_RED_CHI2, q.HIST_TOA_ERR) if hists.get(name)}
+    if not n and not qhists:
+        return None
+    from pulseportraiture_tpu.obs.metrics import quantile
+
+    return {
+        "n_subints": n,
+        "n_bad": ctr("quality_bad_subints"),
+        "n_nonfinite": ctr("quality_nonfinite"),
+        "n_error_inflated": ctr("quality_error_inflated"),
+        "n_zapped": ctr("quality_zapped"),
+        "median_red_chi2": quantile(qhists.get(q.HIST_RED_CHI2), 0.5),
+        "median_toa_err_us": quantile(qhists.get(q.HIST_TOA_ERR), 0.5),
+        "hists": qhists,
+    }
+
+
+def tv_distance(ha, hb):
+    """Total-variation distance between two histogram snapshots'
+    normalized bucket distributions: 0.5 * sum |p_i - q_i| over the
+    bucket union (under/overflow included as buckets).  Bucket counts
+    are exact integers, so two bit-identical reruns give exactly 0.0.
+    None when either side is empty or the geometries differ (a schema
+    change is not a distribution shift)."""
+    if not ha or not hb or not ha.get("count") or not hb.get("count"):
+        return None
+    if any(ha.get(k) != hb.get(k) for k in ("lo", "hi", "per_octave")):
+        return None
+
+    def dist(h):
+        d = {str(i): int(c) for i, c in (h.get("counts") or {}).items()}
+        for edge in ("under", "over"):
+            if h.get(edge):
+                d[edge] = int(h[edge])
+        tot = float(sum(d.values()))
+        return {k: v / tot for k, v in d.items()}
+    pa, pb = dist(ha), dist(hb)
+    return 0.5 * sum(abs(pa.get(k, 0.0) - pb.get(k, 0.0))
+                     for k in set(pa) | set(pb))
 
 
 def run_summary(run_dir):
@@ -98,6 +167,7 @@ def run_summary(run_dir):
         "n_bad": n_bad,
         "fit_subints": n_sub,
         "counters": counters,
+        "quality": quality_slice(manifest, run_dir),
     }
 
 
@@ -181,13 +251,70 @@ def _fmt(x):
     return str(x)
 
 
+def _diff_quality(d, qa, qb, quality_rel, quality_min_subints):
+    """Quality rows of a run-vs-run diff; ``quality_rel=None`` renders
+    them informational (mirrors the memory rows)."""
+    if not qa and not qb:
+        return                      # both pre-quality runs: no rows
+    qa, qb = qa or {}, qb or {}
+    gate = quality_rel is not None and max(
+        qa.get("n_subints") or 0,
+        qb.get("n_subints") or 0) >= quality_min_subints
+    if quality_rel is not None and not gate:
+        d.rows.append(("quality.n_subints",
+                       _fmt(qa.get("n_subints")),
+                       _fmt(qb.get("n_subints")), "-",
+                       "info (< quality-min-subints)"))
+        return
+    if not gate:
+        for key in ("n_subints", "n_bad", "median_red_chi2",
+                    "median_toa_err_us"):
+            d.rows.append(("quality.%s" % key, _fmt(qa.get(key)),
+                           _fmt(qb.get(key)), "-", "info"))
+        return
+    # exact work parity first: a run that fit a different number of
+    # subints (or produced new bad fits) is scientifically different,
+    # regardless of how the distributions compare
+    d.exact("quality.n_subints", qa.get("n_subints"),
+            qb.get("n_subints"))
+    d.exact("quality.n_bad", qa.get("n_bad"), qb.get("n_bad"))
+    d.exact("quality.n_nonfinite", qa.get("n_nonfinite"),
+            qb.get("n_nonfinite"))
+    d.exact("quality.n_error_inflated", qa.get("n_error_inflated"),
+            qb.get("n_error_inflated"))
+    d.check("quality.median_red_chi2", qa.get("median_red_chi2"),
+            qb.get("median_red_chi2"), quality_rel)
+    d.check("quality.median_toa_err_us", qa.get("median_toa_err_us"),
+            qb.get("median_toa_err_us"), quality_rel)
+    for name in sorted(set(qa.get("hists") or {})
+                       | set(qb.get("hists") or {})):
+        tv = tv_distance((qa.get("hists") or {}).get(name),
+                         (qb.get("hists") or {}).get(name))
+        metric = "quality.%s.tv_distance" % name
+        if tv is None:
+            d.rows.append((metric, "-", "-", "-", "missing"))
+        elif tv > quality_rel:
+            d.regressions.append(
+                "%s: distribution shifted (TV %.4f > %.2f)"
+                % (metric, tv, quality_rel))
+            d.rows.append((metric, "0", "%.4f" % tv, "-",
+                           "REGRESSION"))
+        else:
+            d.rows.append((metric, "0", "%.4f" % tv, "-", "ok"))
+
+
 def diff_runs(a, b, rel=0.3, min_s=0.05, compile_rel=None,
-              bad_allow=0, mem_rel=None, mem_min_bytes=1 << 20):
+              bad_allow=0, mem_rel=None, mem_min_bytes=1 << 20,
+              quality_rel=None, quality_min_subints=8):
     """Diff two run summaries; returns a :class:`Diff`.
 
     ``mem_rel=None`` (the default) renders memory rows as
     informational; a threshold gates per-phase peak bytes and the
     run-level peak, with baselines under ``mem_min_bytes`` floored out.
+    ``quality_rel`` likewise turns the fit-quality rows from
+    informational into gated (exact subint/bad-fit parity, median and
+    distribution-shift thresholds), floored by
+    ``quality_min_subints``.
     """
     if compile_rel is None:
         compile_rel = max(rel, 1.0)
@@ -239,6 +366,8 @@ def diff_runs(a, b, rel=0.3, min_s=0.05, compile_rel=None,
             d.rows.append(("n_bad", nb_a, nb_b, "-", "REGRESSION"))
         else:
             d.rows.append(("n_bad", nb_a, nb_b, "-", "ok"))
+    _diff_quality(d, a.get("quality"), b.get("quality"), quality_rel,
+                  quality_min_subints)
     return d
 
 
@@ -303,6 +432,19 @@ def build_parser():
                    dest="mem_min_bytes",
                    help="Memory baselines under this many bytes never "
                         "fail (default 1MiB).")
+    p.add_argument("--quality-rel", type=float, default=None,
+                   dest="quality_rel",
+                   help="Gate the fit-quality fingerprint: exact "
+                        "subint/bad-fit parity, chi^2 and TOA-error "
+                        "medians at this relative threshold, and "
+                        "distribution total-variation distance above "
+                        "it fails.  Without the flag quality rows are "
+                        "informational only.")
+    p.add_argument("--quality-min-subints", type=int, default=8,
+                   dest="quality_min_subints",
+                   help="Quality gating needs at least this many "
+                        "fitted subints on one side (default 8) — "
+                        "medians of two subints are all jitter.")
     return p
 
 
@@ -327,7 +469,9 @@ def main(argv=None):
                       rel=args.rel, min_s=args.min_s,
                       compile_rel=args.compile_rel,
                       bad_allow=args.bad_allow, mem_rel=args.mem_rel,
-                      mem_min_bytes=args.mem_min_bytes)
+                      mem_min_bytes=args.mem_min_bytes,
+                      quality_rel=args.quality_rel,
+                      quality_min_subints=args.quality_min_subints)
         print("# obs diff: %s vs %s" % (side_a, side_b))
     print(d.table())
     if d.regressions:

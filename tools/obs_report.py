@@ -415,6 +415,81 @@ def summarize_fits(events):
     return "\n".join(lines)
 
 
+def summarize_quality(manifest, events, snapshot=None):
+    """The fit-quality plane (obs/quality.py): run-level fingerprint,
+    distribution quantiles from the fixed-geometry histogram series,
+    and a worst-first per-archive attribution table.  None when the
+    run carries no quality telemetry — pre-quality runs render their
+    original report unchanged."""
+    from pulseportraiture_tpu.obs import quality as q
+    from pulseportraiture_tpu.obs.metrics import percentiles
+
+    counters = manifest.get("counters") or {}
+    quals = [e for e in events if e.get("kind") == "quality"]
+    n = int(_num(counters.get("quality_subints")))
+    if not n and not quals:
+        return None
+    if not n:
+        n = sum(int(_num(e.get("n_subints"))) for e in quals)
+    bad = int(_num(counters.get("quality_bad_subints")))
+    if not bad and quals:
+        bad = sum(int(_num(e.get("n_bad"))) for e in quals)
+    thr = quals[-1].get("chi2_bad_threshold") if quals else None
+    lines = ["subints: %d   bad fits: %d (%.2f%%)%s"
+             % (n, bad, 100.0 * bad / n if n else 0.0,
+                "   (red_chi2 > %g | rc non-converged | non-finite)"
+                % thr if thr is not None else "")]
+    detail = []
+    for ctr, label in (("quality_bad_chi2", "chi2"),
+                       ("quality_bad_rc", "rc"),
+                       ("quality_nonfinite", "nonfinite"),
+                       ("quality_error_inflated", "error-inflated"),
+                       ("quality_zapped", "zapped")):
+        v = int(_num(counters.get(ctr)))
+        if v:
+            detail.append("%s %d" % (label, v))
+    if detail:
+        lines.append("breakdown: " + "  ".join(detail))
+    hists = (snapshot or {}).get("histograms") or {}
+    for name, label, fmt in ((q.HIST_RED_CHI2, "red_chi2", "%.4g"),
+                             (q.HIST_TOA_ERR, "TOA err [us]", "%.4g"),
+                             (q.HIST_SNR, "snr", "%.4g")):
+        ps = percentiles(hists.get(name), qs=(0.1, 0.5, 0.9))
+        if ps:
+            h = hists.get(name)
+            lines.append("%s: p10 %s / p50 %s / p90 %s / max %s"
+                         % (label, fmt % ps[0.1], fmt % ps[0.5],
+                            fmt % ps[0.9], fmt % _num(h.get("max"))))
+    if quals:
+        rows = []
+        for e in sorted(quals,
+                        key=lambda e: (-int(_num(e.get("n_bad"))),
+                                       -_num(e.get("median_red_chi2")))):
+            rows.append([os.path.basename(str(e.get("archive") or "?")),
+                         e.get("bucket") or "-",
+                         e.get("workload") or e.get("tenant") or "-",
+                         int(_num(e.get("n_subints"))),
+                         int(_num(e.get("n_bad"))),
+                         "%.4g" % _num(e.get("median_red_chi2")),
+                         "%.4g" % _num(e.get("median_toa_err_us")),
+                         "-" if e.get("whiteness_r1") is None
+                         else "%.2f" % _num(e.get("whiteness_r1"))])
+        lines.append("")
+        lines.append(_table(["archive", "bucket", "workload", "n",
+                             "bad", "med_chi2", "med_err_us", "r1"],
+                            rows[:12]))
+        if len(rows) > 12:
+            lines.append("... %d more archive(s)" % (len(rows) - 12))
+        # per-subint attribution: exactly which subints went bad where
+        for e in quals:
+            if e.get("bad_isubs"):
+                lines.append("  bad subints (%s): %s"
+                             % (os.path.basename(
+                                 str(e.get("archive") or "?")),
+                                e["bad_isubs"]))
+    return "\n".join(lines)
+
+
 _ROBUSTNESS_EVENTS = ("fault_injected", "watchdog_fired",
                       "sigterm_drain", "barrier_timeout",
                       "nonfinite_guard", "lease_expired",
@@ -775,6 +850,11 @@ def summarize(run_dir):
         out.append("## fit telemetry (per-subint convergence)")
         out.append(fits)
     msnap = load_metrics_snapshot(run_dir)
+    qual = summarize_quality(manifest, events, snapshot=msnap)
+    if qual:
+        out.append("")
+        out.append("## quality (fit-quality fingerprint)")
+        out.append(qual)
     lat = summarize_latency(msnap)
     if lat:
         out.append("")

@@ -1,0 +1,172 @@
+"""Quality smoke gate: the fit-quality plane end to end (wired into
+tools/check.sh).
+
+Drives the same tiny synthetic survey as tools/memory_smoke.py twice
+and asserts the quality contract docs/OBSERVABILITY.md names:
+
+* the merged run's ``tools/obs_report.py`` summary renders a
+  ``## quality`` section with per-archive attribution (which archive,
+  which bucket) and the ``--watch`` frame carries the quality row;
+* an ``obs_diff --quality-rel`` self-diff of the two identical
+  surveys passes — bucket counts are exact integers, so the
+  total-variation distance of a bit-deterministic rerun is 0;
+* a third survey re-run in a SUBPROCESS with
+  ``$PPTPU_FOURIER_TRUNC_BITS=5`` — the reduced-precision data-side
+  DFT stand-in hook in ops/fourier.py, a stand-in for a numerically
+  drifted kernel — fails ``--quality-rel`` (the chi^2 distribution
+  shifts and new bad fits appear) while the existing time and memory
+  gates on the very same pair stay green: the drift is invisible to
+  every pre-quality observable.
+
+The perturbed run must be a fresh process: the hook reads the env var
+at TRACE time, so an in-process re-run would reuse jit-cached
+programs built with the old value.
+
+Run:  env JAX_PLATFORMS=cpu python -m tools.quality_smoke
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+QUALITY_REL = 0.25
+MEM_REL = 0.25
+TRUNC_BITS = "3"
+
+
+def _build_inputs(workroot):
+    from pulseportraiture_tpu.io.archive import make_fake_pulsar
+    from pulseportraiture_tpu.io.gmodel import write_model
+
+    gm = os.path.join(workroot, "smoke.gmodel")
+    write_model(gm, "smoke", "000", 1500.0,
+                np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5]),
+                np.ones(8, int), -4.0, 0, quiet=True)
+    par = os.path.join(workroot, "smoke.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    files = []
+    for i, (nchan, nbin) in enumerate([(8, 64), (8, 128)]):
+        fits = os.path.join(workroot, "good%d.fits" % i)
+        make_fake_pulsar(gm, par, fits, nsub=2, nchan=nchan, nbin=nbin,
+                         nu0=1500.0, bw=800.0, tsub=60.0, phase=0.05,
+                         dDM=5e-4, noise_stds=0.01, dedispersed=False,
+                         seed=11 + i, quiet=True)
+        files.append(fits)
+    meta = os.path.join(workroot, "survey.meta")
+    with open(meta, "w") as f:
+        f.write("\n".join(files) + "\n")
+    return meta, gm
+
+
+def _survey(meta, gm, workdir):
+    from pulseportraiture_tpu.runner import plan_survey, run_survey
+
+    plan = plan_survey(meta, modelfile=gm)
+    summary = run_survey(plan, workdir, process_index=0,
+                         process_count=1, bary=False)
+    assert summary["counts"]["done"] == 2, summary["counts"]
+    merged = summary.get("obs_merged")
+    assert merged and os.path.isdir(merged), summary
+    return merged
+
+
+def _child(meta, gm, workdir):
+    """Perturbed-subprocess entry: one survey, merged run dir on the
+    last stdout line (the parent parses ``MERGED <path>``)."""
+    merged = _survey(meta, gm, workdir)
+    print("MERGED %s" % merged)
+    return 0
+
+
+def _perturbed_survey(meta, gm, workdir):
+    env = dict(os.environ)
+    env["PPTPU_FOURIER_TRUNC_BITS"] = TRUNC_BITS
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.quality_smoke", "--child",
+         meta, gm, workdir],
+        env=env, capture_output=True, text=True, timeout=540,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0, \
+        "perturbed child failed (rc %d):\n%s\n%s" \
+        % (proc.returncode, proc.stdout[-2000:], proc.stderr[-2000:])
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("MERGED "):
+            return line.split(" ", 1)[1].strip()
+    raise AssertionError("perturbed child printed no MERGED line:\n%s"
+                         % proc.stdout[-2000:])
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        return _child(*sys.argv[2:5])
+    workroot = tempfile.mkdtemp(prefix="pptpu_quality_smoke_")
+    try:
+        from tools import obs_diff
+        from tools.obs_report import load_metrics_snapshot, summarize
+
+        meta, gm = _build_inputs(workroot)
+        run_a = _survey(meta, gm, os.path.join(workroot, "wd_a"))
+        run_b = _survey(meta, gm, os.path.join(workroot, "wd_b"))
+
+        # 1. the report renders the quality plane with attribution
+        text = summarize(run_a)
+        assert "## quality" in text, text
+        assert "bad fits:" in text, text
+        assert "good0.fits" in text and "good1.fits" in text, text
+        assert "med_chi2" in text, text
+
+        # 2. the --watch frame carries the quality row (merged
+        # snapshot: counters summed, distribution series merged)
+        from pulseportraiture_tpu.obs import metrics
+
+        snap = load_metrics_snapshot(run_a)
+        assert snap is not None, "merged run has no metrics snapshot"
+        frame = metrics.render_watch(snap)
+        assert "quality: bad-fit" in frame, frame
+
+        # 3. identical surveys self-diff clean under the quality gate
+        # (and the memory gate, simultaneously)
+        rc = obs_diff.main([run_a, run_b, "--rel", "5.0", "--min-s",
+                            "1.0", "--mem-rel", str(MEM_REL),
+                            "--quality-rel", str(QUALITY_REL),
+                            "--quality-min-subints", "4"])
+        assert rc == 0, \
+            "self-diff flagged a quality regression (rc %d)" % rc
+
+        # 4. the numerically perturbed survey fails the quality gate...
+        bad = _perturbed_survey(meta, gm, os.path.join(workroot,
+                                                       "wd_bad"))
+        rc = obs_diff.main([run_a, bad, "--rel", "5.0", "--min-s",
+                            "1.0", "--quality-rel", str(QUALITY_REL),
+                            "--quality-min-subints", "4"])
+        assert rc == 1, \
+            "quality gate missed the %s-bit truncated DFT (rc %d)" \
+            % (TRUNC_BITS, rc)
+
+        # 5. ...while the pre-quality gates on the same pair stay
+        # green: wall/device/compile/convergence/memory all pass, the
+        # drift is only visible to the quality plane
+        rc = obs_diff.main([run_a, bad, "--rel", "5.0", "--min-s",
+                            "1.0", "--mem-rel", str(MEM_REL)])
+        assert rc == 0, \
+            "time/memory gates flagged the perturbed run (rc %d) — " \
+            "the smoke needs a drift only quality can see" % rc
+
+        print("quality smoke OK: report + watch row + quality-rel "
+              "gate (self-diff clean, %s-bit truncation caught) at %s"
+              % (TRUNC_BITS, run_a))
+        return 0
+    finally:
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
