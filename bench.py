@@ -33,6 +33,11 @@ extra carries the other BASELINE.md configs and the accuracy criterion:
 - hetero_*: mixed-shape GetTOAs stress — cold (per-shape compiles
   included) vs warm wall, their difference being the compile churn a
   heterogeneous survey pays once per shape set (_hetero_stress).
+- survey_archives_per_s / survey_serial_archives_per_s /
+  prefetch_hit_rate / prefetch_depth: warm survey throughput with the
+  double-buffered host prefetch stage (--prefetch 2) vs the serial
+  loader on the same archives (_survey_prefetch_stage,
+  docs/RUNNER.md "Host pipeline").
 - gflops_approx: rough sustained FLOP/s from an rFFT+iteration count.
 """
 
@@ -193,6 +198,74 @@ def _hetero_stress(on_accel):
         return cold, warm, ntoa, config
     finally:
         shutil.rmtree(hdir, ignore_errors=True)
+
+
+def _survey_prefetch_stage(on_accel):
+    """Serial-vs-prefetch survey throughput (docs/RUNNER.md "Host
+    pipeline"): the same archive set surveyed warm with the serial
+    loader and with ``prefetch=2``, in fresh workdirs so both runs fit
+    every archive.  Returns (serial_rate, prefetch_rate, hit_rate,
+    depth) in archives/s; hit_rate is read back from the obs run's
+    ``pps_prefetch_hits``/``pps_prefetch_misses`` counter deltas
+    (run_survey's obs.run is reentrant and joins the bench recorder).
+    """
+    import shutil
+    import tempfile
+
+    from pulseportraiture_tpu.io.archive import make_fake_pulsar
+    from pulseportraiture_tpu.runner import plan_survey, run_survey
+
+    depth = 2
+    n_arch = 12 if on_accel else 6
+    nchan, nbin = (64, 512) if on_accel else (32, 256)
+    sdir = tempfile.mkdtemp(prefix="pp_bench_prefetch_")
+    try:
+        sgm, spar = _bench_source(sdir)
+        s_rng = np.random.default_rng(8)
+        sfiles = []
+        for i in range(n_arch):
+            out = os.path.join(sdir, "s%03d.fits" % i)
+            make_fake_pulsar(sgm, spar, out, nsub=2, nchan=nchan,
+                             nbin=nbin, nu0=1500.0, bw=800.0, tsub=60.0,
+                             phase=float(s_rng.uniform(-0.2, 0.2)),
+                             dDM=float(s_rng.normal(0, 1e-3)),
+                             noise_stds=0.01, dedispersed=False,
+                             seed=900 + i, quiet=True)
+            sfiles.append(out)
+        plan = plan_survey(sfiles)
+
+        def survey(tag, pf):
+            wd = os.path.join(sdir, "wd_%s" % tag)
+            t0 = time.time()
+            run_survey(plan, wd, modelfile=sgm, merge=False,
+                       prefetch=pf, bary=False, quiet=True)
+            return time.time() - t0
+
+        # warm-up: compile the bucket program once so both timed runs
+        # measure the host pipeline, not XLA
+        _stage('survey prefetch: warm-up (%d archives)' % n_arch)
+        survey("warm", 0)
+        _stage('survey prefetch: serial timed run')
+        serial_dur = survey("serial", 0)
+        rec = obs.current()
+        h0 = m0 = 0
+        if rec is not None:
+            h0 = int(rec.counters.get("pps_prefetch_hits", 0))
+            m0 = int(rec.counters.get("pps_prefetch_misses", 0))
+        _stage('survey prefetch: prefetch=%d timed run' % depth)
+        pf_dur = survey("pf", depth)
+        hit_rate = None
+        if rec is not None:
+            hits = int(rec.counters.get("pps_prefetch_hits", 0)) - h0
+            misses = int(rec.counters.get("pps_prefetch_misses",
+                                          0)) - m0
+            if hits + misses:
+                hit_rate = hits / (hits + misses)
+        _stage('survey prefetch: serial %.1fs, prefetch %.1fs'
+               % (serial_dur, pf_dur))
+        return (n_arch / serial_dur, n_arch / pf_dur, hit_rate, depth)
+    finally:
+        shutil.rmtree(sdir, ignore_errors=True)
 
 
 def main():
@@ -446,6 +519,11 @@ def _bench():
         hetero_cold, hetero_warm, hetero_ntoa, hetero_config = \
             _hetero_stress(on_accel)
 
+    # ---- host pipeline: serial vs prefetch survey throughput ----------
+    with obs.span("survey_prefetch"):
+        survey_serial_rate, survey_pf_rate, pf_hit_rate, pf_depth = \
+            _survey_prefetch_stage(on_accel)
+
     # ---- rough sustained FLOP/s for the main config -------------------
     # per subint: rFFT (5 N log2 N per channel) + ~n_iter fused moment
     # passes of ~40 flops per (channel, harmonic)
@@ -492,6 +570,12 @@ def _bench():
             "hetero_toas_per_sec_warm": round(hetero_ntoa / hetero_warm,
                                               3),
             "hetero_config": hetero_config + " incl. FITS IO",
+            "prefetch_depth": pf_depth,
+            "survey_archives_per_s": round(survey_pf_rate, 3),
+            "survey_serial_archives_per_s": round(survey_serial_rate,
+                                                  3),
+            "prefetch_hit_rate": None if pf_hit_rate is None
+            else round(pf_hit_rate, 3),
             "gflops_approx": round(float(gflops), 1),
             "backend_fallback": ns.backend_fallback,
         },

@@ -73,6 +73,12 @@ def build_parser():
                     dest="tenant_max_queue",
                     help="Per-tenant open-request budget; beyond it "
                          "submissions get 'backpressure' rejections.")
+    st.add_argument("--prefetch", type=int, default=2, metavar="N",
+                    help="Decode-at-intake pool depth: up to N "
+                         "accepted requests decode + pad on the host "
+                         "prefetch pool during the micro-batch window "
+                         "(docs/SERVICE.md; 0 = decode inline in the "
+                         "fit worker).")
     st.add_argument("--max_attempts", type=int, default=3,
                     help="Retries before a request is quarantined.")
     st.add_argument("--backoff", type=float, default=1.0,
@@ -178,6 +184,7 @@ def _cmd_start(args):
         tenant_max_inflight=args.tenant_max_inflight,
         tenant_max_queue=args.tenant_max_queue,
         max_attempts=args.max_attempts, backoff_s=args.backoff,
+        prefetch=args.prefetch,
         run_dirs_max=args.run_dirs_max,
         run_bytes_max=args.run_bytes_max,
         get_toas_kw=fit_kw, quiet=args.quiet)

@@ -113,6 +113,13 @@ def build_parser():
                             "NaN/Inf (below it, bad channels are "
                             "zero-weighted and counted as "
                             "n_nonfinite_zapped).")
+        r.add_argument("--prefetch", type=int, default=2, metavar="N",
+                       help="Claim-ahead depth of the host prefetch "
+                            "stage: decode + pad the next N archives "
+                            "on a background thread while the current "
+                            "one fits (docs/RUNNER.md Host pipeline). "
+                            "0 = serial load, bit-identical results "
+                            "either way.")
         r.add_argument("--mesh", action="store_true", dest="use_mesh",
                        help="Shard each bucket batch over the local "
                             "device mesh.")
@@ -218,7 +225,7 @@ def _cmd_run(args):
         trace_bucket=args.trace_bucket, watchdog_s=args.watchdog_s,
         barrier_timeout_s=args.barrier_timeout_s,
         lease_s=args.lease_s, narrowband=args.narrowband,
-        workload=workload,
+        workload=workload, prefetch=args.prefetch,
         workload_opts=_parse_workload_opts(args.workload_opts),
         quiet=args.quiet, **fit_kw)
     out = {"workload": summary.get("workload", workload),

@@ -8,10 +8,13 @@ carries the same field names the reference's pipelines consume
 """
 
 import os
+import time
 
 import numpy as np
 
 from ..config import host_stats_device
+from ..obs import metrics
+from ..obs.metrics import PHASE_HISTOGRAM
 from ..ops.fourier import get_bin_centers
 from ..testing import faults
 from ..ops.noise import get_SNR, get_noise
@@ -61,6 +64,7 @@ def load_data(filename, state=None, dedisperse=False, dededisperse=False,
     # truncated payload or NFS blip (testing/faults.py)
     faults.check("archive_read", key=getattr(filename, "filename",
                                              None) or str(filename))
+    t_decode0 = time.perf_counter()
     arch = filename if isinstance(filename, Archive) \
         else read_archive(filename)
     if refresh_arch:
@@ -145,6 +149,11 @@ def load_data(filename, state=None, dedisperse=False, dededisperse=False,
         prof_noise = float(np.asarray(get_noise(prof)))
         prof_SNR = float(np.asarray(get_SNR(prof)))
 
+    # the host-pipeline accounting unit: where this time lands — on the
+    # fit timeline (serial) or on a prefetch thread (--prefetch) — is
+    # the whole point of docs/RUNNER.md "Host pipeline"
+    metrics.observe(PHASE_HISTOGRAM, time.perf_counter() - t_decode0,
+                    phase="decode")
     return DataBunch(
         arch=arch if return_arch else None, backend=arch.backend,
         backend_delay=arch.backend_delay, bw=bw,

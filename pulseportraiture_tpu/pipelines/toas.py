@@ -37,7 +37,8 @@ from ..ops.stats import weighted_mean
 from ..testing import faults
 from ..utils.databunch import DataBunch
 
-__all__ = ["GetTOAs", "drop_checkpoint_blocks", "checkpoint_traces"]
+__all__ = ["GetTOAs", "drop_checkpoint_blocks", "checkpoint_traces",
+           "load_archive_data"]
 
 # Per-checkpoint-file locks: the TOA service (service/daemon.py) runs
 # several requests of one tenant concurrently to micro-batch their
@@ -274,6 +275,44 @@ def _detect_model_type(modelfile):
     return "spline"  # npz or legacy pickle container
 
 
+# preload-table miss sentinel (None is a valid load outcome, so a
+# plain dict.get default cannot stand in for "nothing was prefetched")
+_PRELOAD_MISS = object()
+
+
+def load_archive_data(datafile, tscrunch=False, quiet=True):
+    """The host-side archive load shared by :meth:`GetTOAs._load_archive`
+    and the prefetch stage (runner/prefetch.py): load_data with the
+    reference's dmc-reload fallback (pptoas.py:216-233).  Returns the
+    DataBunch or None on failure.  Because both the serial fit loop and
+    the prefetch threads run this exact function, a prefetched buffer
+    is bit-identical to a serial load and the ``archive_read`` fault
+    site (io/archive.py) fires wherever the load actually runs.
+    """
+    try:
+        data = load_data(datafile, dedisperse=False,
+                         dededisperse=False, tscrunch=tscrunch,
+                         pscrunch=True, rm_baseline=True,
+                         refresh_arch=False, return_arch=False,
+                         quiet=quiet)
+        if data.dmc:
+            data = load_data(datafile, dedisperse=False,
+                             dededisperse=True, tscrunch=tscrunch,
+                             pscrunch=True, rm_baseline=True,
+                             refresh_arch=False, return_arch=False,
+                             quiet=quiet)
+        if not len(data.ok_isubs):
+            if not quiet:
+                print(f"No subints to fit for {datafile}; "
+                      f"skipping it.")
+            return None
+        return data
+    except (RuntimeError, ValueError, OSError) as e:
+        if not quiet:
+            print(f"Cannot load_data({datafile}): {e}; skipping it.")
+        return None
+
+
 class GetTOAs:
     """Measure wideband TOAs/DMs (+GM, tau, alpha) from archives.
 
@@ -326,6 +365,10 @@ class GetTOAs:
         # monkeypatch the module attribute); the survey runner installs
         # a mesh-sharded fitter here (runner/execute.py)
         self.fit_batch = None
+        # prefetched load outcomes keyed by realpath, installed by
+        # preload() and consumed (once) by _load_archive — the hand-off
+        # end of the host prefetch stage (runner/prefetch.py)
+        self._preloaded = {}
         for attr in self.RESULT_ATTRS:
             setattr(self, attr, [])
         self.TOA_list = []
@@ -371,30 +414,35 @@ class GetTOAs:
 
     # -- archive loading with the dmc-reload degraded mode --------------
     def _load_archive(self, datafile, tscrunch, quiet):
-        """load_data with the reference's dmc-reload fallback
-        (pptoas.py:216-233); returns the DataBunch or None on failure."""
-        try:
-            data = load_data(datafile, dedisperse=False,
-                             dededisperse=False, tscrunch=tscrunch,
-                             pscrunch=True, rm_baseline=True,
-                             refresh_arch=False, return_arch=False,
-                             quiet=quiet)
-            if data.dmc:
-                data = load_data(datafile, dedisperse=False,
-                                 dededisperse=True, tscrunch=tscrunch,
-                                 pscrunch=True, rm_baseline=True,
-                                 refresh_arch=False, return_arch=False,
+        """load_archive_data, with prefetched outcomes replayed
+        verbatim (see preload)."""
+        hit = self._take_preloaded(datafile)
+        if hit is not _PRELOAD_MISS:
+            kind, val = hit
+            if kind == "raise":
+                raise val
+            return val
+        return load_archive_data(datafile, tscrunch=tscrunch,
                                  quiet=quiet)
-            if not len(data.ok_isubs):
-                if not quiet:
-                    print(f"No subints to fit for {datafile}; "
-                          f"skipping it.")
-                return None
-            return data
-        except (RuntimeError, ValueError, OSError) as e:
-            if not quiet:
-                print(f"Cannot load_data({datafile}): {e}; skipping it.")
-            return None
+
+    # -- host prefetch hand-off (runner/prefetch.py) --------------------
+    def preload(self, datafile, outcome):
+        """Install a prefetched load outcome for ``datafile``:
+        ``("data", DataBunch_or_None)`` or ``("raise", exc)``.  The next
+        ``_load_archive(datafile)`` replays it instead of touching the
+        filesystem — returning or raising exactly what the serial load
+        path would have, from the same call site, so result values and
+        failure chains are identical whether the load ran inline or on
+        a prefetch thread (docs/RUNNER.md "Host pipeline")."""
+        self._preloaded[os.path.realpath(datafile)] = tuple(outcome)
+
+    def _take_preloaded(self, datafile):
+        """Pop the prefetched outcome for ``datafile`` (consume-once),
+        or the module sentinel _PRELOAD_MISS when none was installed."""
+        if not self._preloaded:
+            return _PRELOAD_MISS
+        return self._preloaded.pop(os.path.realpath(datafile),
+                                   _PRELOAD_MISS)
 
     def _prepare_models(self, d, ports, freqs_b, Ps_b, fit_scat,
                         add_instrumental_response, datafile):

@@ -18,16 +18,19 @@ the obs API.
 """
 
 from .plan import (ArchiveInfo, ShapeBucket, SurveyPlan, canonical_shape,
-                   pad_databunch, plan_survey, scan_archive_header)
+                   load_bucketed_databunch, pad_databunch, plan_survey,
+                   scan_archive_header)
 from .queue import DEFAULT_WORKLOAD, WorkQueue
 from .execute import run_survey, survey_status
+from .prefetch import HostPrefetcher, PrefetchTicket
 from .workloads import (AlignWorkload, ModelFitWorkload, ToasWorkload,
                         Workload, ZapWorkload, get_workload,
                         register_workload, resolve_workload,
                         workload_names)
 
 __all__ = ["ArchiveInfo", "ShapeBucket", "SurveyPlan", "canonical_shape",
-           "pad_databunch", "plan_survey", "scan_archive_header",
+           "load_bucketed_databunch", "pad_databunch", "plan_survey",
+           "scan_archive_header", "HostPrefetcher", "PrefetchTicket",
            "WorkQueue", "DEFAULT_WORKLOAD", "run_survey",
            "survey_status", "Workload", "ToasWorkload", "ZapWorkload",
            "AlignWorkload", "ModelFitWorkload", "register_workload",

@@ -47,7 +47,9 @@ over a ('subint', 'chan') mesh via :func:`make_mesh_fitter`
 pipeline's per-archive fit configuration.
 """
 
+import collections
 import contextlib
+import functools
 import itertools
 import json
 import os
@@ -62,8 +64,10 @@ from .. import obs
 from ..obs import memory, metrics, quality, tracing
 from ..obs.merge import merge_obs_shards, write_shard
 from ..obs.metrics import PHASE_HISTOGRAM
-from ..pipelines.toas import GetTOAs, drop_checkpoint_blocks
-from .plan import SurveyPlan, pad_databunch
+from ..pipelines.toas import _PRELOAD_MISS, GetTOAs, \
+    drop_checkpoint_blocks
+from .plan import SurveyPlan, load_bucketed_databunch
+from .prefetch import HostPrefetcher
 from .queue import DEFAULT_WORKLOAD, DONE, FAILED, QUARANTINED, \
     RUNNING, WorkQueue, owner_pid
 
@@ -110,18 +114,17 @@ class _BucketedGetTOAs(GetTOAs):
         self._bucket_shape = tuple(bucket_shape)
 
     def _load_archive(self, datafile, tscrunch, quiet):
-        data = super()._load_archive(datafile, tscrunch, quiet)
-        if data is None:
-            return None
-        try:
-            return pad_databunch(data, *self._bucket_shape)
-        except ValueError as e:
-            # header lied about the shape (bucket smaller than the
-            # decoded data): treated like any unloadable archive
-            if not quiet:
-                print(f"Cannot pad {datafile} to bucket "
-                      f"{self._bucket_shape}: {e}; skipping it.")
-            return None
+        # a prefetched buffer is already bucket-padded: replay its
+        # outcome (or exception) from this exact call site, so a
+        # prefetch-thread read/pad fault propagates like a serial one
+        hit = self._take_preloaded(datafile)
+        if hit is not _PRELOAD_MISS:
+            kind, val = hit
+            if kind == "raise":
+                raise val
+            return val
+        return load_bucketed_databunch(datafile, self._bucket_shape,
+                                       tscrunch=tscrunch, quiet=quiet)
 
 
 def make_mesh_fitter(mesh):
@@ -230,22 +233,26 @@ def _ckpt_path(workdir, pid):
 
 
 class _LeaseHeartbeat:
-    """Daemon thread renewing the in-flight archive's lease.
+    """Daemon thread renewing the leases of in-flight archives.
 
     The fit loop (and the dispatch watchdog's worker) can block inside
     a device dispatch for longer than a lease, so renewal cannot live
-    on the fitting thread: :meth:`hold` marks the archive whose lease
-    the thread keeps alive with ``queue.renew`` heartbeat appends
-    (``lease_renewed`` events).  A renewal that fails — injected
-    ``lease_renew`` fault, NFS blip — is dropped and counted; the
-    lease then simply runs out and the fit's completion guard abandons
-    without a transition if someone took over.
+    on the fitting thread: :meth:`hold` (or an :meth:`acquire` /
+    :meth:`release` pair) marks archives whose leases the thread keeps
+    alive with ``queue.renew`` heartbeat appends (``lease_renewed``
+    events).  The claim-ahead prefetch window holds SEVERAL leases at
+    once — one per claimed-but-not-yet-fit archive — hence a key set
+    rather than a single slot; the set is idempotent, not refcounted.
+    A renewal that fails — injected ``lease_renew`` fault, NFS blip —
+    is dropped and counted; the lease then simply runs out and the
+    fit's completion guard abandons without a transition if someone
+    took over.
     """
 
     def __init__(self, queue, interval_s):
         self.queue = queue
         self.interval_s = max(0.05, float(interval_s))
-        self._key = None
+        self._keys = set()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._t = threading.Thread(target=self._run, daemon=True,
@@ -255,30 +262,38 @@ class _LeaseHeartbeat:
     def _run(self):
         while not self._stop.wait(self.interval_s):
             with self._lock:
-                key = self._key
-            if key is None:
-                continue
-            try:
-                rec = self.queue.renew(key)
-            except Exception:
-                obs.counter("lease_renew_failures")
-                continue
-            if rec is not None:
-                obs.event("lease_renewed", archive=key,
-                          owner=self.queue.owner,
-                          lease_expires_at=rec.get("lease_expires_at"),
-                          renewals=rec.get("renewals"))
-                obs.counter("leases_renewed")
+                keys = sorted(self._keys)
+            for key in keys:
+                try:
+                    rec = self.queue.renew(key)
+                except Exception:
+                    obs.counter("lease_renew_failures")
+                    continue
+                if rec is not None:
+                    obs.event("lease_renewed", archive=key,
+                              owner=self.queue.owner,
+                              lease_expires_at=rec.get(
+                                  "lease_expires_at"),
+                              renewals=rec.get("renewals"))
+                    obs.counter("leases_renewed")
+
+    def acquire(self, path):
+        """Start renewing ``path``'s lease."""
+        with self._lock:
+            self._keys.add(self.queue.key_for(path))
+
+    def release(self, path):
+        """Stop renewing ``path``'s lease."""
+        with self._lock:
+            self._keys.discard(self.queue.key_for(path))
 
     @contextlib.contextmanager
     def hold(self, path):
-        with self._lock:
-            self._key = self.queue.key_for(path)
+        self.acquire(path)
         try:
             yield
         finally:
-            with self._lock:
-                self._key = None
+            self.release(path)
 
     def stop(self):
         self._stop.set()
@@ -355,6 +370,80 @@ def _lease_lost(queue, info, checkpoint, wrote_block,
               new_owner=cur.get("owner"),
               block_dropped=bool(wrote_block))
     obs.counter("leases_lost")
+
+
+class _ClaimedItem:
+    """One claimed archive in flight between claim and fit — either
+    fitting immediately (serial path) or riding the claim-ahead window
+    with its load on the prefetch pool (``ticket``)."""
+
+    __slots__ = ("info", "bucket", "ctx", "t0", "ticket")
+
+    def __init__(self, info, bucket, ctx, t0, ticket=None):
+        self.info = info
+        self.bucket = bucket
+        self.ctx = ctx
+        self.t0 = t0
+        self.ticket = ticket
+
+
+def _try_claim(queue, wl, info, owner, workdir, ipass, pid, t_arch0,
+               blabel, wlabel):
+    """The union-replay lease-claim protocol for one archive; must run
+    under the archive's activated trace context.
+
+    Sync the union view first (a sibling may have claimed or even
+    completed this archive since the last refresh, and a claim layered
+    on top of an unseen ``done`` would win the (t, owner) order and
+    refit it), then claim, then re-sync to run the deterministic
+    double-claim election; a lost election abandons with NO ledger
+    transition.  A takeover additionally scrubs the previous owner's
+    checkpoint block.  Returns the claim record, or None when the
+    archive turned out not to be ours to fit.
+    """
+    queue.refresh()
+    if queue.state(info.path) in (DONE, QUARANTINED) \
+            or not queue.ready(info.path):
+        return None
+    prev_rec = queue.record(info.path) or {}
+    was_held = prev_rec.get("state") == RUNNING
+    claim = queue.claim(info.path, **wl.claim_fields(queue, info))
+    queue.refresh()
+    if not queue.owns(info.path):
+        # double-claim lost: the deterministic (t, owner) union order
+        # elected the other claimant — abandon with NO transition
+        obs.event("lease_claim_lost", archive=info.path, owner=owner,
+                  winner=(queue.record(info.path) or {}).get("owner"))
+        obs.counter("lease_claims_lost")
+        return None
+    if was_held:
+        obs.event("lease_expired", archive=info.path,
+                  prev_owner=prev_rec.get("owner"),
+                  lease_expires_at=prev_rec.get("lease_expires_at"))
+        obs.counter("leases_expired")
+    takeover = claim.get("takeover_from")
+    n_scrubbed = 0
+    if takeover:
+        ppid = owner_pid(takeover)
+        if ppid is not None and ppid != pid:
+            # the previous owner may have died between its checkpoint
+            # flush and the ledger append: scrub its block so the
+            # refit cannot double-write
+            n_scrubbed = wl.drop_blocks(
+                wl.checkpoint_path(workdir, ppid, ipass), [info.path])
+        obs.counter("lease_takeovers")
+    obs.event("lease_claimed", archive=info.path, owner=owner,
+              lease_expires_at=claim.get("lease_expires_at"),
+              takeover_from=takeover,
+              blocks_scrubbed=n_scrubbed or None,
+              attempts=claim.get("attempts", 0))
+    obs.counter("leases_claimed")
+    # claim latency: union refresh + ledger append + takeover scrub
+    claim_s = time.perf_counter() - t_arch0
+    metrics.observe(PHASE_HISTOGRAM, claim_s, phase="claim",
+                    bucket=blabel, workload=wlabel)
+    tracing.emit_span("claim", claim_s, archive=info.path)
+    return claim
 
 
 def _fit_one(gt, queue, info, checkpoint, padded, get_toas_kw, quiet,
@@ -590,7 +679,7 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                trace_bucket=False, watchdog_s=None,
                barrier_timeout_s=600.0, lease_s=600.0,
                narrowband=False, workload=None, workload_opts=None,
-               quiet=True, **get_toas_kw):
+               prefetch=0, quiet=True, **get_toas_kw):
     """Execute (or resume) one process's share of a survey plan.
 
     ``plan`` is a SurveyPlan or a path to a saved plan.json.  All
@@ -665,6 +754,20 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
     with its reduce once the union ledger shows every archive settled
     — the reduce is idempotent, so any process of any topology may
     perform it.  ``**get_toas_kw`` is accepted only for ``toas``.
+
+    ``prefetch`` (``ppsurvey run --prefetch N``) enables the streaming
+    host pipeline (runner/prefetch.py, docs/RUNNER.md "Host
+    pipeline"): the loop claims up to N archives ahead and decodes +
+    pads them on a prefetch thread while the current archive fits, so
+    a warm survey runs fit-bound instead of IO-bound.  ``0`` (the
+    default) is the serial path; results are bit-identical either way
+    — the prefetched buffer (or its load failure/exception) is
+    replayed through the fit's own load call site.  Window archives
+    hold real claims whose leases the heartbeat renews; on drain/stop
+    they are handed back (``prefetch_abandoned`` reset) and a lease
+    lost while queued discards the buffer with NO ledger transition.
+    Ignored for workloads without a prefetchable load phase
+    (``supports_prefetch`` is False).
     """
     if isinstance(plan, str):
         plan = SurveyPlan.load(plan)
@@ -733,9 +836,17 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
     except ValueError:
         prev_handlers = {}  # not the main thread: no graceful drain
 
+    # claim-ahead depth of the streaming host pipeline; 0 (or a
+    # workload without a prefetchable load phase) = the serial path
+    prefetch_depth = max(0, int(prefetch or 0))
+    if prefetch_depth and not getattr(wl, "supports_prefetch", False):
+        prefetch_depth = 0
+    pf_tscrunch = bool(get_toas_kw.get("tscrunch", False))
+
     queue = None
     hb = None
     checkpoint = None
+    prefetcher = None
     revoked = []
     try:
         with obs.run("ppsurvey", base_dir=paths["obs"],
@@ -750,8 +861,11 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                              "watchdog_s": watchdog_s,
                              "lease_s": lease_s,
                              "narrowband": bool(narrowband),
+                             "prefetch": prefetch_depth,
                              "trace_bucket": bool(trace_bucket)}) as rec:
             t0 = time.perf_counter()
+            if prefetch_depth:
+                prefetcher = HostPrefetcher(depth=prefetch_depth)
             if rec is not None and plan.buckets:
                 # analytical footprint ceiling (runner/plan.py): the
                 # largest per-bucket estimate the plan will dispatch;
@@ -796,6 +910,118 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                 stalled = 0
                 tracer = contextlib.ExitStack()
                 cur_bucket = None
+                # claim-ahead window (--prefetch): claimed archives
+                # whose loads are in flight on the prefetch pool,
+                # consumed (fit) in claim order; empty on the serial
+                # path and whenever the wait/backoff loop runs
+                window = collections.deque()
+
+                def _fit_item(item):
+                    """Fit one claimed archive under its trace —
+                    shared by the serial path and the window consumer
+                    (which installs the prefetched buffer first)."""
+                    nonlocal tracer, cur_bucket
+                    info, bucket = item.info, item.bucket
+                    blabel = "%dx%d" % bucket.key
+                    with tracing.activate(item.ctx):
+                        # warm per-bucket state (the toas GetTOAs +
+                        # fitter; None for stateless workloads) — at
+                        # most one compiled program set per (workload,
+                        # shape bucket)
+                        if bucket.key not in states:
+                            states[bucket.key] = wl.make_bucket_state(
+                                bucket, ordered, fitter, quiet=quiet)
+                        if trace_base is not None \
+                                and bucket.key != cur_bucket:
+                            tracer.close()  # stop+ingest prev
+                            tracer = contextlib.ExitStack()
+                            tracer.enter_context(obs.trace_capture(
+                                "bucket_%dx%d" % bucket.key,
+                                base_dir=trace_base))
+                            cur_bucket = bucket.key
+                        if item.ticket is not None:
+                            # hand-off: the fit's own _load_archive
+                            # call site replays the prefetched outcome
+                            # (buffer, None, or raised fault)
+                            states[bucket.key].preload(
+                                info.path,
+                                prefetcher.consume(item.ticket))
+                        padded = (info.nchan, info.nbin) != bucket.key
+                        hold = hb.hold(info.path) if hb is not None \
+                            else contextlib.nullcontext()
+                        with hold:
+                            with metrics.timed(
+                                    PHASE_HISTOGRAM, phase="fit",
+                                    bucket=blabel, workload=wlabel), \
+                                    obs.span("fit", archive=info.path,
+                                             bucket=blabel,
+                                             workload=wlabel), \
+                                    quality.context(bucket=blabel,
+                                                    workload=wlabel):
+                                _, st_poisoned = _fit_one_guarded(
+                                    wl, states[bucket.key], queue,
+                                    info, checkpoint, padded, quiet,
+                                    watchdog_s)
+                        arch_s = time.perf_counter() - item.t0
+                        metrics.observe(PHASE_HISTOGRAM, arch_s,
+                                        phase="archive", bucket=blabel,
+                                        workload=wlabel)
+                        # the root span of this archive's trace:
+                        # children (claim/prefetch_load/fit/...)
+                        # reference its pre-allocated id
+                        tracing.emit_span(
+                            "archive", arch_s, ctx=(item.ctx[0], None),
+                            span_id=item.ctx[1], archive=info.path,
+                            bucket=blabel, workload=wlabel,
+                            owner=owner)
+                    if st_poisoned:
+                        # the abandoned worker may still touch this
+                        # state; retries get a fresh one
+                        states.pop(bucket.key, None)
+
+                def _consume_one():
+                    """Pop the oldest window item and fit it — unless
+                    its lease was lost while it queued, in which case
+                    the buffer is discarded with NO ledger transition
+                    (the taker owns the archive's state now).  Returns
+                    True when a fit attempt actually ran."""
+                    item = window.popleft()
+                    with tracing.activate(item.ctx):
+                        if not queue.owns(item.info.path,
+                                          refresh=True):
+                            prefetcher.discard(item.ticket,
+                                               "lease_lost")
+                            if hb is not None:
+                                hb.release(item.info.path)
+                            _lease_lost(queue, item.info, checkpoint,
+                                        wrote_block=False)
+                            return False
+                    _fit_item(item)
+                    # hold() inside _fit_item already released the
+                    # claim-time acquire (the key set is idempotent)
+                    return True
+
+                def _abandon_item(item, cause):
+                    """Flush a window item without fitting it (drain,
+                    stop): discard the buffer, and hand the claim back
+                    with an explicit reset when we still own it — we
+                    claimed ahead and never fit, so waiting out the
+                    lease would strand the archive for a resume."""
+                    prefetcher.discard(item.ticket, cause)
+                    if hb is not None:
+                        hb.release(item.info.path)
+                    with tracing.activate(item.ctx):
+                        if queue.owns(item.info.path, refresh=True):
+                            queue.reset(item.info.path,
+                                        "prefetch_abandoned: %s"
+                                        % cause)
+                            obs.event("prefetch_abandoned",
+                                      archive=item.info.path,
+                                      cause=cause)
+                        else:
+                            _lease_lost(queue, item.info, checkpoint,
+                                        wrote_block=False)
+
                 try:
                     while True:
                         ran = 0
@@ -808,13 +1034,6 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                                 continue
                             if not queue.ready(info.path):
                                 continue
-                            # -- lease claim (union-replay protocol) -
-                            # sync the union view first: a sibling may
-                            # have claimed or even completed this
-                            # archive since the last refresh, and a
-                            # claim layered on top of an unseen
-                            # ``done`` would win the (t, owner) order
-                            # and refit it
                             blabel = "%dx%d" % bucket.key
                             t_arch0 = time.perf_counter()
                             # each archive's claim->fit->checkpoint
@@ -823,147 +1042,59 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                             # and the checkpoint block carry the trace
                             # id, and the fit's phase spans become
                             # children of the root "archive" span
-                            # emitted below
                             trace_ctx = (tracing.new_trace_id(),
                                          tracing.new_span_id())
+                            item = _ClaimedItem(info, bucket,
+                                                trace_ctx, t_arch0)
                             with tracing.activate(trace_ctx):
-                                queue.refresh()
-                                if queue.state(info.path) in \
-                                        (DONE, QUARANTINED) \
-                                        or not queue.ready(info.path):
+                                claim = _try_claim(
+                                    queue, wl, info, owner, workdir,
+                                    ipass, pid, t_arch0, blabel,
+                                    wlabel)
+                                if claim is None:
                                     continue
-                                prev_rec = queue.record(info.path) \
-                                    or {}
-                                was_held = prev_rec.get("state") \
-                                    == RUNNING
-                                claim = queue.claim(
-                                    info.path,
-                                    **wl.claim_fields(queue, info))
-                                queue.refresh()
-                                if not queue.owns(info.path):
-                                    # double-claim lost: the
-                                    # deterministic (t, owner) union
-                                    # order elected the other claimant
-                                    # — abandon with NO transition
-                                    obs.event("lease_claim_lost",
-                                              archive=info.path,
-                                              owner=owner,
-                                              winner=(queue.record(
-                                                  info.path)
-                                                  or {}).get("owner"))
-                                    obs.counter("lease_claims_lost")
-                                    continue
-                                if was_held:
-                                    obs.event(
-                                        "lease_expired",
-                                        archive=info.path,
-                                        prev_owner=prev_rec.get(
-                                            "owner"),
-                                        lease_expires_at=prev_rec.get(
-                                            "lease_expires_at"))
-                                    obs.counter("leases_expired")
-                                takeover = claim.get("takeover_from")
-                                n_scrubbed = 0
-                                if takeover:
-                                    ppid = owner_pid(takeover)
-                                    if ppid is not None \
-                                            and ppid != pid:
-                                        # the previous owner may have
-                                        # died between its checkpoint
-                                        # flush and the ledger append:
-                                        # scrub its block so the refit
-                                        # cannot double-write
-                                        n_scrubbed = wl.drop_blocks(
-                                            wl.checkpoint_path(
-                                                workdir, ppid, ipass),
-                                            [info.path])
-                                    obs.counter("lease_takeovers")
-                                obs.event("lease_claimed",
-                                          archive=info.path,
-                                          owner=owner,
-                                          lease_expires_at=claim.get(
-                                              "lease_expires_at"),
-                                          takeover_from=takeover,
-                                          blocks_scrubbed=n_scrubbed
-                                          or None,
-                                          attempts=claim.get(
-                                              "attempts", 0))
-                                obs.counter("leases_claimed")
-                                # claim latency: union refresh +
-                                # ledger append + takeover scrub
-                                claim_s = time.perf_counter() - t_arch0
-                                metrics.observe(PHASE_HISTOGRAM,
-                                                claim_s, phase="claim",
-                                                bucket=blabel,
-                                                workload=wlabel)
-                                tracing.emit_span("claim", claim_s,
-                                                  archive=info.path)
-                                # -- bucketed fit --------------------
-                                # warm per-bucket state (the toas
-                                # GetTOAs + fitter; None for
-                                # stateless workloads) — at most one
-                                # compiled program set per (workload,
-                                # shape bucket)
-                                if bucket.key not in states:
-                                    states[bucket.key] = \
-                                        wl.make_bucket_state(
-                                            bucket, ordered, fitter,
-                                            quiet=quiet)
-                                if trace_base is not None \
-                                        and bucket.key != cur_bucket:
-                                    tracer.close()  # stop+ingest prev
-                                    tracer = contextlib.ExitStack()
-                                    tracer.enter_context(
-                                        obs.trace_capture(
-                                            "bucket_%dx%d"
-                                            % bucket.key,
-                                            base_dir=trace_base))
-                                    cur_bucket = bucket.key
-                                padded = (info.nchan,
-                                          info.nbin) != bucket.key
-                                hold = hb.hold(info.path) \
-                                    if hb is not None \
-                                    else contextlib.nullcontext()
-                                with hold:
-                                    with metrics.timed(
-                                            PHASE_HISTOGRAM,
-                                            phase="fit",
-                                            bucket=blabel,
-                                            workload=wlabel), \
-                                            obs.span(
-                                                "fit",
-                                                archive=info.path,
-                                                bucket=blabel,
-                                                workload=wlabel), \
-                                            quality.context(
-                                                bucket=blabel,
-                                                workload=wlabel):
-                                        _, st_poisoned = \
-                                            _fit_one_guarded(
-                                                wl,
-                                                states[bucket.key],
-                                                queue, info,
-                                                checkpoint, padded,
-                                                quiet, watchdog_s)
-                                arch_s = time.perf_counter() - t_arch0
-                                metrics.observe(PHASE_HISTOGRAM,
-                                                arch_s,
-                                                phase="archive",
-                                                bucket=blabel,
-                                                workload=wlabel)
-                                # the root span of this archive's
-                                # trace: children (claim/fit/...)
-                                # reference its pre-allocated id
-                                tracing.emit_span(
-                                    "archive", arch_s,
-                                    ctx=(trace_ctx[0], None),
-                                    span_id=trace_ctx[1],
-                                    archive=info.path, bucket=blabel,
-                                    workload=wlabel, owner=owner)
-                            if st_poisoned:
-                                # the abandoned worker may still touch
-                                # this state; retries get a fresh one
-                                states.pop(bucket.key, None)
+                                if prefetcher is not None:
+                                    # claim first, THEN prefetch: the
+                                    # heartbeat renews this lease
+                                    # while the load runs on the
+                                    # worker and the item waits in
+                                    # the window
+                                    if hb is not None:
+                                        hb.acquire(info.path)
+                                    item.ticket = prefetcher.submit(
+                                        info.path,
+                                        functools.partial(
+                                            load_bucketed_databunch,
+                                            info.path, bucket.key,
+                                            tscrunch=pf_tscrunch,
+                                            quiet=quiet),
+                                        est_bytes=bucket.est_bytes(),
+                                        ctx=trace_ctx)
+                            if prefetcher is None:
+                                _fit_item(item)
+                            else:
+                                window.append(item)
+                                if len(window) < prefetch_depth:
+                                    continue  # top up the window
+                                if not _consume_one():
+                                    continue  # discarded, no fit ran
+                            ran += 1
+                            n_fit += 1
+                            if max_archives is not None \
+                                    and n_fit >= max_archives:
+                                stop = True
+                        # flush the claim-ahead window: fit what is
+                        # still ours, or on stop/drain hand the
+                        # claims back (SIGTERM drain must not strand
+                        # in-flight prefetches behind live leases)
+                        while window:
+                            if stop or drain["sig"]:
+                                _abandon_item(window.popleft(),
+                                              drain["sig"] or
+                                              "stopped")
+                                continue
+                            if not _consume_one():
+                                continue
                             ran += 1
                             n_fit += 1
                             if max_archives is not None \
@@ -1052,6 +1183,12 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                     print("ppsurvey: %s received — drained after %d "
                           "fit attempt(s); resume continues the rest."
                           % (drain["sig"], n_fit), file=sys.stderr)
+            if prefetcher is not None:
+                # host-pipeline memory plane: the high-water mark of
+                # live prefetch buffers (bounded by depth ×
+                # ShapeBucket.est_bytes)
+                obs.gauge("prefetch_buffer_peak_bytes",
+                          prefetcher.peak_bytes)
             if rec is not None and trace_base is not None:
                 # was this run fit-bound or IO-bound?  devtime
                 # ingestion sums attributed device seconds into a run
@@ -1143,6 +1280,8 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
             summary["merged_counts"] = merged["counts"]
         return summary
     finally:
+        if prefetcher is not None:
+            prefetcher.stop()
         if hb is not None:
             hb.stop()
         for s, h in prev_handlers.items():

@@ -36,8 +36,8 @@ from ..io.fits import BLOCK, CARD, Header
 from ..testing import faults
 
 __all__ = ["ArchiveInfo", "ShapeBucket", "SurveyPlan", "canonical_shape",
-           "estimate_archive_bytes", "pad_databunch", "plan_survey",
-           "scan_archive_header"]
+           "estimate_archive_bytes", "load_bucketed_databunch",
+           "pad_databunch", "plan_survey", "scan_archive_header"]
 
 PLAN_SCHEMA = "pptpu-survey-plan-v1"
 
@@ -392,3 +392,35 @@ def pad_databunch(d, nchan_pad, nbin_pad):
         weights_norm[:, None, :, None],
         (nsub, npol, d.nchan, d.nbin)).copy()
     return d
+
+
+def load_bucketed_databunch(datafile, bucket_shape, tscrunch=False,
+                            quiet=True):
+    """The complete host-side load of one bucketed archive: FITS decode
+    with the dmc-reload fallback (pipelines.toas.load_archive_data) +
+    pad to the bucket's canonical shape.
+
+    This is THE load path of the bucketed fit loop
+    (execute._BucketedGetTOAs) and of the host prefetch stage
+    (runner/prefetch.py) — one implementation, so a prefetched buffer
+    is bit-identical to a serial load and the ``archive_read`` /
+    ``archive_pad`` fault sites fire on whichever thread actually runs
+    the load.  Returns the padded DataBunch, or None when the archive
+    is unloadable or its header lied about the shape (bucket smaller
+    than the decoded data); anything pad_databunch raises beyond
+    ValueError (e.g. an injected RuntimeError) propagates so it travels
+    the fit loop's fault-isolation path unchanged.
+    """
+    from ..pipelines.toas import load_archive_data
+
+    bucket_shape = tuple(bucket_shape)
+    data = load_archive_data(datafile, tscrunch=tscrunch, quiet=quiet)
+    if data is None:
+        return None
+    try:
+        return pad_databunch(data, *bucket_shape)
+    except ValueError as e:
+        if not quiet:
+            print(f"Cannot pad {datafile} to bucket "
+                  f"{bucket_shape}: {e}; skipping it.")
+        return None

@@ -207,6 +207,10 @@ class Workload:
     has_reduce = False
     #: archives are padded to their bucket's canonical shape
     uses_buckets = True
+    #: fit_one's load phase goes through GetTOAs._load_archive, so the
+    #: claim-ahead host prefetch stage (runner/prefetch.py) can run it
+    #: on a worker thread and replay the outcome via preload()
+    supports_prefetch = False
 
     def n_passes(self, plan):
         return 1
@@ -256,6 +260,7 @@ class ToasWorkload(Workload):
     same ledger transitions, same compiled-program reuse)."""
 
     name = DEFAULT_WORKLOAD
+    supports_prefetch = True
 
     def __init__(self, modelfile=None, narrowband=False,
                  get_toas_kw=None):

@@ -52,6 +52,7 @@ process cannot grow obs state without bound.
 """
 
 import contextlib
+import functools
 import itertools
 import os
 import re
@@ -66,7 +67,9 @@ from ..obs.metrics import PHASE_HISTOGRAM
 from ..obs.core import Recorder
 from ..runner.execute import _BucketedGetTOAs, _fit_one
 from ..runner.plan import SurveyPlan, canonical_shape, \
-    estimate_archive_bytes, scan_archive_header
+    estimate_archive_bytes, load_bucketed_databunch, \
+    scan_archive_header
+from ..runner.prefetch import HostPrefetcher
 from ..runner.queue import DONE, FAILED, QUARANTINED, WorkQueue
 from ..testing import faults
 from .batcher import MicroBatcher
@@ -104,7 +107,7 @@ class Request:
                  "nsub", "nchan", "nbin", "state", "reason", "attempts",
                  "n_toas", "toa_lines", "quality", "t_submit", "t_done",
                  "done_evt", "recorder", "recovered", "batch_id",
-                 "trace_id", "parent_span_id", "span_id")
+                 "trace_id", "parent_span_id", "span_id", "ticket")
 
     def __init__(self, req_id, tenant, path, key, config):
         self.id = req_id
@@ -128,6 +131,9 @@ class Request:
         self.recorder = None
         self.recovered = False
         self.batch_id = None
+        # decode-at-intake hand-off (runner/prefetch.py): the ticket
+        # whose buffer the fit worker consumes via gt.preload
+        self.ticket = None
         # causal identity (obs/tracing.py): the trace this request
         # belongs to (client-minted via the traceparent carrier, or
         # daemon-minted), the client span it parents on, and the id of
@@ -238,7 +244,8 @@ class TOAService:
                  tenant_max_inflight=4, tenant_max_queue=64,
                  max_attempts=3, backoff_s=0.0, run_dirs_max=None,
                  run_bytes_max=None, mem_budget_bytes=None,
-                 return_toa_lines=True, get_toas_kw=None, quiet=True):
+                 return_toa_lines=True, get_toas_kw=None, prefetch=2,
+                 quiet=True):
         self.modelfile = modelfile
         self.workdir = workdir
         if isinstance(plan, str):
@@ -262,6 +269,14 @@ class TOAService:
             if mem_budget_bytes is None else int(mem_budget_bytes)
         self.return_toa_lines = bool(return_toa_lines)
         self.get_toas_kw = dict(get_toas_kw or {})
+        # decode-at-intake (docs/SERVICE.md): up to ``prefetch``
+        # admitted requests have their FITS decode + bucket pad run on
+        # the host-prefetch pool during the micro-batch window instead
+        # of inside ``fit`` — the measured 21-27 ms load tail on the
+        # warmed critical path (PERF.md §5).  0 disables (decode runs
+        # inline in the fit worker, the pre-prefetch behavior).
+        self.prefetch = max(0, int(prefetch))
+        self._prefetcher = None
         self.quiet = quiet
 
         os.makedirs(workdir, exist_ok=True)
@@ -306,7 +321,13 @@ class TOAService:
                     "max_attempts": self.max_attempts,
                     "run_dirs_max": self.run_dirs_max,
                     "run_bytes_max": self.run_bytes_max,
-                    "mem_budget_bytes": self.mem_budget_bytes}))
+                    "mem_budget_bytes": self.mem_budget_bytes,
+                    "prefetch": self.prefetch}))
+        if self.prefetch:
+            # before recovery: recovered requests prefetch like fresh
+            # ones, so a restarted daemon's first cycle is warm too
+            self._prefetcher = HostPrefetcher(depth=self.prefetch,
+                                              name="ppserve-prefetch")
         self._recover_tenants()
         self._thread = threading.Thread(target=self._dispatcher,
                                         name="ppserve-dispatcher",
@@ -368,6 +389,9 @@ class TOAService:
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
+        if self._prefetcher is not None:
+            self._prefetcher.stop()
+            self._prefetcher = None
         with self._lock:
             tenants = list(self._tenants.values())
             requests = list(self._requests.values())
@@ -412,7 +436,31 @@ class TOAService:
             # header scan outside the lock (file IO); unreadable
             # leftovers quarantine exactly like a fresh submission's
             if self._classify(rq):
+                self._maybe_prefetch(rq)
                 self._emit_request(rq, "recovered")
+
+    def _maybe_prefetch(self, rq):
+        """Decode-at-intake: hand a freshly admitted request's FITS
+        decode + bucket pad to the prefetch pool so it overlaps the
+        micro-batch window instead of extending ``fit``.  Best-effort —
+        past ``depth`` live tickets :meth:`~HostPrefetcher.try_submit`
+        refuses and the request simply decodes inline at fit time, the
+        pre-prefetch behavior."""
+        pf = self._prefetcher
+        if pf is None or rq.bucket is None or rq.ticket is not None \
+                or rq.t_done is not None:
+            return
+        kw = dict(self.get_toas_kw)
+        kw.update(rq.config or {})
+        rq.ticket = pf.try_submit(
+            rq.path,
+            functools.partial(load_bucketed_databunch, rq.path,
+                              tuple(rq.bucket),
+                              tscrunch=bool(kw.get("tscrunch", False)),
+                              quiet=self.quiet),
+            est_bytes=estimate_archive_bytes(rq.nchan, rq.nbin,
+                                             nsub=rq.nsub),
+            ctx=rq.ctx())
 
     def _new_request(self, tenant, path, key, config, recovered=False,
                      traceparent=None):
@@ -510,6 +558,7 @@ class TOAService:
                 rejection = self._memory_admission(rq)
                 if rejection is not None:
                     return rejection
+                self._maybe_prefetch(rq)
             # else: header scan failed — quarantined at intake, like
             # the survey planner's unreadable-archive path
         self._emit_request(rq, "submitted")
@@ -727,6 +776,13 @@ class TOAService:
                         phase="checkout", bucket=blabel)
         tracing.emit_span("checkout", checkout_s, request=rq.id)
         gt.fit_batch = bucket.batcher.fit
+        if rq.ticket is not None and self._prefetcher is not None:
+            # decode-at-intake hand-off: the fit's own _load_archive
+            # call site replays the prefetched outcome (data or fault)
+            # exactly as if it had loaded inline.  A retry after a
+            # consumed faulty ticket decodes inline, same as serial.
+            ticket, rq.ticket = rq.ticket, None
+            gt.preload(rq.path, self._prefetcher.consume(ticket))
         kw = dict(self.get_toas_kw)
         kw.update(rq.config or {})
         flags = dict(kw.get("addtnl_toa_flags") or {})
@@ -792,6 +848,12 @@ class TOAService:
     def _finalize_locked(self, rq, state, reason):
         if rq.t_done is not None:
             return  # already finalized (racing duplicate settle)
+        if rq.ticket is not None and self._prefetcher is not None:
+            # settled without the fit consuming its buffer (e.g. a
+            # quarantine racing ahead of dispatch): drop it — no
+            # ledger transition, the settle already wrote the record
+            ticket, rq.ticket = rq.ticket, None
+            self._prefetcher.discard(ticket, "settled_before_fit")
         rq.state = state
         rq.reason = reason
         rq.t_done = time.time()

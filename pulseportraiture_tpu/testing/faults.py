@@ -13,9 +13,15 @@ unless a matching fault spec is active:
 =================  ====================================================
 site               where it fires
 =================  ====================================================
-``archive_read``   ``io/archive.load_data`` (per archive load)
+``archive_read``   ``io/archive.load_data`` (per archive load; under
+                   ``--prefetch`` it fires on the prefetch thread and
+                   is replayed at the fit's load call site —
+                   runner/prefetch.py outcome replay — so quarantine/
+                   retry/backoff semantics are unchanged)
 ``header_scan``    ``runner/plan.scan_archive_header`` (plan-time scan)
-``archive_pad``    ``runner/plan.pad_databunch`` (bucket padding)
+``archive_pad``    ``runner/plan.pad_databunch`` (bucket padding; on
+                   the prefetch thread under ``--prefetch``, replayed
+                   like ``archive_read``)
 ``dispatch``       ``pipelines/toas.py`` just before the batched device
                    fit (wideband and narrowband drivers)
 ``ledger_append``  ``runner/queue.WorkQueue._append`` (every ledger
@@ -43,6 +49,10 @@ Spec grammar (``PPTPU_FAULTS`` or :func:`configure`)::
                               (persistent corruption), keys you never
                               pass decide per check count (transients)
              | "nth="K        fire exactly on the K-th check of the site
+                              (check *order* dependent — for targeting
+                              a load that may run on a prefetch thread
+                              prefer a probability clause, whose per-key
+                              hash is order independent)
              | "every="K      fire on every K-th check
              | "after="K      sites: fire on every check past the K-th;
                               signals: deliver ONCE when the counting
@@ -60,6 +70,13 @@ Spec grammar (``PPTPU_FAULTS`` or :func:`configure`)::
                               fodder; the hang then *releases as the
                               fault* so an abandoned watchdogged
                               thread terminates instead of leaking
+             | "latency="SECS on fire, sleep SECS then PROCEED — the
+                              check returns normally, no fault is
+                              raised.  Slow-storage simulation (an
+                              NFS/Lustre archive mount) for the host
+                              pipeline: inject on ``archive_read`` to
+                              measure IO-wait overlap under
+                              ``--prefetch`` (PERF.md §8)
              | "times="M      cap total fires of this clause
              | "seed="N       probability-hash seed (default 0)
 
@@ -118,7 +135,8 @@ class InjectedFault(RuntimeError):
 
 class _Clause:
     __slots__ = ("raw", "site", "signal", "p", "nth", "every", "after",
-                 "at", "hang_s", "times", "seed", "n_fired")
+                 "at", "hang_s", "latency_s", "times", "seed",
+                 "n_fired")
 
     def __init__(self, raw, site=None, sig=None):
         self.raw = raw
@@ -130,6 +148,7 @@ class _Clause:
         self.after = None
         self.at = "dispatch"
         self.hang_s = None
+        self.latency_s = None
         self.times = None
         self.seed = 0
         self.n_fired = 0
@@ -179,6 +198,8 @@ def _parse(spec):
                     c.at = val
                 elif key == "hang":
                     c.hang_s = float(val)
+                elif key == "latency":
+                    c.latency_s = float(val)
                 elif key == "times":
                     c.times = int(val)
                 elif key == "seed":
@@ -276,6 +297,17 @@ class _Harness:
                     os.kill(os.getpid(), _SIGNALS[c.signal])
                 continue
             if c.site != site or not self._matches(c, site, key, n):
+                continue
+            if c.latency_s:
+                # pure success-path delay (slow-storage simulation):
+                # sleep, record, and keep checking — never raises
+                self._record(c, site, n, key, "latency")
+                deadline = time.monotonic() + c.latency_s
+                while True:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    time.sleep(min(HANG_SLICE_S, left))
                 continue
             action = "hang" if c.hang_s else "fail"
             self._record(c, site, n, key, action)

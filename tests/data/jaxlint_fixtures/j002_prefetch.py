@@ -1,0 +1,97 @@
+"""J002 fixtures: host-prefetch API misuse inside jit.
+
+The host pipeline (pulseportraiture_tpu.runner.prefetch + the archive
+loaders it schedules) is host-side by construction — worker threads,
+hand-off events and FITS decode cannot exist in compiled code; under
+jit a submit would spawn threads once at trace time and the decoded
+buffer could never feed the program.  This corpus proves no prefetch
+entry point is reachable inside a jit trace without the linter firing.
+docs/RUNNER.md "Host pipeline".
+"""
+
+import jax
+
+from pulseportraiture_tpu.runner import (HostPrefetcher,
+                                         load_bucketed_databunch,
+                                         prefetch)
+from pulseportraiture_tpu.pipelines.toas import load_archive_data
+
+prefetcher = HostPrefetcher(depth=2)
+
+
+@jax.jit
+def bad_ctor_in_jit(x):
+    pf = HostPrefetcher(depth=2)  # EXPECT: J002
+    return x + pf.depth
+
+
+@jax.jit
+def bad_submit_in_jit(x):
+    prefetcher.submit("a.fits", lambda: None)  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_try_submit_in_jit(x):
+    t = prefetcher.try_submit("a.fits", lambda: None)  # EXPECT: J002
+    return x if t is None else x + 1.0
+
+
+@jax.jit
+def bad_consume_in_jit(x, ticket):
+    prefetcher.consume(ticket)  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_discard_in_jit(x, ticket):
+    prefetcher.discard(ticket, "why")  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_stop_in_jit(x):
+    prefetcher.stop()  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_ticket_in_jit(x):
+    t = prefetch.PrefetchTicket("a.fits")  # EXPECT: J002
+    return x + t.est_bytes
+
+
+@jax.jit
+def bad_bucketed_load_in_jit(x):
+    load_bucketed_databunch("a.fits", (64, 2048))  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_archive_load_in_jit(x):
+    load_archive_data("a.fits")  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def ok_suppressed(x):
+    prefetcher.stop()  # jaxlint: disable=J002
+    return x
+
+
+def ok_host_side(paths, bucket):
+    # outside jit: exactly how the runner's claim-ahead window drives it
+    pf = HostPrefetcher(depth=2)
+    tickets = [pf.submit(p, lambda p=p: load_bucketed_databunch(p, bucket))
+               for p in paths]
+    out = [pf.consume(t) for t in tickets]
+    pf.stop()
+    return out
+
+
+@jax.jit
+def ok_unrelated_methods(x, q):
+    # submit/consume/stop are generic names: an unrelated object's
+    # method must not trip the rule without a prefetch-ish head
+    q.submit(x)
+    return x

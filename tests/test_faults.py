@@ -106,6 +106,18 @@ def test_hang_sleeps_then_releases_as_fault():
     assert faults.fired()[0]["action"] == "hang"
 
 
+def test_latency_sleeps_then_proceeds_without_fault():
+    # slow-storage simulation: the check delays but NEVER raises, and
+    # every fire is still on the audit log (docs/RUNNER.md, PERF.md §8)
+    faults.configure("site:archive_read@1.0,latency=0.15")
+    t0 = time.monotonic()
+    faults.check("archive_read", key="slow_mount.fits")
+    assert time.monotonic() - t0 >= 0.15
+    assert [f["action"] for f in faults.fired()] == ["latency"]
+    faults.check("archive_read", key="slow_mount.fits")
+    assert len(faults.fired()) == 2  # probability 1.0: every check
+
+
 def test_signal_clause_delivers_once_at_count(monkeypatch):
     got = []
     prev = signal.signal(signal.SIGTERM,

@@ -291,6 +291,93 @@ def test_barrier_injected_failure_propagates():
         barrier("pptpu_runner_merge", timeout_s=1.0)
 
 
+def _seed_firing_only(files, target, site="archive_read", p=0.5):
+    """Seed under which the keyed-probability hash fires for exactly
+    ``target`` out of ``files`` — order-independent targeting, so the
+    same spec hits the same archive whether the load runs inline or on
+    the prefetch thread."""
+    fire = faults._Harness._hash_fires
+    for seed in range(500):
+        c = SimpleNamespace(p=p, seed=seed)
+        if [f for f in files if fire(c, site, f, 1)] == [target]:
+            return seed
+    raise AssertionError("no discriminating seed found")
+
+
+def test_prefetch_read_fault_parity_with_serial(survey, tmp_path):
+    """Acceptance: an archive_read fault firing on the prefetch thread
+    travels the outcome-replay hand-off and quarantines with exactly
+    the serial path's ledger outcome and reason chain — per-archive
+    results identical, only the thread the fault fired on differs."""
+    bad = survey.files[1]
+    spec = "site:archive_read@0.5,seed=%d" % _seed_firing_only(
+        survey.files, bad)
+    plan = plan_survey(survey.files, modelfile=survey.gm)
+    outcomes = {}
+    for tag, pf in (("serial", 0), ("prefetch", 2)):
+        faults.reset()
+        faults.configure(spec)
+        wd = str(tmp_path / ("wd_" + tag))
+        s = run_survey(plan, wd, process_index=0, process_count=1,
+                       bary=False, backoff_s=0.0, max_attempts=2,
+                       prefetch=pf, merge=False)
+        faults.reset()
+        quar = {r["archive"]: r["reason"] for r in _ledger(wd)
+                if r["state"] == "quarantined"}
+        toas = sorted(ln.split()[0] for ln in
+                      _toa_lines(s["checkpoint"]))
+        outcomes[tag] = (s["counts"], quar, toas)
+        if pf:
+            # the fault genuinely fired off the fit timeline: the bad
+            # archive's loads all ran as prefetch_load spans
+            evs = _obs_events(s["obs_run"])
+            pre = [e for e in evs if e.get("name") == "prefetch_load"]
+            assert any(e.get("archive") == bad for e in pre), pre
+    assert outcomes["serial"] == outcomes["prefetch"]
+    counts, quar, _ = outcomes["prefetch"]
+    assert counts["done"] == 2 and counts["quarantined"] == 1
+    assert set(quar) == {WorkQueue.key_for(bad)}
+    assert "retries exhausted" in quar[WorkQueue.key_for(bad)]
+
+
+def test_sigterm_drains_prefetch_window_losslessly(survey, tmp_path):
+    """Acceptance: SIGTERM with archives claimed ahead in the prefetch
+    window — the in-flight fit finishes, the window's claims are handed
+    back (reset, lease released), and resume refits nothing."""
+    wd = str(tmp_path / "wd")
+    plan = plan_survey(survey.files, modelfile=survey.gm)
+    faults.configure("sigterm@after=1")  # during the 1st dispatch
+    s1 = run_survey(plan, wd, process_index=0, process_count=1,
+                    bary=False, backoff_s=0.0, prefetch=2, merge=False)
+    assert s1.get("drained") == "SIGTERM", s1
+    assert s1["counts"]["done"] == 1      # the in-flight archive
+    assert s1["counts"]["pending"] == 2   # window handed back
+    assert s1["counts"]["running"] == 0   # no stranded lease
+    evs = _obs_events(s1["obs_run"])
+    ab = [e for e in evs if e.get("name") == "prefetch_abandoned"]
+    assert ab and all("SIGTERM" in e["cause"] for e in ab), ab
+    resets = [r for r in _ledger(wd) if r["state"] == "pending"
+              and "prefetch_abandoned" in (r.get("reason") or "")]
+    assert len(resets) == len(ab)
+
+    faults.reset()
+    s2 = run_survey(plan, wd, process_index=0, process_count=1,
+                    bary=False, backoff_s=0.0, prefetch=2, merge=False)
+    assert not s2.get("drained")
+    assert s2["counts"]["done"] == 3
+    # nothing refit, nothing duplicated: one done record per archive,
+    # one block of nsub TOA lines each
+    done = {}
+    for rec in _ledger(wd):
+        if rec["state"] == "done":
+            done[rec["archive"]] = done.get(rec["archive"], 0) + 1
+    assert done == {WorkQueue.key_for(f): 1 for f in survey.files}
+    per_arch = {}
+    for ln in _toa_lines(s2["checkpoint"]):
+        per_arch[ln.split()[0]] = per_arch.get(ln.split()[0], 0) + 1
+    assert per_arch == {f: 2 for f in survey.files}
+
+
 def test_watchdog_off_by_default(survey, tmp_path):
     """Without watchdog_s the guarded path is a plain call — no worker
     threads, identical results (the tier-1 perf contract)."""

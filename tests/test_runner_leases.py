@@ -568,6 +568,77 @@ def test_midfit_takeover_abandons_without_transition(survey, tmp_path,
     assert lost[0]["new_owner"] == "p9@1.1"
 
 
+def test_takeover_mid_prefetch_discards_buffer_without_transition(
+        survey, tmp_path, monkeypatch):
+    """A lease taken over while the archive's buffer sits in the
+    claim-ahead prefetch window: the loser discards the buffer and
+    makes NO ledger transition — no reset, no fail — exactly the
+    mid-fit abandon discipline.  The thief's short lease then expires
+    and the loser's own retry round takes the archive back, so the run
+    still ends with one done record and one checkpoint block."""
+    from pulseportraiture_tpu.pipelines import toas as toas_mod
+
+    wd = str(tmp_path / "wd")
+    plan = plan_survey(survey.files[:2], modelfile=survey.gm)
+    # claim order = plan order; with depth 2 the second archive waits
+    # prefetched in the window while the first one fits
+    stolen = plan.buckets[0].archives[1].path
+    real_fit = toas_mod.fit_portrait_full_batch
+    thief = {"n": 0}
+
+    def stealing_fit(*a, **k):
+        thief["n"] += 1
+        if thief["n"] == 1:
+            # a sibling claims the WINDOWED archive while the first
+            # one is mid-fit (as if our lease had expired), with a
+            # short lease so the loser can take it back
+            q = WorkQueue(os.path.join(wd, "ledger.9.jsonl"),
+                          union_dir=wd, owner="p9@1.1", lease_s=0.05,
+                          process_index=9)
+            q.claim(stolen)
+            q.close()
+        return real_fit(*a, **k)
+
+    monkeypatch.setattr(toas_mod, "fit_portrait_full_batch",
+                        stealing_fit)
+    s = run_survey(plan, wd, process_index=0, process_count=1,
+                   bary=False, backoff_s=0.0, prefetch=2, merge=False)
+    monkeypatch.setattr(toas_mod, "fit_portrait_full_batch", real_fit)
+    assert s["counts"]["done"] == 2
+    kkey = WorkQueue.key_for(stolen)
+    # exactly one done record for the stolen archive (the retake's)
+    done = [r for r in _union_ledger(wd)
+            if r["archive"] == kkey and r["state"] == "done"]
+    assert len(done) == 1
+    # the loser made NO transition at discard time: every shard-0
+    # record for the stolen archive between the thief's claim and the
+    # loser's retake is the thief's — no reset/fail by p0
+    evs = _obs_events(s["obs_run"])
+    lost = [e for e in evs if e.get("name") == "lease_lost"]
+    assert len(lost) == 1 and lost[0]["new_owner"] == "p9@1.1"
+    assert lost[0]["block_dropped"] is False  # never fit, no block
+    disc = [e for e in evs if e.get("name") == "prefetch_discarded"]
+    assert len(disc) == 1 and disc[0]["cause"] == "lease_lost"
+    assert disc[0]["archive"] == stolen
+    # the abandoned claim left no reset record (discard is NOT a
+    # transition; contrast the SIGTERM drain, which resets)
+    assert not [r for r in _union_ledger(wd)
+                if r["archive"] == kkey and r["state"] == PENDING
+                and "prefetch" in (r.get("reason") or "")]
+    # the retake is visible: the loser's second claim carries the
+    # lease_expired revocation of the thief's lease
+    exp = [r for r in _union_ledger(wd)
+           if r["archive"] == kkey
+           and r.get("reason") == "lease_expired"
+           and r.get("prev_owner") == "p9@1.1"]
+    assert len(exp) == 1 and exp[0]["owner"].startswith("p0@")
+    # one checkpoint block, from the fit that landed
+    per_arch = {}
+    for ln in _toa_lines(s["checkpoint"]):
+        per_arch[ln.split()[0]] = per_arch.get(ln.split()[0], 0) + 1
+    assert per_arch == {f: 2 for f in survey.files[:2]}
+
+
 def test_status_shows_owners_leases_and_expired(survey, tmp_path):
     """ppsurvey status on a live multi-shard workdir: per-owner
     counts, lease time-to-expiry, and expired-but-unreclaimed

@@ -64,7 +64,17 @@
 #                 drift stand-in) must fail the quality gate while
 #                 every time/memory gate stays green
 #                 (docs/OBSERVABILITY.md Quality)
-#  13. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
+#  13. prefetch smoke — the streaming host pipeline end to end: the
+#                 same tiny survey run serial and with --prefetch 2
+#                 must agree archive-for-archive (ledger outcomes,
+#                 TOA lines, obs_diff incl. the quality fingerprint),
+#                 the prefetch counters must show hits>0/discarded=0,
+#                 obs_trace must show the load phase off the
+#                 per-archive critical path, and an injected
+#                 archive_read fault on the prefetch thread must
+#                 quarantine identically to serial
+#                 (docs/RUNNER.md "Host pipeline")
+#  14. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
 #
 # Exit status is non-zero when any stage fails.
 set -u
@@ -196,6 +206,17 @@ if [ $? -ne 0 ]; then
     fail=1
 else
     tail -1 /tmp/_quality_smoke.log
+fi
+
+echo
+echo "== prefetch smoke (streaming host pipeline, docs/RUNNER.md) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu PPTPU_OBS_DIR="" PPTPU_FAULTS="" \
+    python -m tools.prefetch_smoke >/tmp/_prefetch_smoke.log 2>&1
+if [ $? -ne 0 ]; then
+    tail -40 /tmp/_prefetch_smoke.log
+    fail=1
+else
+    tail -1 /tmp/_prefetch_smoke.log
 fi
 
 echo

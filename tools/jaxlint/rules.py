@@ -130,6 +130,18 @@ _RUNNER_API_NAMES = {"plan_survey", "run_survey", "scan_archive_header",
 _FAULTS_API_NAMES = {"check", "configure", "reset", "fired", "active",
                      "spec_string"}
 
+# host prefetch pipeline (pulseportraiture_tpu.runner.prefetch + the
+# archive loaders it schedules): thread pools, hand-off events and
+# FITS decode are host-side by construction — under jit a submit would
+# spawn threads at trace time and the decoded buffer could never feed
+# the compiled program.  The generic method names (submit, consume,
+# stop, ...) match only behind a ``prefetch.``/``prefetcher.`` head;
+# the distinctive entry points also match bare.
+_PREFETCH_METHOD_NAMES = {"submit", "try_submit", "consume", "discard",
+                          "stop"}
+_PREFETCH_BARE_NAMES = {"HostPrefetcher", "PrefetchTicket",
+                        "load_bucketed_databunch", "load_archive_data"}
+
 # TOA service (pulseportraiture_tpu.service): host-side daemon
 # orchestration by contract — socket IO, ledger intake, thread
 # barriers and warm-up drive the jit boundary from OUTSIDE; under jit
@@ -545,6 +557,19 @@ class RuleVisitor(ast.NodeVisitor):
                           "rewrites); under jit it would run once at "
                           "trace time and its file IO is unreachable "
                           "from compiled code (docs/RUNNER.md)")
+            elif fname is not None and (
+                    (fname.rsplit(".", 1)[-1] in _PREFETCH_METHOD_NAMES
+                     and fname.startswith(("prefetch.", "prefetcher.",
+                                           "runner.prefetch.")))
+                    or fname.rsplit(".", 1)[-1] in _PREFETCH_BARE_NAMES):
+                self._add("J002", node,
+                          "host-prefetch call inside a jitted function "
+                          "— the prefetch pipeline is host-side by "
+                          "construction (worker threads, hand-off "
+                          "events, FITS decode); under jit it would "
+                          "run once at trace time and its buffers "
+                          "cannot feed compiled code (docs/RUNNER.md "
+                          "Host pipeline)")
             elif fname is not None and (
                     (fname.startswith("service.")
                      and fname.split(".", 1)[1] in _SERVICE_API_NAMES)

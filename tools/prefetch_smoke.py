@@ -1,0 +1,220 @@
+"""Prefetch smoke gate: the streaming host pipeline end to end (wired
+into tools/check.sh).
+
+Drives the same tiny two-bucket synthetic survey twice — once with the
+serial loader (``prefetch=0``) and once through the double-buffered
+host prefetch stage (``--prefetch 2``) — and asserts the contract
+docs/RUNNER.md "Host pipeline" names:
+
+* **bit-identical results**: the two runs agree archive-for-archive —
+  ledger outcomes, per-archive TOA counts, and the checkpoint's TOA
+  lines are equal; an ``obs_diff`` serial-vs-prefetch diff passes every
+  gate including ``--quality-rel`` (the fit-quality fingerprint cannot
+  tell the runs apart);
+* **the pipeline engaged**: the prefetch run's merged manifest counts
+  ``pps_prefetch_hits > 0`` and ``pps_prefetch_discarded == 0``;
+* **load moved off the critical path**: ``tools/obs_trace``'s
+  per-archive critical-path aggregate shows the ``load`` phase reduced
+  vs serial (the decode shows up as ``prefetch_load`` instead, off the
+  fit timeline);
+* **faults replay exactly**: an ``archive_read`` fault injected via an
+  order-independent per-key probability clause fires on the prefetch
+  thread and lands exactly one quarantine with the same reason chain
+  as the serial run under the same spec.
+
+Run:  env JAX_PLATFORMS=cpu python -m tools.prefetch_smoke
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+from types import SimpleNamespace
+
+import numpy as np
+
+QUALITY_REL = 0.25
+
+
+def _build_inputs(workroot):
+    from pulseportraiture_tpu.io.archive import make_fake_pulsar
+    from pulseportraiture_tpu.io.gmodel import write_model
+
+    gm = os.path.join(workroot, "smoke.gmodel")
+    write_model(gm, "smoke", "000", 1500.0,
+                np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5]),
+                np.ones(8, int), -4.0, 0, quiet=True)
+    par = os.path.join(workroot, "smoke.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    files = []
+    # two shape buckets, two archives each: the window spans bucket
+    # boundaries, so the hand-off is exercised across program switches
+    for i, (nchan, nbin) in enumerate([(8, 64), (8, 64),
+                                       (8, 128), (8, 128)]):
+        fits = os.path.join(workroot, "good%d.fits" % i)
+        make_fake_pulsar(gm, par, fits, nsub=2, nchan=nchan, nbin=nbin,
+                         nu0=1500.0, bw=800.0, tsub=60.0,
+                         phase=0.05 + 0.01 * i, dDM=5e-4,
+                         noise_stds=0.01, dedispersed=False,
+                         seed=11 + i, quiet=True)
+        files.append(fits)
+    meta = os.path.join(workroot, "survey.meta")
+    with open(meta, "w") as f:
+        f.write("\n".join(files) + "\n")
+    return meta, gm, files
+
+
+def _ledger_outcomes(workdir):
+    """Final (state, n_toas) per archive from the process-0 ledger."""
+    out = {}
+    with open(os.path.join(workdir, "ledger.0.jsonl")) as fh:
+        for ln in fh:
+            if not ln.strip():
+                continue
+            rec = json.loads(ln)
+            out[rec["archive"]] = (rec["state"], rec.get("n_toas"))
+    return out
+
+
+def _toa_lines(ckpt):
+    return sorted(ln for ln in open(ckpt)
+                  if ln.split() and ln.split()[0] not in
+                  ("FORMAT", "C", "#"))
+
+
+def _manifest_counters(run_dir):
+    with open(os.path.join(run_dir, "manifest.json"),
+              encoding="utf-8") as fh:
+        return json.load(fh).get("counters", {})
+
+
+def _load_critical_p50(run_dir, phase="load"):
+    """p50 critical-path seconds the given phase contributed across
+    the run's per-archive traces (tools/obs_trace importable API)."""
+    from tools.obs_trace import aggregate_critical_path, analyze
+
+    res = analyze([run_dir])
+    summaries = [s for s in res["traces"].values()
+                 if s["root"] == "archive"]
+    assert summaries, "no archive traces under %s" % run_dir
+    agg = aggregate_critical_path(summaries, qs=(0.5,))
+    return agg["phases"].get(phase, {}).get("p50", 0.0), len(summaries)
+
+
+def _chaos_seed(files, target):
+    """Seed under which the keyed-probability hash fires for exactly
+    ``target`` — order-independent, so the same spec hits the same
+    archive whether the load runs inline or on the prefetch thread."""
+    from pulseportraiture_tpu.testing import faults
+
+    fire = faults._Harness._hash_fires
+    for seed in range(500):
+        c = SimpleNamespace(p=0.5, seed=seed)
+        if [f for f in files
+                if fire(c, "archive_read", f, 1)] == [target]:
+            return seed
+    raise AssertionError("no discriminating chaos seed found")
+
+
+def main():
+    workroot = tempfile.mkdtemp(prefix="pptpu_prefetch_smoke_")
+    os.environ.pop("PPTPU_FAULTS", None)
+    try:
+        from pulseportraiture_tpu.runner import plan_survey, run_survey
+        from pulseportraiture_tpu.runner.queue import WorkQueue
+        from pulseportraiture_tpu.testing import faults
+        from tools import obs_diff
+
+        meta, gm, files = _build_inputs(workroot)
+        plan = plan_survey(meta, modelfile=gm)
+        assert len(plan.buckets) == 2, [b.key for b in plan.buckets]
+
+        wd_ser = os.path.join(workroot, "wd_serial")
+        wd_pf = os.path.join(workroot, "wd_prefetch")
+        s_ser = run_survey(plan, wd_ser, process_index=0,
+                           process_count=1, bary=False, prefetch=0)
+        s_pf = run_survey(plan, wd_pf, process_index=0,
+                          process_count=1, bary=False, prefetch=2)
+
+        # 1. archive-for-archive parity: counts, ledger outcomes,
+        # per-archive TOA counts, and the checkpoint's TOA lines
+        assert s_ser["counts"] == s_pf["counts"], (s_ser["counts"],
+                                                   s_pf["counts"])
+        assert s_pf["counts"]["done"] == 4, s_pf["counts"]
+        assert _ledger_outcomes(wd_ser) == _ledger_outcomes(wd_pf)
+        assert _toa_lines(s_ser["checkpoint"]) \
+            == _toa_lines(s_pf["checkpoint"])
+
+        # 2. the pipeline genuinely engaged, and nothing was dropped
+        c_pf = _manifest_counters(s_pf["obs_merged"])
+        assert c_pf.get("pps_prefetch_hits", 0) > 0, c_pf
+        assert c_pf.get("pps_prefetch_discarded", 0) == 0, c_pf
+        c_ser = _manifest_counters(s_ser["obs_merged"])
+        assert "pps_prefetch_hits" not in c_ser, c_ser
+
+        # 3. serial-vs-prefetch obs_diff passes every gate, including
+        # the fit-quality fingerprint (bit-identical by construction)
+        rc = obs_diff.main([s_ser["obs_merged"], s_pf["obs_merged"],
+                            "--rel", "5.0", "--min-s", "1.0",
+                            "--quality-rel", str(QUALITY_REL),
+                            "--quality-min-subints", "4"])
+        assert rc == 0, \
+            "serial-vs-prefetch obs_diff flagged a drift (rc %d)" % rc
+
+        # 4. the decode left the fit timeline: per-archive critical
+        # path shows the load phase collapsed vs serial
+        ser_load, n_ser = _load_critical_p50(s_ser["obs_merged"])
+        pf_load, n_pf = _load_critical_p50(s_pf["obs_merged"])
+        assert n_ser == 4 and n_pf == 4, (n_ser, n_pf)
+        assert ser_load > 0.0, "serial run recorded no load phase"
+        assert pf_load <= max(0.8 * ser_load, 0.002), \
+            "load critical-path not reduced: serial %.4fs vs " \
+            "prefetch %.4fs" % (ser_load, pf_load)
+        pf_span, _ = _load_critical_p50(s_pf["obs_merged"],
+                                        phase="prefetch_load")
+        assert pf_span >= 0.0  # present in the trace plane
+
+        # 5. chaos through the prefetch thread: the same per-key
+        # probability spec quarantines exactly one archive with the
+        # same reason chain serial does
+        bad = files[2]
+        spec = "site:archive_read@0.5,seed=%d" % _chaos_seed(files, bad)
+        reasons = {}
+        for tag, pf in (("serial", 0), ("prefetch", 2)):
+            faults.reset()
+            faults.configure(spec)
+            wd = os.path.join(workroot, "wd_chaos_" + tag)
+            s = run_survey(plan, wd, process_index=0, process_count=1,
+                           bary=False, backoff_s=0.0, max_attempts=2,
+                           prefetch=pf, merge=False)
+            faults.reset()
+            assert s["counts"]["done"] == 3 \
+                and s["counts"]["quarantined"] == 1, (tag, s["counts"])
+            quar = {a: st for a, (st, _) in
+                    _ledger_outcomes(wd).items()
+                    if st == "quarantined"}
+            assert set(quar) == {WorkQueue.key_for(bad)}, (tag, quar)
+            (reasons[tag],) = [json.loads(ln)["reason"]
+                               for ln in open(os.path.join(
+                                   wd, "ledger.0.jsonl"))
+                               if ln.strip()
+                               and json.loads(ln)["state"]
+                               == "quarantined"]
+        assert reasons["serial"] == reasons["prefetch"], reasons
+        assert "retries exhausted" in reasons["prefetch"], reasons
+
+        print("prefetch smoke OK: 4/4 archives identical serial vs "
+              "--prefetch 2 (hits=%d, load p50 %.1fms -> %.1fms), "
+              "obs_diff clean, chaos quarantine parity at %s"
+              % (c_pf.get("pps_prefetch_hits", 0), ser_load * 1e3,
+                 pf_load * 1e3, s_pf["obs_merged"]))
+        return 0
+    finally:
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
