@@ -38,6 +38,12 @@ extra carries the other BASELINE.md configs and the accuracy criterion:
   double-buffered host prefetch stage (--prefetch 2) vs the serial
   loader on the same archives (_survey_prefetch_stage,
   docs/RUNNER.md "Host pipeline").
+- time_to_first_fit_cold_s / time_to_first_fit_warm_s /
+  warm_compile_cache_hit_rate / warm_s: zero-cold-start startup — the
+  same survey as two fresh ``ppsurvey run --warm`` subprocesses
+  sharing one persistent compile cache; the cold leg pays the XLA
+  compiles, the warm leg deserializes them (_survey_warm_stage,
+  docs/RUNNER.md "Warm start").
 - gflops_approx: rough sustained FLOP/s from an rFFT+iteration count.
 """
 
@@ -266,6 +272,85 @@ def _survey_prefetch_stage(on_accel):
         return (n_arch / serial_dur, n_arch / pf_dur, hit_rate, depth)
     finally:
         shutil.rmtree(sdir, ignore_errors=True)
+
+
+def _survey_warm_stage():
+    """Cold-vs-warm startup through the persistent compile cache
+    (docs/RUNNER.md "Warm start"): the same tiny survey run twice as
+    fresh ``ppsurvey run --warm`` subprocesses sharing one fresh
+    ``--compile-cache`` dir.  The first (cold) process pays the real
+    XLA compiles into the cache; the second (warm) deserializes them,
+    so its time-to-first-fit is the zero-cold-start number.  Both legs
+    run as CPU subprocesses — an accelerator parent already holds the
+    device, and the cold/warm delta being measured is host-side
+    compile vs cache deserialize.  Returns (cold time-to-first-fit,
+    warm time-to-first-fit, warm-leg cache hit rate, warm-leg warm
+    wall) in seconds."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    from pulseportraiture_tpu.io.archive import make_fake_pulsar
+
+    wdir = tempfile.mkdtemp(prefix="pp_bench_warm_")
+    try:
+        wgm, wpar = _bench_source(wdir)
+        w_rng = np.random.default_rng(13)
+        wfiles = []
+        for i in range(2):
+            out = os.path.join(wdir, "w%03d.fits" % i)
+            make_fake_pulsar(wgm, wpar, out, nsub=2, nchan=32,
+                             nbin=256, nu0=1500.0, bw=800.0, tsub=60.0,
+                             phase=float(w_rng.uniform(-0.2, 0.2)),
+                             dDM=float(w_rng.normal(0, 1e-3)),
+                             noise_stds=0.01, dedispersed=False,
+                             seed=700 + i, quiet=True)
+            wfiles.append(out)
+        meta = os.path.join(wdir, "w.meta")
+        with open(meta, "w") as fh:
+            fh.write("\n".join(wfiles) + "\n")
+        cache = os.path.join(wdir, "ppcache")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PPTPU_OBS_DIR"] = ""
+        env["PPTPU_FAULTS"] = ""
+        env.pop("PPTPU_COMPILE_CACHE_DIR", None)
+        repo = os.path.dirname(os.path.abspath(__file__))
+        cli = [sys.executable, "-m",
+               "pulseportraiture_tpu.cli.ppsurvey"]
+
+        def leg(tag):
+            wd = os.path.join(wdir, "wd_%s" % tag)
+            for args in (["plan", "-d", meta, "-m", wgm, "-w", wd],
+                         ["run", "-w", wd, "--compile-cache", cache,
+                          "--warm", "--no_bary", "--quiet"]):
+                res = subprocess.run(cli + args, cwd=repo, env=env,
+                                     capture_output=True, text=True,
+                                     timeout=600)
+                if res.returncode != 0:
+                    raise RuntimeError(
+                        "survey warm %s leg failed (%s): %s"
+                        % (tag, args[0], res.stderr[-800:]))
+            with open(os.path.join(wd, "survey.0.json"),
+                      encoding="utf-8") as fh:
+                return json.load(fh)
+
+        _stage('survey warm: cold leg (populates the compile cache)')
+        cold = leg("cold")
+        _stage('survey warm: warm leg (deserializes it)')
+        warm = leg("warm")
+        ws = warm.get("warm_summary") or {}
+        hits = int(ws.get("compile_cache_hits") or 0)
+        misses = int(ws.get("compile_cache_misses") or 0)
+        hit_rate = hits / (hits + misses) if hits + misses else None
+        _stage('survey warm: first fit cold %.1fs -> warm %.1fs'
+               % (cold.get("time_to_first_fit_s") or -1.0,
+                  warm.get("time_to_first_fit_s") or -1.0))
+        return (cold.get("time_to_first_fit_s"),
+                warm.get("time_to_first_fit_s"), hit_rate,
+                warm.get("warm_s"))
+    finally:
+        shutil.rmtree(wdir, ignore_errors=True)
 
 
 def main():
@@ -524,6 +609,11 @@ def _bench():
         survey_serial_rate, survey_pf_rate, pf_hit_rate, pf_depth = \
             _survey_prefetch_stage(on_accel)
 
+    # ---- zero-cold-start: cold vs warm time-to-first-fit --------------
+    with obs.span("survey_warm"):
+        ttff_cold, ttff_warm, warm_hit_rate, warm_wall = \
+            _survey_warm_stage()
+
     # ---- rough sustained FLOP/s for the main config -------------------
     # per subint: rFFT (5 N log2 N per channel) + ~n_iter fused moment
     # passes of ~40 flops per (channel, harmonic)
@@ -576,6 +666,14 @@ def _bench():
                                                   3),
             "prefetch_hit_rate": None if pf_hit_rate is None
             else round(pf_hit_rate, 3),
+            "time_to_first_fit_cold_s": None if ttff_cold is None
+            else round(ttff_cold, 3),
+            "time_to_first_fit_warm_s": None if ttff_warm is None
+            else round(ttff_warm, 3),
+            "warm_compile_cache_hit_rate": None
+            if warm_hit_rate is None else round(warm_hit_rate, 3),
+            "warm_s": None if warm_wall is None
+            else round(warm_wall, 3),
             "gflops_approx": round(float(gflops), 1),
             "backend_fallback": ns.backend_fallback,
         },

@@ -165,7 +165,8 @@ def _compile_cache(args):
     if cache:
         from ..service import enable_persistent_cache
 
-        enable_persistent_cache(cache)
+        if not enable_persistent_cache(cache):
+            return None  # degraded (compile_cache_degraded recorded)
     return cache or None
 
 

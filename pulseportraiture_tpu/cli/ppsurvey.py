@@ -6,6 +6,8 @@ report state + the merged observability run.
 
     python -m pulseportraiture_tpu.cli.ppsurvey plan   -d archives.meta \\
         -m model.gmodel -w workdir
+    python -m pulseportraiture_tpu.cli.ppsurvey warm   -w workdir \\
+        --compile-cache /shared/ppcache
     python -m pulseportraiture_tpu.cli.ppsurvey run    -w workdir
     python -m pulseportraiture_tpu.cli.ppsurvey resume -w workdir
     python -m pulseportraiture_tpu.cli.ppsurvey status -w workdir
@@ -120,6 +122,23 @@ def build_parser():
                             "one fits (docs/RUNNER.md Host pipeline). "
                             "0 = serial load, bit-identical results "
                             "either way.")
+        r.add_argument("--warm", nargs="?", const="always",
+                       choices=["always", "auto"], default=None,
+                       help="Warm the plan's program set at worker "
+                            "start (runner/warm.py), overlapped with "
+                            "the host prefetch so time-to-first-fit "
+                            "collapses.  'auto' warms only when a "
+                            "persistent compile cache is active or "
+                            "prefetch overlap hides the wall time "
+                            "(docs/RUNNER.md Warm start).")
+        r.add_argument("--compile-cache", default=None, metavar="DIR",
+                       dest="compile_cache",
+                       help="Persistent XLA compile-cache directory "
+                            "(default: $PPTPU_COMPILE_CACHE_DIR); "
+                            "share one dir across processes/restarts "
+                            "so warmed programs deserialize instead "
+                            "of recompiling.  A corrupt/unwritable "
+                            "dir degrades to normal compiles.")
         r.add_argument("--mesh", action="store_true", dest="use_mesh",
                        help="Shard each bucket batch over the local "
                             "device mesh.")
@@ -136,6 +155,41 @@ def build_parser():
         r.add_argument("--fit_scat", action="store_true")
         r.add_argument("--no_bary", dest="bary", action="store_false")
         r.add_argument("--quiet", action="store_true")
+
+    wm = sub.add_parser(
+        "warm", help="Warm a plan's programs into the persistent "
+                     "compile cache and exit (no survey run).")
+    wm.add_argument("-w", "--workdir", required=True,
+                    help="Survey working directory (its plan.json is "
+                         "the default --plan).")
+    wm.add_argument("-m", "--modelfile", default=None, metavar="model",
+                    help="Override the plan's model file (required "
+                         "for the toas workload if the plan carries "
+                         "none).")
+    wm.add_argument("--plan", default=None, metavar="plan.json",
+                    help="Plan to warm (default: <workdir>/plan.json).")
+    wm.add_argument("--workload", default=None, metavar="NAME",
+                    help="Warm this workload's program set (toas "
+                         "(default), zap, align, modelfit).")
+    wm.add_argument("--compile-cache", default=None, metavar="DIR",
+                    dest="compile_cache",
+                    help="Persistent compile-cache dir (default: "
+                         "$PPTPU_COMPILE_CACHE_DIR).  Idempotent and "
+                         "safe to run concurrently from N processes "
+                         "against one dir.")
+    wm.add_argument("--coalesce", type=int, default=0, metavar="K",
+                    help="Also warm the K-way coalesced batch "
+                         "programs (the service micro-batcher's "
+                         "dispatch shapes; toas only).")
+    wm.add_argument("--no-aot", action="store_false", dest="aot",
+                    help="Warm by execution only (skip the "
+                         "jit().lower().compile() persistent-cache "
+                         "stage).")
+    wm.add_argument("--narrowband", action="store_true")
+    wm.add_argument("--tscrunch", "-T", action="store_true")
+    wm.add_argument("--fit_scat", action="store_true")
+    wm.add_argument("--no_bary", dest="bary", action="store_false")
+    wm.add_argument("--quiet", action="store_true")
 
     st = sub.add_parser("status", help="Aggregate ledger state.")
     st.add_argument("-w", "--workdir", required=True)
@@ -197,6 +251,45 @@ def _parse_workload_opts(pairs):
     return opts
 
 
+def _cache_dir(args):
+    """--compile-cache or $PPTPU_COMPILE_CACHE_DIR, or None."""
+    return args.compile_cache \
+        or os.environ.get("PPTPU_COMPILE_CACHE_DIR", "").strip() \
+        or None
+
+
+def _cmd_warm(args):
+    from .. import obs
+    from ..runner.warm import enable_persistent_cache, warm_plan
+
+    plan = args.plan or _plan_path(args.workdir)
+    if not os.path.isfile(plan):
+        print(f"ppsurvey: no plan at {plan} — run 'ppsurvey plan' "
+              "first.", file=sys.stderr)
+        return 1
+    os.makedirs(args.workdir, exist_ok=True)
+    workload = args.workload or "toas"
+    fit_kw = {}
+    if workload == "toas":
+        fit_kw = dict(tscrunch=args.tscrunch, fit_scat=args.fit_scat)
+        if not args.narrowband:
+            fit_kw["bary"] = args.bary
+    with obs.run("ppsurvey-warm",
+                 base_dir=os.path.join(args.workdir, "obs")):
+        cache = _cache_dir(args)
+        if cache:
+            enable_persistent_cache(cache)
+        summary = warm_plan(
+            plan, args.modelfile, get_toas_kw=fit_kw,
+            coalesce=(args.coalesce,) if args.coalesce > 1 else (),
+            aot=args.aot, narrowband=args.narrowband,
+            quiet=args.quiet, workloads=(workload,))
+    print(json.dumps({k: summary[k] for k in
+                      ("n_programs", "wall_s", "backend_compiles",
+                       "compile_cache_hits", "compile_cache_misses")}))
+    return 0
+
+
 def _cmd_run(args):
     from ..runner.execute import run_survey
     from ..runner.queue import DEFAULT_WORKLOAD
@@ -226,6 +319,7 @@ def _cmd_run(args):
         barrier_timeout_s=args.barrier_timeout_s,
         lease_s=args.lease_s, narrowband=args.narrowband,
         workload=workload, prefetch=args.prefetch,
+        warm=args.warm, compile_cache=_cache_dir(args),
         workload_opts=_parse_workload_opts(args.workload_opts),
         quiet=args.quiet, **fit_kw)
     out = {"workload": summary.get("workload", workload),
@@ -346,8 +440,8 @@ def main(argv=None):
         build_parser().print_help()
         return 1
     return {"plan": _cmd_plan, "run": _cmd_run, "resume": _cmd_run,
-            "status": _cmd_status, "report": _cmd_report}[args.command](
-                args)
+            "warm": _cmd_warm, "status": _cmd_status,
+            "report": _cmd_report}[args.command](args)
 
 
 if __name__ == "__main__":

@@ -316,10 +316,22 @@ class Recorder:
         elif evt == monitor.CACHE_HIT_EVENT:
             # persistent-compile-cache outcome counters: a warm-started
             # process proves its cold compiles were saved here
-            # (docs/SERVICE.md zero-cold-start)
+            # (docs/SERVICE.md zero-cold-start).  Mirrored into the
+            # metrics plane so live --watch views show the hit/miss
+            # ratio without waiting for the run manifest.
             self.bump("compile_cache_hits")
+            try:
+                self.metrics_registry().inc(
+                    "pps_compile_cache_hits_total")
+            except Exception:
+                pass
         elif evt == monitor.CACHE_MISS_EVENT:
             self.bump("compile_cache_misses")
+            try:
+                self.metrics_registry().inc(
+                    "pps_compile_cache_misses_total")
+            except Exception:
+                pass
 
     # -- manifest -------------------------------------------------------
 

@@ -854,6 +854,32 @@ def _quality_row(snap):
         "%.3g" % med if med is not None else "-")
 
 
+def _compile_cache_row(snap):
+    """The ``--watch`` persistent-compile-cache line from the exact
+    ``pps_compile_cache_*_total`` counters (summed across any
+    ``p<proc>/`` merge prefixes); None when the snapshot carries no
+    cache series (pre-warm runs keep their original frame)."""
+    hits = misses = 0
+    seen = False
+    for key, v in (snap.get("counters") or {}).items():
+        base = key.rsplit("/", 1)[-1]
+        try:
+            if base == "pps_compile_cache_hits_total":
+                hits += int(v)
+                seen = True
+            elif base == "pps_compile_cache_misses_total":
+                misses += int(v)
+                seen = True
+        except (TypeError, ValueError):
+            continue
+    if not seen:
+        return None
+    total = hits + misses
+    rate = " (%.0f%% hit)" % (100.0 * hits / total) if total else ""
+    return "compile-cache: %d hit(s) / %d miss(es)%s" % (hits, misses,
+                                                         rate)
+
+
 def render_watch(snap, prev=None, title=""):
     """A terminal dashboard frame from one snapshot (pptop-style).
 
@@ -945,6 +971,11 @@ def render_watch(snap, prev=None, title=""):
         if not mem:
             lines.append("")
         lines.append(qual)
+    cache = _compile_cache_row(snap)
+    if cache:
+        if not mem and not qual:
+            lines.append("")
+        lines.append(cache)
     if gauges:
         lines.append("")
         lines.append("gauges: " + "  ".join(

@@ -23,6 +23,8 @@ from .plan import (ArchiveInfo, ShapeBucket, SurveyPlan, canonical_shape,
 from .queue import DEFAULT_WORKLOAD, WorkQueue
 from .execute import run_survey, survey_status
 from .prefetch import HostPrefetcher, PrefetchTicket
+from .warm import (WarmSpec, enable_persistent_cache, program_specs,
+                   synth_databunch, warm_plan)
 from .workloads import (AlignWorkload, ModelFitWorkload, ToasWorkload,
                         Workload, ZapWorkload, get_workload,
                         register_workload, resolve_workload,
@@ -34,4 +36,6 @@ __all__ = ["ArchiveInfo", "ShapeBucket", "SurveyPlan", "canonical_shape",
            "WorkQueue", "DEFAULT_WORKLOAD", "run_survey",
            "survey_status", "Workload", "ToasWorkload", "ZapWorkload",
            "AlignWorkload", "ModelFitWorkload", "register_workload",
-           "get_workload", "workload_names", "resolve_workload"]
+           "get_workload", "workload_names", "resolve_workload",
+           "WarmSpec", "program_specs", "warm_plan",
+           "enable_persistent_cache", "synth_databunch"]

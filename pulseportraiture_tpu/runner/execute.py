@@ -679,6 +679,7 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                trace_bucket=False, watchdog_s=None,
                barrier_timeout_s=600.0, lease_s=600.0,
                narrowband=False, workload=None, workload_opts=None,
+               warm=None, compile_cache=None,
                prefetch=0, quiet=True, **get_toas_kw):
     """Execute (or resume) one process's share of a survey plan.
 
@@ -768,6 +769,27 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
     lost while queued discards the buffer with NO ledger transition.
     Ignored for workloads without a prefetchable load phase
     (``supports_prefetch`` is False).
+
+    ``warm`` (``ppsurvey run --warm[=auto]``) runs the shared warm
+    pass (runner/warm.py) at worker start: every program the plan's
+    buckets will dispatch for this workload is compiled/primed before
+    the first claim, against the persistent compile cache when
+    ``compile_cache`` names one (``--compile-cache`` /
+    ``$PPTPU_COMPILE_CACHE_DIR``), so a resumed/rescheduled worker
+    starts fit-bound.  Under ``--prefetch`` the warm pass OVERLAPS the
+    host pipeline: the first window of this process's preferred slice
+    is decoded speculatively on the prefetch workers while the main
+    thread warms, and the claim loop adopts those buffers after a
+    fresh claim (lease semantics unchanged — no claim is taken before
+    warm finishes).  ``"always"``/True warms unconditionally;
+    ``"auto"`` warms only when it can pay for itself (a persistent
+    cache is active, or prefetch overlap hides the wall time).  Warm
+    is never fatal: failures degrade to normal first-use compiles
+    (``warm_failed`` / ``compile_cache_degraded`` events).  When warm
+    ran, the summary/manifest gain ``warm_s``,
+    ``time_to_first_fit_s`` and a ``warm_summary`` compile/cache
+    digest; without ``--warm`` the manifest is bit-identical to the
+    pre-warm behavior.
     """
     if isinstance(plan, str):
         plan = SurveyPlan.load(plan)
@@ -848,6 +870,15 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
     checkpoint = None
     prefetcher = None
     revoked = []
+    # zero-cold-start state (--warm): wall time of the warm pass, its
+    # compile/cache digest, when the first fit completed, and the
+    # speculative prefetch tickets warm overlapped with
+    warm_mode = warm if isinstance(warm, str) else \
+        ("always" if warm else None)
+    warm_s = None
+    warm_summary = None
+    first_fit = {"t": None}
+    speculative = {}
     try:
         with obs.run("ppsurvey", base_dir=paths["obs"],
                      config={"process": pid, "n_processes": nproc,
@@ -866,6 +897,49 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
             t0 = time.perf_counter()
             if prefetch_depth:
                 prefetcher = HostPrefetcher(depth=prefetch_depth)
+            # persistent compile cache + warm-overlapped startup
+            # (docs/RUNNER.md "Warm start"): enable the cache first
+            # (degraded-not-fatal), kick the first window of loads
+            # onto the prefetch workers, then warm the plan's program
+            # set on the main thread while those decodes run
+            from .warm import (WARM_WORKLOADS, enable_persistent_cache,
+                               warm_plan)
+
+            cache_ok = None
+            if compile_cache:
+                cache_ok = enable_persistent_cache(compile_cache)
+            do_warm = wl.name in WARM_WORKLOADS and (
+                warm_mode == "always"
+                or (warm_mode == "auto"
+                    and (bool(cache_ok) or prefetch_depth > 0)))
+            if warm_mode is not None and not do_warm:
+                obs.event("warm_skipped", mode=warm_mode,
+                          workload=wl.name,
+                          compile_cache=bool(cache_ok))
+            if do_warm:
+                if prefetcher is not None:
+                    for idx in order_idx[:prefetch_depth]:
+                        sinfo, sbucket = ordered[idx]
+                        speculative[sinfo.path] = prefetcher.submit(
+                            sinfo.path,
+                            functools.partial(
+                                load_bucketed_databunch, sinfo.path,
+                                sbucket.key, tscrunch=pf_tscrunch,
+                                quiet=quiet),
+                            est_bytes=sbucket.est_bytes())
+                tw0 = time.perf_counter()
+                try:
+                    with obs.span("warm", workload=wl.name):
+                        warm_summary = warm_plan(
+                            plan, modelfile, get_toas_kw=get_toas_kw,
+                            narrowband=narrowband, quiet=quiet,
+                            workloads=(wl.name,))
+                except Exception as e:
+                    # never fatal: the run proceeds with first-use
+                    # compiles
+                    obs.event("warm_failed", error="%s: %s"
+                              % (type(e).__name__, e))
+                warm_s = time.perf_counter() - tw0
             if rec is not None and plan.buckets:
                 # analytical footprint ceiling (runner/plan.py): the
                 # largest per-bucket estimate the plan will dispatch;
@@ -974,6 +1048,12 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                             span_id=item.ctx[1], archive=info.path,
                             bucket=blabel, workload=wlabel,
                             owner=owner)
+                        if first_fit["t"] is None:
+                            # time-to-first-fit: worker start -> first
+                            # completed fit attempt (includes any
+                            # compile the warm pass did not absorb)
+                            first_fit["t"] = \
+                                time.perf_counter() - t0
                     if st_poisoned:
                         # the abandoned worker may still touch this
                         # state; retries get a fresh one
@@ -1061,15 +1141,23 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                                     # the window
                                     if hb is not None:
                                         hb.acquire(info.path)
-                                    item.ticket = prefetcher.submit(
-                                        info.path,
-                                        functools.partial(
-                                            load_bucketed_databunch,
-                                            info.path, bucket.key,
-                                            tscrunch=pf_tscrunch,
-                                            quiet=quiet),
-                                        est_bytes=bucket.est_bytes(),
-                                        ctx=trace_ctx)
+                                    # adopt the warm-overlapped
+                                    # speculative decode when one is
+                                    # in flight for this archive (the
+                                    # claim above owns the lease; the
+                                    # buffer is claim-independent)
+                                    item.ticket = speculative.pop(
+                                        info.path, None)
+                                    if item.ticket is None:
+                                        item.ticket = prefetcher.submit(
+                                            info.path,
+                                            functools.partial(
+                                                load_bucketed_databunch,
+                                                info.path, bucket.key,
+                                                tscrunch=pf_tscrunch,
+                                                quiet=quiet),
+                                            est_bytes=bucket.est_bytes(),
+                                            ctx=trace_ctx)
                             if prefetcher is None:
                                 _fit_item(item)
                             else:
@@ -1129,12 +1217,23 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                                              else exp - now)
                         if not waits:
                             break
+                        # sleep to the earliest deadline, but poll the
+                        # union view on the way: a live sibling
+                        # completing its claims must wake this process
+                        # immediately, not after the sibling's full
+                        # lease runs out (a --warm worker that lost
+                        # the claim race would otherwise idle for
+                        # minutes behind a finished survey)
                         deadline = now + max(0.0, min(waits))
+                        woke = 0
                         while time.time() < deadline \
                                 and not drain["sig"]:
                             time.sleep(min(0.2,
                                            deadline - time.time()))
-                        n_new = queue.refresh()
+                            woke = queue.refresh()
+                            if woke:
+                                break
+                        n_new = woke or queue.refresh()
                         # a live sibling renewing or completing IS
                         # progress; only a dead-still union view
                         # counts toward the stall cap (a backstop
@@ -1189,6 +1288,11 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                 # ShapeBucket.est_bytes)
                 obs.gauge("prefetch_buffer_peak_bytes",
                           prefetcher.peak_bytes)
+            if warm_s is not None:
+                obs.gauge("warm_s", round(warm_s, 6))
+                if first_fit["t"] is not None:
+                    obs.gauge("time_to_first_fit_s",
+                              round(first_fit["t"], 6))
             if rec is not None and trace_base is not None:
                 # was this run fit-bound or IO-bound?  devtime
                 # ingestion sums attributed device seconds into a run
@@ -1248,6 +1352,18 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
 
         extra = {"checkpoint": checkpoint,
                  "obs_run": run_dir, "n_fit_attempts": n_fit}
+        if warm_s is not None:
+            # only when --warm ran: a plain run's manifest stays
+            # bit-identical to pre-warm behavior
+            extra["warm_s"] = round(warm_s, 6)
+            if first_fit["t"] is not None:
+                extra["time_to_first_fit_s"] = round(first_fit["t"], 6)
+            if warm_summary is not None:
+                extra["warm_summary"] = {
+                    k: warm_summary[k]
+                    for k in ("n_programs", "wall_s",
+                              "backend_compiles", "compile_cache_hits",
+                              "compile_cache_misses")}
         if n_passes > 1:
             extra["n_passes"] = n_passes
             extra["pass_complete"] = pass_complete
@@ -1281,6 +1397,10 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
         return summary
     finally:
         if prefetcher is not None:
+            # speculative decodes never adopted by a claim (sibling
+            # took the archive, drain, quarantine): drop the buffers
+            for tkt in speculative.values():
+                prefetcher.discard(tkt, "warm_unused")
             prefetcher.stop()
         if hb is not None:
             hb.stop()

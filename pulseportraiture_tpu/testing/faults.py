@@ -35,6 +35,9 @@ site               where it fires
                    injected failure must DROP the event, never crash)
 ``barrier``        ``parallel/multihost.barrier`` (simulates a
                    straggler for the timeout path)
+``compile_cache``  ``runner/warm.enable_persistent_cache`` (persistent
+                   compile-cache enable; a failure degrades to normal
+                   first-use JIT compiles — warm is never fatal)
 =================  ====================================================
 
 Spec grammar (``PPTPU_FAULTS`` or :func:`configure`)::
@@ -112,7 +115,7 @@ __all__ = ["InjectedFault", "SITES", "check", "active", "configure",
 
 SITES = ("archive_read", "header_scan", "archive_pad", "dispatch",
          "ledger_append", "ledger_scan", "lease_renew",
-         "checkpoint_flush", "obs_write", "barrier")
+         "checkpoint_flush", "obs_write", "barrier", "compile_cache")
 
 _SIGNALS = {"sigterm": _signal.SIGTERM, "sigint": _signal.SIGINT,
             "sigkill": _signal.SIGKILL}

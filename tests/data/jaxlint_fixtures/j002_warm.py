@@ -1,0 +1,78 @@
+"""J002 fixtures: warm-core API misuse inside jit.
+
+The warm core (pulseportraiture_tpu.runner.warm, re-exported by
+service.warm) drives the jit boundary from OUTSIDE — AOT
+lower/compile into the persistent compile cache, synthetic-archive
+IO, and per-program obs events cannot exist in compiled code; under
+jit a warm_plan would fire once at trace time.  This corpus proves no
+warm entry point is reachable inside a jit trace without the linter
+firing.  docs/RUNNER.md "Warm start".
+"""
+
+import jax
+
+from pulseportraiture_tpu.runner import warm
+from pulseportraiture_tpu.runner.warm import (solver_program,
+                                              write_warm_archive)
+
+
+@jax.jit
+def bad_warm_plan_in_jit(x, plan):
+    warm.warm_plan(plan)  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_enable_cache_in_jit(x):
+    warm.enable_persistent_cache("/tmp/ppcache")  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_program_specs_in_jit(x, plan):
+    warm.program_specs(plan, workloads=("toas",))  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_spec_ctor_in_jit(x):
+    spec = warm.WarmSpec((64, 2048), 16)  # EXPECT: J002
+    return x + spec.nsub
+
+
+@jax.jit
+def bad_synth_databunch_in_jit(x, model, freqs):
+    warm.synth_databunch(model, freqs, 16)  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_solver_program_in_jit(x):
+    scan, batch = solver_program(16)  # EXPECT: J002
+    return x + batch
+
+
+@jax.jit
+def bad_write_archive_in_jit(x, spec, model):
+    write_warm_archive(spec, model, "/tmp/warm.fits")  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def ok_suppressed(x, plan):
+    warm.warm_plan(plan)  # jaxlint: disable=J002
+    return x
+
+
+def ok_host_side(plan, cache_dir):
+    # outside jit: exactly how ppsurvey --warm drives the warm core
+    warm.enable_persistent_cache(cache_dir)
+    return warm.warm_plan(plan, workloads=("toas",))
+
+
+@jax.jit
+def ok_unrelated_attr(x, registry):
+    # program_specs etc. are warm-only behind warm heads: an unrelated
+    # object's same-named attribute must not trip the rule
+    registry.program_specs(x)
+    return x

@@ -1,0 +1,351 @@
+"""Zero-cold-start warm core (ISSUE 15): runner/warm.py spec
+derivation, warm idempotency, persistent-cache degradation, and the
+``run_survey(..., warm=...)`` manifest contract.
+
+docs/RUNNER.md "Warm start" contract under test here:
+
+* ``program_specs`` enumerates one program class per plan
+  ``(bucket, native, nsub)`` for every requested workload, plus the
+  coalesced micro-batch solver programs (toas only), deduped.
+* ``warm_plan`` is idempotent — a second in-process warm reports zero
+  backend compiles — and never fatal: a failing program records its
+  error and the pass continues.
+* ``enable_persistent_cache`` degrades, never fails: a corrupt /
+  unwritable cache dir (or an injected ``compile_cache`` fault) emits
+  ``compile_cache_degraded`` and the run proceeds with first-use JIT
+  compiles.
+* A ``--warm`` run's summary/manifest gains ``warm_s`` /
+  ``time_to_first_fit_s`` / ``warm_summary``; WITHOUT ``--warm`` those
+  keys are absent (the bit-identical acceptance), and ``--warm=auto``
+  with nothing to pay for itself skips with a ``warm_skipped`` event.
+* A resumed survey in a warmed process starts fit-bound: the resume
+  run's obs manifest records zero backend compiles.
+
+The cross-process legs (two concurrent workers over one cache dir,
+zero misses post-warm, sigkill takeover) live in tools/warm_smoke.py
+(check.sh stage 14) and in the slow-marked subprocess test below.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu import obs
+from pulseportraiture_tpu.io.archive import make_fake_pulsar
+from pulseportraiture_tpu.io.gmodel import write_model
+from pulseportraiture_tpu.runner.execute import run_survey
+from pulseportraiture_tpu.runner.plan import plan_survey
+from pulseportraiture_tpu.runner.warm import (WarmSpec, WARM_WORKLOADS,
+                                              enable_persistent_cache,
+                                              program_specs,
+                                              solver_program, warm_plan)
+from pulseportraiture_tpu.testing import faults
+
+MODEL_PARAMS = np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5])
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("PPTPU_FAULTS", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def ws(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("runner_warm")
+    gm = str(tmp / "wm.gmodel")
+    write_model(gm, "wm", "000", 1500.0, MODEL_PARAMS, np.ones(8, int),
+                -4.0, 0, quiet=True)
+    par = str(tmp / "wm.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    # two (8, 128) archives + one (8, 256): two bucket classes, same
+    # nsub, kept tiny so the toas warm in this process stays cheap.
+    # nbin >= 128 keeps these program sets DISJOINT from
+    # test_service's (8, 64) corpus: that module (which sorts AFTER
+    # this one) asserts its own warm compiles fresh into a persistent
+    # cache, which this module must not pre-warm
+    files = []
+    for i, nbin in enumerate((128, 128, 256)):
+        out = str(tmp / f"wm{i}.fits")
+        make_fake_pulsar(gm, par, out, nsub=2, nchan=8, nbin=nbin,
+                         nu0=1500.0, bw=400.0, tsub=60.0,
+                         phase=0.02 * (i + 1), dDM=5e-4,
+                         noise_stds=0.01, dedispersed=False,
+                         seed=210 + i, quiet=True)
+        files.append(out)
+    return SimpleNamespace(tmp=tmp, gm=gm, par=par, files=files,
+                           plan=plan_survey(files),
+                           plan128=plan_survey(files[:2]))
+
+
+def _events(run_dir, name=None):
+    path = os.path.join(run_dir, "events.jsonl")
+    if not os.path.isfile(path):
+        return []
+    out = [json.loads(ln) for ln in open(path) if ln.strip()]
+    if name is not None:
+        out = [e for e in out if e.get("name") == name]
+    return out
+
+
+def _spec_keys(specs):
+    return {(s.bucket, s.native, s.nsub, s.workload, s.kind)
+            for s in specs}
+
+
+# -- program enumeration -----------------------------------------------
+
+def test_program_specs_toas_default(ws):
+    specs = program_specs(ws.plan)
+    assert len(specs) == 2
+    by_bucket = {s.bucket: s for s in specs}
+    assert set(by_bucket) == {(8, 128), (8, 256)}
+    assert by_bucket[(8, 128)].n_archives == 2
+    assert by_bucket[(8, 256)].n_archives == 1
+    for s in specs:
+        assert s.workload == "toas" and s.kind == "archive"
+        assert s.native == s.bucket  # power-of-two shapes bucket to self
+        assert s.nsub == 2
+        assert (s.scan_size, s.batch) == solver_program(2)
+    # a saved plan path enumerates identically
+    p = str(ws.tmp / "plan_specs.json")
+    ws.plan.save(p)
+    assert _spec_keys(program_specs(p)) == _spec_keys(specs)
+
+
+def test_program_specs_workload_matrix(ws):
+    # every plan bucket x every warm workload gets exactly one spec
+    specs = program_specs(ws.plan, workloads=WARM_WORKLOADS)
+    assert len(specs) == 2 * len(WARM_WORKLOADS)
+    buckets = {(8, 128), (8, 256)}
+    for wl in WARM_WORKLOADS:
+        got = {s.bucket for s in specs if s.workload == wl}
+        assert got == buckets, wl
+    # single non-toas workload enumerates only its own program set
+    zap = program_specs(ws.plan, workloads=("zap",))
+    assert {s.workload for s in zap} == {"zap"}
+    assert {s.bucket for s in zap} == buckets
+    # unknown workloads enumerate nothing (the warm pass skips them)
+    assert len(program_specs(ws.plan, workloads=("toas", "bogus"))) == 2
+    assert program_specs(ws.plan, workloads=("bogus",)) == []
+
+
+def test_program_specs_coalesce(ws):
+    # K=2 adds one combined-batch solver program per bucket (nsub 2->4)
+    specs = program_specs(ws.plan, coalesce=(2,))
+    co = [s for s in specs if s.kind == "coalesced"]
+    assert len(specs) == 4 and len(co) == 2
+    assert {(s.bucket, s.nsub) for s in co} == {((8, 128), 4),
+                                                ((8, 256), 4)}
+    assert all(s.workload == "toas" for s in co)
+    # duplicate multipliers dedupe; K<=1 is a no-op
+    assert len(program_specs(ws.plan, coalesce=(2, 2, 1))) == 4
+    # coalescing only applies to toas (the micro-batcher's workload)
+    assert all(s.kind == "archive"
+               for s in program_specs(ws.plan, coalesce=(2,),
+                                      workloads=("zap",)))
+
+
+def test_warmspec_to_dict(ws):
+    d = WarmSpec((8, 64), 2).to_dict()
+    scan, batch = solver_program(2)
+    assert d == {"bucket": "8x64", "native": "8x64", "nsub": 2,
+                 "n_archives": 1, "kind": "archive", "batch": batch,
+                 "scan_size": scan, "workload": "toas"}
+    # native + workload survive the round trip for workload specs
+    d = WarmSpec((8, 128), 2, native=(6, 100), workload="align").to_dict()
+    assert d["native"] == "6x100" and d["workload"] == "align"
+
+
+# -- persistent-cache degradation (faults.py compile_cache site) -------
+
+def test_enable_persistent_cache_degrades(ws, tmp_path):
+    with obs.run("warmtest", base_dir=str(tmp_path / "obs")) as rec:
+        # injected cache fault: degrade, never raise
+        faults.configure("site:compile_cache@nth=1")
+        assert enable_persistent_cache(str(tmp_path / "cache")) is False
+        assert rec.counters.get("compile_cache_degraded") == 1
+        faults.reset()
+        # unusable cache path (a file where the dir should go): same
+        bad = tmp_path / "cachefile"
+        bad.write_text("not a dir")
+        assert enable_persistent_cache(str(bad)) is False
+        assert rec.counters.get("compile_cache_degraded") == 2
+        run_dir = rec.dir
+    ev = _events(run_dir, "compile_cache_degraded")
+    assert len(ev) == 2 and all(e.get("error") for e in ev)
+
+
+# -- warm_plan ---------------------------------------------------------
+
+def test_warm_plan_zap_zero_compiles(ws, tmp_path):
+    # the zap proposal walk is pure numpy: its warm specs exist for
+    # program-set completeness and honestly record zero compiles
+    with obs.run("warmtest", base_dir=str(tmp_path / "obs")) as rec:
+        s = warm_plan(ws.plan, workloads=("zap",))
+        assert s["n_programs"] == 2
+        assert all(p["ok"] for p in s["programs"])
+        assert s["backend_compiles"] == 0
+        assert rec.counters.get("warm_programs") == 2
+        assert "warm_compiles" not in rec.counters
+        run_dir = rec.dir
+    ev = _events(run_dir, "warm_program")
+    assert len(ev) == 2
+    assert all(e["workload"] == "zap" and e["program_kind"] == "archive"
+               for e in ev)
+    assert len(_events(run_dir, "warm_done")) == 1
+
+
+def test_warm_plan_toas_idempotent(ws, tmp_path):
+    # second warm of the same plan in the same process: all programs
+    # already live in the jit caches -> zero new backend compiles (the
+    # contract a resumed daemon or survey worker relies on)
+    with obs.run("warmtest", base_dir=str(tmp_path / "obs")):
+        s1 = warm_plan(ws.plan128, ws.gm, get_toas_kw={"bary": False})
+        assert s1["n_programs"] == 1
+        assert all(p["ok"] for p in s1["programs"])
+        s2 = warm_plan(ws.plan128, ws.gm, get_toas_kw={"bary": False})
+        assert all(p["ok"] for p in s2["programs"])
+        assert s2["backend_compiles"] == 0
+        assert s2["compile_cache_misses"] == 0
+
+
+def test_warm_plan_failure_not_fatal(ws, tmp_path):
+    # a program that cannot warm (missing model) records its error and
+    # the pass continues — warm is best-effort by contract
+    with obs.run("warmtest", base_dir=str(tmp_path / "obs")):
+        s = warm_plan(ws.plan, str(ws.tmp / "no_such.gmodel"))
+    assert s["n_programs"] == 2
+    assert all(not p["ok"] and p["error"] for p in s["programs"])
+
+
+@pytest.mark.slow
+def test_warm_plan_all_workloads(ws, tmp_path):
+    with obs.run("warmtest", base_dir=str(tmp_path / "obs")):
+        s = warm_plan(ws.plan128, ws.gm, get_toas_kw={"bary": False},
+                      workloads=WARM_WORKLOADS)
+    assert s["n_programs"] == len(WARM_WORKLOADS)
+    assert all(p["ok"] for p in s["programs"]), s["programs"]
+
+
+# -- run_survey warm surface -------------------------------------------
+
+def test_run_survey_warm_manifest_and_fault_degrade(ws, tmp_path):
+    # --warm with an injected compile_cache fault: the cache degrades
+    # (never fatal), the warm pass still runs, the survey completes,
+    # and the manifest carries the warm telemetry
+    faults.configure("site:compile_cache@nth=1")
+    s = run_survey(ws.plan128, str(tmp_path / "wd"), modelfile=ws.gm,
+                   process_index=0, process_count=1, backoff_s=0.0,
+                   merge=False, warm=True,
+                   compile_cache=str(tmp_path / "cache"), bary=False)
+    assert s["counts"]["done"] == 2
+    assert s["counts"].get("failed", 0) == 0
+    assert s["warm_s"] >= 0.0
+    assert s["warm_summary"]["n_programs"] == 1
+    assert s["time_to_first_fit_s"] > 0.0
+    man = json.load(open(os.path.join(s["obs_run"], "manifest.json")))
+    assert man["counters"].get("compile_cache_degraded", 0) >= 1
+    assert man["gauges"]["warm_s"] == s["warm_s"]
+    assert man["gauges"]["time_to_first_fit_s"] \
+        == s["time_to_first_fit_s"]
+
+
+def test_run_survey_without_warm_keys_absent(ws, tmp_path):
+    # bit-identical acceptance: a plain run's summary/manifest carries
+    # no warm fields at all
+    s = run_survey(ws.plan128, str(tmp_path / "wd"), modelfile=ws.gm,
+                   process_index=0, process_count=1, backoff_s=0.0,
+                   merge=False, bary=False)
+    assert s["counts"]["done"] == 2
+    for key in ("warm_s", "time_to_first_fit_s", "warm_summary"):
+        assert key not in s
+    man = json.load(open(os.path.join(s["obs_run"], "manifest.json")))
+    assert "warm_s" not in man["gauges"]
+    assert "time_to_first_fit_s" not in man["gauges"]
+    assert not _events(s["obs_run"], "warm_program")
+    assert not _events(s["obs_run"], "warm_skipped")
+
+
+def test_run_survey_warm_auto_skips_without_payoff(ws, tmp_path):
+    # auto only warms when it can pay for itself (persistent cache or
+    # prefetch overlap); with neither it skips and says so
+    s = run_survey(ws.plan128, str(tmp_path / "wd"), modelfile=ws.gm,
+                   process_index=0, process_count=1, backoff_s=0.0,
+                   merge=False, warm="auto", prefetch=0, bary=False)
+    assert s["counts"]["done"] == 2
+    assert "warm_s" not in s
+    ev = _events(s["obs_run"], "warm_skipped")
+    assert len(ev) == 1 and ev[0]["mode"] == "auto"
+    assert not _events(s["obs_run"], "warm_program")
+
+
+def test_run_survey_resume_starts_fit_bound(ws, tmp_path):
+    # interrupted survey (max_archives=1), then a --warm resume in the
+    # same (already warm) process: the resume run's own obs manifest
+    # must record zero backend compiles — it goes straight to fitting
+    wd = str(tmp_path / "wd")
+    s1 = run_survey(ws.plan128, wd, modelfile=ws.gm, process_index=0,
+                    process_count=1, backoff_s=0.0, merge=False,
+                    max_archives=1, bary=False)
+    assert s1["counts"]["done"] == 1
+    s2 = run_survey(ws.plan128, wd, modelfile=ws.gm, process_index=0,
+                    process_count=1, backoff_s=0.0, merge=False,
+                    warm=True, bary=False)
+    assert s2["counts"]["done"] == 2
+    assert s2["warm_summary"]["backend_compiles"] == 0
+    man = json.load(open(os.path.join(s2["obs_run"], "manifest.json")))
+    assert man["counters"].get("backend_compiles", 0) == 0
+
+
+# -- cross-process warm (slow: real subproceses + cold compiles) -------
+
+def _ppsurvey(args, timeout=540):
+    return subprocess.run(
+        [sys.executable, "-m", "pulseportraiture_tpu.cli.ppsurvey"]
+        + args, cwd=REPO, capture_output=True, text=True,
+        timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PPTPU_OBS_DIR": "",
+             "PPTPU_FAULTS": ""})
+
+
+@pytest.mark.slow
+def test_concurrent_warm_one_cache_race_free(ws, tmp_path):
+    """Two concurrent ``ppsurvey warm`` processes against ONE cache dir
+    both succeed (jax's persistent cache writes atomically), and a
+    ``--warm`` run afterwards records zero cache misses."""
+    wd = str(tmp_path / "wd")
+    cache = str(tmp_path / "cache")
+    meta = str(tmp_path / "meta.txt")
+    with open(meta, "w") as f:
+        f.write("".join(p + "\n" for p in ws.files[:2]))
+    r = _ppsurvey(["plan", "-d", meta, "-m", ws.gm, "-w", wd])
+    assert r.returncode == 0, r.stderr[-2000:]
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "pulseportraiture_tpu.cli.ppsurvey",
+         "warm", "-w", wd, "-m", ws.gm, "--compile-cache", cache,
+         "--no_bary", "--quiet"], cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PPTPU_OBS_DIR": "",
+             "PPTPU_FAULTS": ""}) for _ in range(2)]
+    outs = [p.communicate(timeout=540)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    # the warmed cache makes a fresh worker process all-hit
+    r = _ppsurvey(["run", "-w", wd, "-m", ws.gm, "--compile-cache",
+                   cache, "--warm", "--no_bary", "--quiet"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    s = json.load(open(os.path.join(wd, "survey.0.json")))
+    assert s["counts"]["done"] == 2
+    ws_sum = s["warm_summary"]
+    assert ws_sum["compile_cache_misses"] == 0
+    assert ws_sum["backend_compiles"] == ws_sum["compile_cache_hits"]

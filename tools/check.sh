@@ -74,7 +74,15 @@
 #                 archive_read fault on the prefetch thread must
 #                 quarantine identically to serial
 #                 (docs/RUNNER.md "Host pipeline")
-#  14. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
+#  14. warm smoke — zero-cold-start surveys end to end: ppsurvey warm
+#                 + two concurrent ppsurvey run subprocesses sharing
+#                 one --compile-cache dir must record zero cache
+#                 misses (every backend compile a persistent-cache
+#                 deserialize) in both worker manifests and the
+#                 merged report, and an incremental re-warm of an
+#                 extended plan must compile ONLY the new bucket
+#                 (docs/RUNNER.md "Warm start")
+#  15. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
 #
 # Exit status is non-zero when any stage fails.
 set -u
@@ -217,6 +225,17 @@ if [ $? -ne 0 ]; then
     fail=1
 else
     tail -1 /tmp/_prefetch_smoke.log
+fi
+
+echo
+echo "== warm smoke (zero-cold-start compile cache, docs/RUNNER.md) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu PPTPU_OBS_DIR="" PPTPU_FAULTS="" \
+    python -m tools.warm_smoke >/tmp/_warm_smoke.log 2>&1
+if [ $? -ne 0 ]; then
+    tail -40 /tmp/_warm_smoke.log
+    fail=1
+else
+    tail -1 /tmp/_warm_smoke.log
 fi
 
 echo

@@ -152,6 +152,20 @@ _SERVICE_API_NAMES = {"TOAService", "MicroBatcher", "ServiceServer",
                       "warm_plan", "program_specs", "client_request",
                       "synth_databunch", "enable_persistent_cache"}
 
+# warm core (pulseportraiture_tpu.runner.warm, re-exported by
+# service.warm): host-side by contract — warm drives the jit boundary
+# from OUTSIDE (AOT lower/compile into the persistent cache, synthetic
+# archive IO, per-program obs events); under jit a warm call would
+# fire once at trace time and its compilation/file IO cannot exist in
+# compiled code.  The entry points shared with the service shim
+# (warm_plan, program_specs, ...) already match bare via
+# _SERVICE_API_NAMES; this set adds the ``warm.``/``runner.warm.``
+# heads plus the warm-only names, which also match bare.
+_WARM_API_NAMES = {"warm_plan", "program_specs", "synth_databunch",
+                   "enable_persistent_cache", "WarmSpec",
+                   "solver_program", "write_warm_archive"}
+_WARM_BARE_NAMES = {"solver_program", "write_warm_archive"}
+
 _JNP_PREFIXES = ("jnp.", "jax.numpy.")
 
 
@@ -570,6 +584,20 @@ class RuleVisitor(ast.NodeVisitor):
                           "run once at trace time and its buffers "
                           "cannot feed compiled code (docs/RUNNER.md "
                           "Host pipeline)")
+            elif fname is not None and (
+                    (fname.rsplit(".", 1)[-1] in _WARM_API_NAMES
+                     and fname.startswith(("warm.", "runner.warm.")))
+                    or fname in _WARM_BARE_NAMES):
+                self._add("J002", node,
+                          "warm-core call inside a jitted function — "
+                          "zero-cold-start warm drives the jit "
+                          "boundary from OUTSIDE (AOT lower/compile "
+                          "into the persistent compile cache, "
+                          "synthetic-archive IO, per-program obs "
+                          "events); under jit it would fire once at "
+                          "trace time and its compilation/file IO "
+                          "cannot exist in compiled code "
+                          "(docs/RUNNER.md Warm start)")
             elif fname is not None and (
                     (fname.startswith("service.")
                      and fname.split(".", 1)[1] in _SERVICE_API_NAMES)

@@ -377,6 +377,41 @@ def summarize_compiles(events):
     return _table(["span", "compiles", "total_s"], rows)
 
 
+def summarize_compile_cache(manifest):
+    """Persistent-compile-cache outcome row (runner/warm.py zero-cold-
+    start): hit/miss counters summed across any ``p<proc>/`` shard
+    prefixes, plus the warm/first-fit gauges when a ``--warm`` run
+    recorded them.  None when the run never touched a persistent
+    cache (pre-warm runs keep their original report)."""
+    counters = manifest.get("counters") or {}
+    hits = misses = 0
+    seen = False
+    for key, v in counters.items():
+        base = str(key).rsplit("/", 1)[-1]
+        if base == "compile_cache_hits":
+            hits += int(_num(v))
+            seen = True
+        elif base == "compile_cache_misses":
+            misses += int(_num(v))
+            seen = True
+    if not seen:
+        return None
+    total = hits + misses
+    lines = ["persistent cache: %d hit(s) / %d miss(es)%s"
+             % (hits, misses,
+                " (%.0f%% hit)" % (100.0 * hits / total)
+                if total else "")]
+    gauges = manifest.get("gauges") or {}
+    warm_rows = []
+    for key in sorted(gauges):
+        base = str(key).rsplit("/", 1)[-1]
+        if base in ("warm_s", "time_to_first_fit_s"):
+            warm_rows.append("%s=%s" % (key, _fmt_s(_num(gauges[key]))))
+    if warm_rows:
+        lines.append("warm start: " + "  ".join(warm_rows))
+    return "\n".join(lines)
+
+
 def summarize_fits(events):
     """Per-subint convergence stats aggregated over every fit event."""
     fits = [e for e in events if e.get("kind") == "fit"]
@@ -844,6 +879,11 @@ def summarize(run_dir):
         out.append("")
         out.append("## compile attribution")
         out.append(comp)
+    ccache = summarize_compile_cache(manifest)
+    if ccache:
+        out.append("")
+        out.append("## compile cache (persistent)")
+        out.append(ccache)
     fits = summarize_fits(events)
     if fits:
         out.append("")

@@ -1,0 +1,249 @@
+"""Warm smoke gate: zero-cold-start surveys end to end (wired into
+tools/check.sh).
+
+Leg A — cross-process cache reuse.  Plan a tiny two-bucket survey,
+warm it through the real ``ppsurvey warm`` CLI (a subprocess) against
+a fresh shared ``--compile-cache`` dir, then run the SAME plan as two
+concurrent real ``ppsurvey run`` subprocesses (``--process 0/1
+--processes 2 --warm``) sharing that cache.  In jax every backend
+compile with a persistent cache configured is preceded by exactly one
+cache-hit or cache-miss event (obs/monitor.py), so the zero-cold-start
+contract is: both worker manifests record ``compile_cache_misses == 0``
+and ``backend_compiles == compile_cache_hits`` — every program
+deserialized, nothing XLA-compiled post-warm.  The merged manifest and
+``tools/obs_report``'s "compile cache (persistent)" section must agree,
+and both workers must carry the ``warm_s`` / ``time_to_first_fit_s``
+gauges.
+
+Leg B — incremental warm.  Extend the survey with a NEW shape bucket
+and re-warm against the same cache: the ``warm_program`` events must
+record zero misses for the two already-warm buckets while the new
+bucket's misses account for every miss in the pass — warm is
+incremental, not a recompile of the world.
+
+Run:  env JAX_PLATFORMS=cpu python -m tools.warm_smoke
+"""
+
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SUBPROC_TIMEOUT = 540
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PPTPU_OBS_DIR"] = ""
+    env["PPTPU_FAULTS"] = ""
+    env.pop("PPTPU_COMPILE_CACHE_DIR", None)
+    return env
+
+
+def _ppsurvey(args):
+    """Run one ppsurvey CLI subprocess; returns its stdout-JSON."""
+    cmd = [sys.executable, "-m", "pulseportraiture_tpu.cli.ppsurvey"]
+    res = subprocess.run(cmd + args, cwd=REPO, env=_env(),
+                         capture_output=True, text=True,
+                         timeout=SUBPROC_TIMEOUT)
+    assert res.returncode == 0, \
+        "ppsurvey %s rc=%d\nstdout: %s\nstderr: %s" \
+        % (args[0], res.returncode, res.stdout[-2000:],
+           res.stderr[-2000:])
+    return json.loads(res.stdout.strip().splitlines()[-1])
+
+
+def _build_inputs(workroot):
+    from pulseportraiture_tpu.io.archive import make_fake_pulsar
+    from pulseportraiture_tpu.io.gmodel import write_model
+
+    gm = os.path.join(workroot, "smoke.gmodel")
+    write_model(gm, "smoke", "000", 1500.0,
+                np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5]),
+                np.ones(8, int), -4.0, 0, quiet=True)
+    par = os.path.join(workroot, "smoke.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    files = []
+    # two shape buckets, two archives each (so a 2-process run fits at
+    # least one archive per process), plus the leg-B new-bucket archive
+    for i, (nchan, nbin) in enumerate([(8, 64), (8, 64),
+                                       (8, 128), (8, 128),
+                                       (8, 256)]):
+        fits = os.path.join(workroot, "good%d.fits" % i)
+        make_fake_pulsar(gm, par, fits, nsub=2, nchan=nchan, nbin=nbin,
+                         nu0=1500.0, bw=800.0, tsub=60.0,
+                         phase=0.05 + 0.01 * i, dDM=5e-4,
+                         noise_stds=0.01, dedispersed=False,
+                         seed=11 + i, quiet=True)
+        files.append(fits)
+    return gm, files
+
+
+def _write_meta(workroot, name, files):
+    meta = os.path.join(workroot, name)
+    with open(meta, "w") as f:
+        f.write("\n".join(files) + "\n")
+    return meta
+
+
+def _manifests(workdir, name):
+    """Manifests of the obs runs named ``name`` under workdir/obs."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(workdir, "obs", "*",
+                                              "manifest.json"))):
+        with open(path, encoding="utf-8") as fh:
+            m = json.load(fh)
+        if m.get("name") == name:
+            out.append(m)
+    return out
+
+
+def _warm_events(workdir):
+    """warm_program events of the (single) ppsurvey-warm obs run."""
+    runs = [os.path.dirname(p) for p in
+            glob.glob(os.path.join(workdir, "obs", "*",
+                                   "manifest.json"))]
+    from tools.obs_report import load_run
+
+    progs = []
+    for run_dir in runs:
+        manifest, events = load_run(run_dir)
+        if manifest.get("name") != "ppsurvey-warm":
+            continue
+        progs.extend(e for e in events
+                     if e.get("name") == "warm_program")
+    return progs
+
+
+def _assert_all_hits(tag, counters):
+    hits = int(counters.get("compile_cache_hits", 0))
+    misses = int(counters.get("compile_cache_misses", 0))
+    compiles = int(counters.get("backend_compiles", 0))
+    assert misses == 0, \
+        "%s: %d post-warm cache miss(es) (cold XLA compiles)" \
+        % (tag, misses)
+    assert hits > 0, "%s: no persistent-cache hits recorded" % tag
+    assert compiles == hits, \
+        "%s: %d backend compile(s) bypassed the persistent cache " \
+        "(hits %d)" % (tag, compiles - hits, hits)
+    return hits
+
+
+def main():
+    workroot = tempfile.mkdtemp(prefix="pptpu_warm_smoke_")
+    os.environ.pop("PPTPU_FAULTS", None)
+    try:
+        gm, files = _build_inputs(workroot)
+        cache = os.path.join(workroot, "ppcache")
+
+        # ---- leg A: warm once, run twice concurrently, zero cold
+        # compiles in either worker
+        wd1 = os.path.join(workroot, "wd_a")
+        meta1 = _write_meta(workroot, "a.meta", files[:4])
+        planned = _ppsurvey(["plan", "-d", meta1, "-m", gm, "-w", wd1])
+        assert planned["n_buckets"] == 2, planned
+
+        warmed = _ppsurvey(["warm", "-w", wd1, "-m", gm,
+                            "--compile-cache", cache,
+                            "--no_bary", "--quiet"])
+        assert warmed["n_programs"] == 2, warmed
+        assert warmed["compile_cache_misses"] > 0, \
+            "cold warm populated nothing: %s" % warmed
+
+        run_args = ["-w", wd1, "--processes", "2",
+                    "--compile-cache", cache, "--warm",
+                    "--no_bary", "--quiet"]
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "pulseportraiture_tpu.cli.ppsurvey",
+             "run", "--process", str(i)] + run_args,
+            cwd=REPO, env=_env(), stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True) for i in (0, 1)]
+        outs = []
+        for i, proc in enumerate(procs):
+            out, err = proc.communicate(timeout=SUBPROC_TIMEOUT)
+            assert proc.returncode == 0, \
+                "run --process %d rc=%d\nstdout: %s\nstderr: %s" \
+                % (i, proc.returncode, out[-2000:], err[-2000:])
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+        # counts are the union-ledger view: every worker must see the
+        # whole survey complete
+        for o in outs:
+            assert o["counts"].get("done") == 4 \
+                and not o["counts"].get("failed") \
+                and not o["counts"].get("quarantined"), outs
+
+        manifests = _manifests(wd1, "ppsurvey")
+        assert len(manifests) == 2, \
+            "expected 2 worker obs runs, found %d" % len(manifests)
+        hits = 0
+        for m in manifests:
+            pid = (m.get("config") or {}).get("process")
+            hits += _assert_all_hits("worker p%s" % pid,
+                                     m.get("counters") or {})
+            gauges = m.get("gauges") or {}
+            assert "warm_s" in gauges, (pid, sorted(gauges))
+            assert "time_to_first_fit_s" in gauges, (pid,
+                                                     sorted(gauges))
+
+        # re-merge now that both shards exist (simulated-process runs
+        # skip the pre-merge barrier, so p0's in-run merge may predate
+        # p1's shard), and check the report renders the
+        # persistent-cache section from the summed counters
+        res = subprocess.run(
+            [sys.executable, "-m",
+             "pulseportraiture_tpu.cli.ppsurvey", "report", "-w", wd1],
+            cwd=REPO, env=_env(), capture_output=True, text=True,
+            timeout=SUBPROC_TIMEOUT)
+        assert res.returncode == 0, res.stderr[-2000:]
+        with open(os.path.join(wd1, "obs_merged", "manifest.json"),
+                  encoding="utf-8") as fh:
+            merged = json.load(fh)
+        mhits = _assert_all_hits("merged", merged.get("counters") or {})
+        assert mhits == hits, (mhits, hits)
+        assert "compile cache (persistent)" in res.stdout, \
+            res.stdout[-2000:]
+        assert "0 miss(es)" in res.stdout, res.stdout[-2000:]
+
+        # ---- leg B: a NEW bucket against the same cache — only the
+        # new bucket's programs miss (warm is incremental)
+        wd2 = os.path.join(workroot, "wd_b")
+        meta2 = _write_meta(workroot, "b.meta", files)
+        planned2 = _ppsurvey(["plan", "-d", meta2, "-m", gm,
+                              "-w", wd2])
+        assert planned2["n_buckets"] == 3, planned2
+        warmed2 = _ppsurvey(["warm", "-w", wd2, "-m", gm,
+                             "--compile-cache", cache,
+                             "--no_bary", "--quiet"])
+        assert warmed2["n_programs"] == 3, warmed2
+
+        progs = {p["bucket"]: p for p in _warm_events(wd2)}
+        assert set(progs) == {"8x64", "8x128", "8x256"}, sorted(progs)
+        for bucket in ("8x64", "8x128"):
+            assert progs[bucket]["compile_cache_misses"] == 0, \
+                "already-warm bucket %s recompiled: %s" \
+                % (bucket, progs[bucket])
+        new_misses = progs["8x256"]["compile_cache_misses"]
+        assert new_misses > 0, progs["8x256"]
+        assert warmed2["compile_cache_misses"] == new_misses, \
+            (warmed2, progs["8x256"])
+
+        print("warm smoke OK: 2-process post-warm run all-hit "
+              "(%d deserialized, 0 misses), incremental re-warm "
+              "compiled only the new bucket (%d miss(es) @ 8x256)"
+              % (hits, new_misses))
+        return 0
+    finally:
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
