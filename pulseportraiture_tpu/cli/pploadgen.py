@@ -165,13 +165,14 @@ def run_load(socket_path, requests, mode="closed", rate=1.0,
     if mode == "open":
         sched = arrival_schedule(len(results), rate, seed)
         threads = []
-        for res, due in zip(results, sched):
+        for i, (res, due) in enumerate(zip(results, sched)):
             wait = t_start + due - time.perf_counter()
             if wait > 0:
                 time.sleep(wait)
             th = threading.Thread(target=_submit_one,
                                   args=(socket_path, res, timeout),
-                                  daemon=True)
+                                  daemon=True,
+                                  name="pploadgen-open-%d" % i)
             th.start()
             threads.append(th)
         for th in threads:
@@ -194,8 +195,9 @@ def run_load(socket_path, requests, mode="closed", rate=1.0,
                              res.latency_s or -1.0,
                              res.state), file=sys.stderr)
 
-        threads = [threading.Thread(target=worker, daemon=True)
-                   for _ in range(max(1, int(concurrency)))]
+        threads = [threading.Thread(target=worker, daemon=True,
+                                    name="pploadgen-closed-%d" % i)
+                   for i in range(max(1, int(concurrency)))]
         for th in threads:
             th.start()
         for th in threads:

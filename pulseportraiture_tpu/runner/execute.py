@@ -255,7 +255,8 @@ class _LeaseHeartbeat:
         self._keys = set()
         self._lock = threading.Lock()
         self._stop = threading.Event()
-        self._t = threading.Thread(target=self._run, daemon=True,
+        # the heartbeat renews MANY archives' leases; no one trace to adopt (jaxlint J008)
+        self._t = threading.Thread(target=self._run, daemon=True,  # jaxlint: disable=J008
                                    name="pptpu-lease-heartbeat")
         self._t.start()
 

@@ -351,8 +351,10 @@ class WorkQueue:
                     self._order.append(key)
                 self.entries[key] = rec
                 self.all_entries[(self.workload, key)] = rec
-            self._fh.write(json.dumps(rec) + "\n")
-            self._fh.flush()
+            # the ledger append IS _iolock's critical section (docs/RUNNER.md) (jaxlint J006)
+            self._fh.write(json.dumps(rec) + "\n")  # jaxlint: disable=J006
+            # flushed before the lease becomes visible to peers (jaxlint J006)
+            self._fh.flush()  # jaxlint: disable=J006
         return rec
 
     def _recover(self):

@@ -59,7 +59,8 @@ from ..utils.mjd import MJD
 from .plan import SurveyPlan
 
 __all__ = ["WarmSpec", "program_specs", "warm_plan",
-           "enable_persistent_cache", "synth_databunch"]
+           "enable_persistent_cache", "synth_databunch",
+           "solver_program", "write_warm_archive"]
 
 #: workloads the warm pass knows how to prime (runner/workloads.py
 #: names); anything else enumerates no specs

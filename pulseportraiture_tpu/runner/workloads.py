@@ -38,6 +38,11 @@ workload.  Ledger records carry ``workload`` (runner/queue.py); old
 ledgers without the field replay as ``toas``.
 """
 
+# every checkpoint open/write/readline below happens under _ckpt_lock
+# BY DESIGN: the per-path lock exists to serialize exactly that IO
+# (atomic append / read-rewrite), mirroring pipelines/toas.py
+# jaxlint: disable-file=J006
+
 import hashlib
 import json
 import os

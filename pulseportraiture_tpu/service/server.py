@@ -90,7 +90,9 @@ class ServiceServer:
             except OSError:
                 break
             threading.Thread(target=self._handle, args=(conn,),
-                             daemon=True).start()
+                             daemon=True,
+                             name="ppserve-conn-%d" % conn.fileno(),
+                             ).start()
 
     def _handle(self, conn):
         try:

@@ -1,0 +1,82 @@
+"""J006 fixture: blocking calls while a lock is held.
+
+Everything inside a ``with <lock>:`` body that can block — sleeps,
+subprocess, file/socket IO, thread joins, unbounded waits, chaos fault
+sites — stalls every sibling of the lock.  The Condition idiom
+(``cond.wait`` releases the lock) and bounded waits are exempt.
+"""
+
+import queue
+import subprocess
+import threading
+import time
+
+from pulseportraiture_tpu.testing import faults
+
+_lock = threading.Lock()
+_cond = threading.Condition(_lock)
+_jobs = queue.Queue()
+
+
+def bad_sleep_under_lock():
+    with _lock:
+        time.sleep(0.1)  # EXPECT: J006
+
+
+def bad_subprocess_under_lock():
+    with _lock:
+        subprocess.run(["true"])  # EXPECT: J006
+
+
+def bad_file_io_under_lock(path):
+    with _lock:
+        fh = open(path, "a")  # EXPECT: J006
+        fh.write("x\n")  # EXPECT: J006
+        fh.close()
+
+
+def bad_join_under_lock(worker_t):
+    with _lock:
+        worker_t.join()  # EXPECT: J006
+
+
+def bad_queue_get_under_lock():
+    with _lock:
+        return _jobs.get()  # EXPECT: J006
+
+
+def bad_unbounded_wait_under_lock(done_event):
+    with _lock:
+        done_event.wait()  # EXPECT: J006
+
+
+def bad_fault_site_under_lock():
+    with _lock:
+        faults.check("obs_write")  # EXPECT: J006
+
+
+def ok_sleep_outside_lock():
+    with _lock:
+        n = 1
+    time.sleep(0.01)
+    return n
+
+
+def ok_cond_wait_releases(timeout_s):
+    with _cond:
+        _cond.wait(timeout=timeout_s)
+
+
+def ok_bounded_wait_under_lock(done_event):
+    with _lock:
+        done_event.wait(timeout=1.0)
+
+
+def ok_queue_get_with_timeout():
+    with _lock:
+        return _jobs.get(timeout=0.5)
+
+
+def ok_suppressed(path):
+    with _lock:
+        open(path, "a").close()  # jaxlint: disable=J006

@@ -1,0 +1,63 @@
+"""J007 fixture: lock-acquisition-order cycles.
+
+Two code paths taking the same pair of locks in opposite orders is a
+deadlock candidate; so is re-acquiring a non-reentrant lock through a
+call chain (a self-loop in the lock graph).  A globally consistent
+order is clean.
+"""
+
+import threading
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+_lock_solo = threading.Lock()
+_lock_x = threading.Lock()
+_lock_y = threading.Lock()
+_lock_p = threading.Lock()
+_lock_q = threading.Lock()
+
+
+def bad_order_ab():
+    with _lock_a:
+        with _lock_b:  # EXPECT: J007
+            pass
+
+
+def bad_order_ba():
+    with _lock_b:
+        with _lock_a:  # EXPECT: J007
+            pass
+
+
+def _grab_solo():
+    with _lock_solo:
+        return 1
+
+
+def bad_reenter_via_call():
+    with _lock_solo:
+        return _grab_solo()  # EXPECT: J007
+
+
+def ok_consistent_order():
+    with _lock_x:
+        with _lock_y:
+            pass
+
+
+def ok_consistent_order_again():
+    with _lock_x:
+        with _lock_y:
+            pass
+
+
+def ok_suppressed_pq():
+    with _lock_p:
+        with _lock_q:  # jaxlint: disable=J007
+            pass
+
+
+def ok_suppressed_qp():
+    with _lock_q:
+        with _lock_p:  # jaxlint: disable=J007
+            pass

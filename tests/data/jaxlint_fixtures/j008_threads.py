@@ -1,0 +1,50 @@
+"""J008 fixture: thread-creation hygiene.
+
+Threads must be daemon=True (a non-daemon thread wedged in native code
+aborts interpreter teardown), must carry a name (obs forensics and the
+watchdog identify threads by name), and a target that emits telemetry
+must adopt trace context or its spans are trace-orphaned.
+"""
+
+import threading
+
+from pulseportraiture_tpu import obs
+from pulseportraiture_tpu.obs import tracing
+
+
+def _plain_target():
+    return None
+
+
+def _emitting_target():
+    obs.event("tick")
+
+
+def _adopting_target(ctx):
+    with tracing.activate(ctx):
+        obs.event("tick")
+
+
+def bad_non_daemon():
+    return threading.Thread(target=_plain_target, name="fx-nd")  # EXPECT: J008
+
+
+def bad_daemon_false():
+    return threading.Thread(target=_plain_target, daemon=False, name="fx-df")  # EXPECT: J008
+
+
+def bad_unnamed():
+    return threading.Thread(target=_plain_target, daemon=True)  # EXPECT: J008
+
+
+def bad_orphan_telemetry():
+    return threading.Thread(target=_emitting_target, daemon=True, name="fx-emit")  # EXPECT: J008
+
+
+def ok_thread():
+    return threading.Thread(target=_adopting_target, args=(None,),
+                            daemon=True, name="fx-ok")
+
+
+def ok_suppressed():
+    return threading.Thread(target=_plain_target)  # jaxlint: disable=J008

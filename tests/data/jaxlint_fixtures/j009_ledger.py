@@ -1,0 +1,40 @@
+"""J009 fixture: ledger writes outside the WorkQueue append API.
+
+Ledger mutations must go through runner/queue.py (single-writer,
+fsync'd, torn-tail tolerant appends); a raw write/append-mode open of
+anything ledger-ish anywhere else forks the protocol.  Read-mode opens
+(audit tooling, tests) are fine.
+"""
+
+import json
+import os
+
+
+def bad_raw_ledger_append(workdir, rec):
+    ledger_path = os.path.join(workdir, "ledger.jsonl")
+    with open(ledger_path, "a") as fh:  # EXPECT: J009
+        fh.write(json.dumps(rec) + "\n")
+
+
+def bad_inline_ledger_write(workdir):
+    with open(os.path.join(workdir, "survey.ledger"), "w") as fh:  # EXPECT: J009
+        fh.write("{}\n")
+
+
+def bad_pathlib_ledger_open(ledger_file):
+    return ledger_file.open("a")  # EXPECT: J009
+
+
+def ok_read_ledger(ledger_path):
+    with open(ledger_path) as fh:
+        return fh.read()
+
+
+def ok_other_file(workdir):
+    with open(os.path.join(workdir, "notes.txt"), "a") as fh:
+        fh.write("x\n")
+
+
+def ok_suppressed(ledger_path):
+    with open(ledger_path, "a") as fh:  # jaxlint: disable=J009
+        fh.write("")

@@ -1,0 +1,65 @@
+"""J010 fixture: unguarded telemetry emission on thread-target paths.
+
+A background thread that bypasses the sanctioned never-fatal wrappers
+(obs.*/metrics.*) — emitting directly on a recorder/registry object or
+opening a sink file — outside try/except dies on a full disk, and a
+dead worker thread is a correctness event.
+"""
+
+import threading
+
+from pulseportraiture_tpu import obs
+from pulseportraiture_tpu.obs import tracing
+
+
+class _Worker:
+    def __init__(self, recorder):
+        self._recorder = recorder
+        self._t = threading.Thread(target=self._loop, daemon=True,
+                                   name="fx-j010")
+
+    def _loop(self):
+        self._recorder.emit("tick")  # EXPECT: J010
+
+
+class _GuardedWorker:
+    def __init__(self, recorder):
+        self._recorder = recorder
+        self._t = threading.Thread(target=self._loop_guarded,
+                                   daemon=True, name="fx-j010-ok")
+
+    def _loop_guarded(self):
+        try:
+            self._recorder.emit("tick")
+        except Exception:
+            pass
+
+
+def _sink_writer(path):
+    with open(path, "a") as fh:  # EXPECT: J010
+        fh.write("x")
+
+
+def start_sink(path):
+    return threading.Thread(target=_sink_writer, args=(path,),
+                            daemon=True, name="fx-sink")
+
+
+def _wrapped_emitter(ctx):
+    with tracing.activate(ctx):
+        obs.counter("ticks")
+
+
+def start_wrapped(ctx):
+    return threading.Thread(target=_wrapped_emitter, args=(ctx,),
+                            daemon=True, name="fx-wrap")
+
+
+class _Quiet:
+    def __init__(self, registry):
+        self._registry = registry
+        self._t = threading.Thread(target=self._pump, daemon=True,
+                                   name="fx-quiet")
+
+    def _pump(self):
+        self._registry.inc("n")  # jaxlint: disable=J010

@@ -1,0 +1,20 @@
+"""JP01 fixture: malformed pragmas are findings, not silent no-ops.
+
+A suppression the engine silently ignored would be obeyed by the
+author and by nothing else — an unknown rule id or a comment that
+intends to be a pragma but does not parse must surface.
+"""
+
+
+def bad_unknown_rule():
+    x = 1  # jaxlint: disable=J999  # EXPECT: JP01
+    return x
+
+
+def bad_malformed_verb():
+    y = 2  # jaxlint: disabled J002  # EXPECT: JP01
+    return y
+
+
+def ok_valid_multi(z):
+    return float(z)  # jaxlint: disable=J002, J006

@@ -87,3 +87,134 @@ def test_finding_render_is_clickable():
     findings, _ = lint_file(FIXTURES / "j005_config.py")
     line = findings[0].render()
     assert re.match(r".+\.py:\d+:\d+: J005 ", line)
+
+
+# -- engine degradation: broken inputs are findings, never tracebacks --
+
+def test_empty_file_lints_clean(tmp_path):
+    empty = tmp_path / "empty.py"
+    empty.write_text("")
+    findings, nsup = lint_file(empty)
+    assert findings == [] and nsup == 0
+
+
+def test_torn_file_is_single_j000(tmp_path):
+    # a torn/partially-written file (NUL bytes) must degrade to one
+    # diagnostic, not an ast traceback
+    torn = tmp_path / "torn.py"
+    torn.write_bytes(b"def ok():\n    return 1\n\x00\x00\x00")
+    findings, _ = lint_file(torn)
+    assert len(findings) == 1 and findings[0].rule == "J000"
+
+
+def test_syntax_error_file_is_single_j000(tmp_path):
+    broken = tmp_path / "broken.py"
+    broken.write_text("def broken(:\n    pass\n")
+    findings, _ = lint_file(broken)
+    assert len(findings) == 1 and findings[0].rule == "J000"
+    assert "syntax" in findings[0].message.lower()
+
+
+def test_unreadable_file_is_single_j000(tmp_path):
+    findings, _ = lint_file(tmp_path / "no_such_file.py")
+    assert len(findings) == 1 and findings[0].rule == "J000"
+    assert "unreadable" in findings[0].message
+
+
+def test_undecodable_file_is_single_j000(tmp_path):
+    latin = tmp_path / "latin.py"
+    latin.write_bytes(b"# caf\xe9\nx = 1\n")
+    findings, _ = lint_file(latin)
+    assert len(findings) == 1 and findings[0].rule == "J000"
+
+
+# -- pragma parsing: comma lists work, malformed pragmas surface ------
+
+def test_comma_separated_pragma_with_whitespace_suppresses_all():
+    src = ("import jax\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    return float(x)  # jaxlint: disable=J002 , J006\n")
+    findings, nsup = lint_source(src, "t.py")
+    assert findings == [] and nsup == 1
+
+
+def test_malformed_pragma_is_a_finding_not_a_silent_noop():
+    src = ("import jax\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    return float(x)  # jaxlint: disabled J002\n")
+    findings, _ = lint_source(src, "t.py")
+    rules = {f.rule for f in findings}
+    # the bad pragma surfaces AND the violation it meant to hide fires
+    assert rules == {"JP01", "J002"}
+
+
+def test_unknown_rule_id_flagged_but_known_ids_still_apply():
+    src = ("import jax\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    return float(x)  # jaxlint: disable=J999,J002\n")
+    findings, nsup = lint_source(src, "t.py")
+    assert {f.rule for f in findings} == {"JP01"}
+    assert nsup == 1  # J002 was still suppressed by the valid id
+
+
+# -- auto-derived J002 inventory --------------------------------------
+
+def test_inventory_is_cached_and_covers_scanned_packages():
+    from tools.jaxlint.inventory import host_inventory
+    inv = host_inventory()
+    assert host_inventory() is inv  # per-process cache
+    # spot checks across the scanned families
+    assert inv.match_dotted("obs.event")
+    assert inv.match_dotted("faults.check")[-1] == "faults"
+    assert inv.match_bare("load_archive_data") == "prefetch"
+    assert inv.match_dotted("jnp.sum") is None
+    assert inv.match_bare("float") is None
+
+
+def test_inventory_tracks_new_public_api(tmp_path):
+    # the point of auto-derivation: a public def in a scanned package
+    # is flagged inside jit without anyone editing a hand list
+    src = ("import jax\n"
+           "from pulseportraiture_tpu.obs import metrics\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    metrics.set_gauge('pps_queue_depth', 1.0)\n"
+           "    return x\n")
+    findings, _ = lint_source(src, "t.py")
+    assert any(f.rule == "J002" for f in findings)
+
+
+# -- whole-program J007: cycles invisible to per-file linting ---------
+
+def test_cross_file_lock_cycle_found_by_lint_paths(tmp_path):
+    from tools.jaxlint import lint_paths
+    mod_a = tmp_path / "mod_a.py"
+    mod_b = tmp_path / "mod_b.py"
+    mod_a.write_text(
+        "import threading\n"
+        "_alpha_lock = threading.Lock()\n\n\n"
+        "def hold_alpha_then_beta():\n"
+        "    with _alpha_lock:\n"
+        "        take_beta_briefly()\n\n\n"
+        "def retake_alpha():\n"
+        "    with _alpha_lock:\n"
+        "        pass\n")
+    mod_b.write_text(
+        "import threading\n"
+        "_beta_lock = threading.Lock()\n\n\n"
+        "def take_beta_briefly():\n"
+        "    with _beta_lock:\n"
+        "        pass\n\n\n"
+        "def hold_beta_then_alpha():\n"
+        "    with _beta_lock:\n"
+        "        retake_alpha()\n")
+    # each file alone is cycle-free
+    for mod in (mod_a, mod_b):
+        findings, _ = lint_file(mod)
+        assert not [f for f in findings if f.rule == "J007"], mod
+    # the whole-program graph sees alpha -> beta -> alpha
+    findings, _, _ = lint_paths([tmp_path])
+    assert any(f.rule == "J007" for f in findings)

@@ -44,3 +44,42 @@ def test_cli_exit_nonzero_on_seeded_violations():
         cwd=REPO, capture_output=True, text=True)
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "J003" in proc.stdout
+
+
+# -- cross-artifact drift gate ----------------------------------------
+
+def test_drift_gate_clean():
+    from tools.jaxlint.drift import check_drift
+    problems = check_drift(repo_root=REPO)
+    assert problems == [], "artifact drift:\n%s" % "\n".join(problems)
+
+
+def test_seeded_drift_fails(tmp_path):
+    # the self-test: delete one SITES entry from a scratch copy of
+    # faults.py and the checker must call out every broken linkage
+    from tools.jaxlint.drift import check_drift
+    faults_py = REPO / "pulseportraiture_tpu" / "testing" / "faults.py"
+    src = faults_py.read_text()
+    assert '"barrier", ' in src
+    seeded = tmp_path / "faults_seeded.py"
+    seeded.write_text(src.replace('"barrier", ', "", 1))
+    problems = check_drift(repo_root=REPO, faults_file=seeded)
+    assert problems, "seeded drift went undetected"
+    assert any("barrier" in p for p in problems)
+
+
+def test_cli_drift_exit_codes(tmp_path):
+    ok = subprocess.run(
+        [sys.executable, "-m", "tools.jaxlint", "--drift"],
+        cwd=REPO, capture_output=True, text=True)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    faults_py = REPO / "pulseportraiture_tpu" / "testing" / "faults.py"
+    seeded = tmp_path / "faults_seeded.py"
+    seeded.write_text(faults_py.read_text().replace(
+        '"barrier", ', "", 1))
+    bad = subprocess.run(
+        [sys.executable, "-m", "tools.jaxlint", "--drift",
+         "--faults-file", str(seeded)],
+        cwd=REPO, capture_output=True, text=True)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "barrier" in bad.stdout

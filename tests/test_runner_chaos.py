@@ -388,3 +388,48 @@ def test_watchdog_off_by_default(survey, tmp_path):
     assert summary["counts"]["done"] == 1
     assert not [e for e in _obs_events(summary["obs_run"])
                 if e.get("name") == "watchdog_fired"]
+
+
+def test_header_scan_fault_quarantines_at_plan_time(survey, tmp_path):
+    """Acceptance (site:header_scan): a fault in the plan-time header
+    scan lands the archive on the plan's unreadable list with the
+    fault as the reason, and the survey quarantines it up front —
+    the remaining archives fit normally."""
+    faults.configure("site:header_scan@nth=2")
+    plan = plan_survey(survey.files, modelfile=survey.gm)
+    faults.reset()
+    assert plan.n_archives == 2
+    assert [p for p, _ in plan.unreadable] == [survey.files[1]]
+    assert "header_scan" in plan.unreadable[0][1]
+    wd = str(tmp_path / "wd")
+    s = run_survey(plan, wd, process_index=0, process_count=1,
+                   bary=False, backoff_s=0.0, merge=False)
+    assert s["counts"]["done"] == 2
+    quar = {r["archive"]: r["reason"] for r in _ledger(wd)
+            if r["state"] == "quarantined"}
+    key = WorkQueue.key_for(survey.files[1])
+    assert set(quar) == {key}
+    assert "unreadable at plan time" in quar[key]
+
+
+def test_archive_pad_fault_quarantines_after_retries(survey, tmp_path):
+    """Acceptance (site:archive_pad): a fault firing inside bucket
+    padding travels the fit loop's fault-isolation path — the load
+    fails each attempt, retries exhaust, the archive quarantines —
+    while the untargeted archives fit normally."""
+    bad = survey.files[2]
+    spec = "site:archive_pad@0.5,seed=%d" % _seed_firing_only(
+        survey.files, bad, site="archive_pad")
+    faults.configure(spec)
+    plan = plan_survey(survey.files, modelfile=survey.gm)
+    wd = str(tmp_path / "wd")
+    s = run_survey(plan, wd, process_index=0, process_count=1,
+                   bary=False, backoff_s=0.0, max_attempts=2,
+                   merge=False)
+    faults.reset()
+    assert s["counts"]["done"] == 2 and s["counts"]["quarantined"] == 1
+    quar = {r["archive"]: r["reason"] for r in _ledger(wd)
+            if r["state"] == "quarantined"}
+    key = WorkQueue.key_for(bad)
+    assert set(quar) == {key}
+    assert "retries exhausted" in quar[key]

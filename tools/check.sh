@@ -1,7 +1,13 @@
 #!/usr/bin/env bash
 # One-command verification gate (see docs/LINTING.md):
 #
-#   1. jaxlint  — repo-native JAX/TPU static analysis (J001-J005)
+#   1. pplint   — repo-native static analysis (python -m tools.jaxlint):
+#                 jit purity J001-J005, concurrency J006-J008, protocol
+#                 J009-J010, pragma hygiene JP01
+#   1b. drift   — cross-artifact drift checker (fault sites / metrics /
+#                 obs events vs docs + chaos coverage), plus a
+#                 seeded-drift self-test: a scratch faults.py with one
+#                 SITES entry deleted MUST fail the gate
 #   2. ruff     — generic python lint (skipped when not installed;
 #                 configuration lives in pyproject.toml [tool.ruff])
 #   3. obs smoke — tiny synthetic pptoas run must emit a valid
@@ -84,13 +90,26 @@
 #                 (docs/RUNNER.md "Warm start")
 #  15. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
 #
+# Usage: tools/check.sh [--lint-only]
+#   --lint-only   run only the static stages (pplint + ruff + drift +
+#                 seeded-drift self-test) — the seconds-fast pre-commit
+#                 path; no pytest, no smokes
+#
 # Exit status is non-zero when any stage fails.
 set -u
 cd "$(dirname "$0")/.."
 
+lint_only=0
+for arg in "$@"; do
+    case "$arg" in
+        --lint-only) lint_only=1 ;;
+        *) echo "usage: tools/check.sh [--lint-only]" >&2; exit 2 ;;
+    esac
+done
+
 fail=0
 
-echo "== jaxlint (python -m tools.jaxlint) =="
+echo "== pplint (python -m tools.jaxlint, J001-J010 + JP01) =="
 python -m tools.jaxlint pulseportraiture_tpu tools || fail=1
 
 echo
@@ -99,6 +118,27 @@ if command -v ruff >/dev/null 2>&1; then
     ruff check . || fail=1
 else
     echo "ruff not installed — skipped (pip install ruff to enable)"
+fi
+
+echo
+echo "== drift (python -m tools.jaxlint --drift, docs/LINTING.md) =="
+python -m tools.jaxlint --drift || fail=1
+
+echo
+echo "== seeded-drift self-test (a broken faults.py MUST fail) =="
+seeded=$(mktemp /tmp/_faults_seeded.XXXXXX.py)
+sed 's/"barrier", //' pulseportraiture_tpu/testing/faults.py > "$seeded"
+if python -m tools.jaxlint --drift --faults-file "$seeded" \
+        >/tmp/_drift_seed.log 2>&1; then
+    echo "seeded drift (SITES entry deleted) was NOT detected"
+    fail=1
+else
+    echo "seeded drift detected (exit nonzero) — checker is live"
+fi
+rm -f "$seeded"
+
+if [ "$lint_only" -eq 1 ]; then
+    exit $fail
 fi
 
 echo

@@ -1,29 +1,41 @@
-"""jaxlint: repo-native static analysis for the JAX/TPU timing stack.
+"""jaxlint / pplint: repo-native static analysis for the timing stack.
 
-Five AST rules encode the invariants the kernels in this repo depend on
-(see docs/LINTING.md for the full catalogue and rationale):
+Grown from a jit-purity linter into the repo's invariant checker (see
+docs/LINTING.md for the full catalogue, rationale and blind spots):
 
 * J001 — Python ``for``/``while`` loop over an array axis inside a
   ``@jax.jit``-decorated function (unrolls at trace time; use
   ``lax.scan``/``vmap``/``fori_loop``).
-* J002 — host-sync call (``float()``, ``int()``, ``.item()``,
-  ``.tolist()``, ``np.asarray``) on a traced value inside a jitted
-  function.
-* J003 — dtype-less array constructor (``jnp.zeros``/``arange``/
-  ``linspace``/float-literal ``asarray`` ...) in the ``ops/`` and
-  ``fit/`` kernel layers, where an implicit f64/complex128 default is a
-  TPU hazard.
-* J004 — retrace/cache hazards around ``jax.jit`` itself: mutable
-  default arguments on jitted functions, ``jax.jit`` applied inside a
-  function body (fresh compile cache per call), immediate
-  ``jax.jit(f)(...)`` invocation.
+* J002 — host-side call inside a jitted function: host syncs
+  (``float()``, ``.item()``, ``np.asarray``) on traced values, plus
+  the whole obs/runner/service/testing API surface, auto-scanned from
+  the package tree (inventory.py) so new modules are covered the
+  moment they land.
+* J003 — dtype-less array constructor in the ``ops/`` and ``fit/``
+  kernel layers, where an implicit f64/complex128 default is a TPU
+  hazard.
+* J004 — retrace/cache hazards around ``jax.jit`` itself.
 * J005 — ``jax.config`` mutation outside ``config.py``.
+* J006 — blocking call (sleep/subprocess/file/socket IO, thread join,
+  unbounded wait, chaos fault site) while a lock is held.
+* J007 — lock-acquisition-order cycle in the static, whole-program
+  lock graph (deadlock candidate).
+* J008 — thread-creation hygiene: non-daemon/unnamed threads, or
+  telemetry-emitting targets that never adopt trace context.
+* J009 — ledger file opened for writing outside the WorkQueue append
+  API (runner/queue.py owns the ledger protocol).
+* J010 — unguarded telemetry emission on background-thread paths (the
+  obs plane's never-fatal contract).
+* JP01 — malformed ``jaxlint:`` pragma (ignored suppressions must be
+  findings, not silence).
 
 Suppress a finding with a same-line ``# jaxlint: disable=J00X`` pragma
 (comma-separate several IDs, or ``disable=all``); a whole file opts out
 of one rule with ``# jaxlint: disable-file=J00X`` on any line.
 
-Run as ``python -m tools.jaxlint pulseportraiture_tpu``.
+Run as ``python -m tools.jaxlint pulseportraiture_tpu tools``; the
+cross-artifact drift checker (fault sites / metrics / obs events vs
+docs and chaos coverage) runs as ``python -m tools.jaxlint --drift``.
 """
 
 from .engine import Finding, lint_file, lint_paths, lint_source

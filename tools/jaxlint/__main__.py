@@ -1,7 +1,10 @@
-"""CLI: ``python -m tools.jaxlint [paths...] [--select J001,J003]``.
+"""CLI: ``python -m tools.jaxlint [paths...] [--select J001,J006]``.
 
-Exit status 0 when the tree is clean, 1 when findings remain, 2 on
-usage errors.  Rule catalogue and suppression syntax: docs/LINTING.md.
+pplint — the repo's whole-program static analyzer (jit purity,
+concurrency, protocol rules) plus the ``--drift`` cross-artifact
+checker.  Exit status 0 when the tree is clean, 1 when findings (or
+drift mismatches) remain, 2 on usage errors.  Rule catalogue and
+suppression syntax: docs/LINTING.md.
 """
 
 import argparse
@@ -14,8 +17,10 @@ from .rules import RULES
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m tools.jaxlint",
-        description="Repo-native JAX/TPU static analysis (rules "
-                    "J001-J005; see docs/LINTING.md).")
+        description="pplint: repo-native JAX/TPU static analysis "
+                    "(jit purity J001-J005, concurrency J006-J008, "
+                    "protocol J009-J010, pragma hygiene JP01; see "
+                    "docs/LINTING.md).")
     parser.add_argument("paths", nargs="*", default=["pulseportraiture_tpu"],
                         help="files or directories to lint "
                              "(default: pulseportraiture_tpu)")
@@ -26,12 +31,32 @@ def main(argv=None):
                         help="print per-rule counts after the findings")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalogue and exit")
+    parser.add_argument("--drift", action="store_true",
+                        help="run the cross-artifact drift checker "
+                             "(fault sites / metrics / obs events vs "
+                             "docs and chaos coverage) instead of "
+                             "linting")
+    parser.add_argument("--faults-file", default=None,
+                        help="override the faults.py parsed for SITES "
+                             "(the seeded-drift self-test hook)")
+    parser.add_argument("--repo-root", default=None,
+                        help="repo root for --drift (default: the "
+                             "root this linter lives in)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
         for rule in sorted(RULES):
             print("%s  %s" % (rule, RULES[rule]))
         return 0
+
+    if args.drift:
+        from .drift import main as drift_main
+        return drift_main(repo_root=args.repo_root,
+                          faults_file=args.faults_file)
+    if args.faults_file:
+        print("--faults-file only applies with --drift",
+              file=sys.stderr)
+        return 2
 
     select = None
     if args.select:

@@ -1,0 +1,456 @@
+"""Concurrency rules J006-J008: the fleet's thread discipline.
+
+Sixteen modules now spawn threads or hold locks (prefetch pool, lease
+heartbeat, memory sampler, dispatch watchdog, micro-batcher, daemon),
+and the production fleet directions (ROADMAP "New directions") only
+add more.  Three static rules encode the discipline those threads
+already follow by convention:
+
+* **J006 — blocking call while a lock is held.**  ``time.sleep``,
+  ``subprocess.*``, ``open()``, file-handle IO, socket IO, thread
+  ``join``, ``queue.get()`` without timeout, unbounded ``wait()`` and
+  ``faults.check`` (whose ``hang=`` clauses sleep by design) inside a
+  ``with <lock>:`` body stall every sibling of that lock.  The repo's
+  deliberate exceptions (the ledger append serializing its own sink
+  IO, the obs sink write) carry pragmas with one-line justifications.
+* **J007 — lock-acquisition-order cycles.**  A static lock graph:
+  syntactically nested ``with`` acquisitions plus one level of
+  name-resolved call summaries (a function called while a lock is
+  held contributes every lock it may transitively acquire).  A cycle
+  — including a self-loop through a re-entrant call chain — is a
+  deadlock candidate.  Resolution is heuristic by design: call
+  targets resolve by terminal name only when distinctive (≥4 chars,
+  not a generic verb, ≤4 candidates repo-wide).
+* **J008 — thread-creation hygiene.**  Every ``threading.Thread``
+  must be ``daemon=True`` (a non-daemon thread wedged in native XLA
+  code aborts interpreter teardown — runner/execute.py
+  ``abandoned_workers``) and carry a ``name=`` (the obs plane and
+  watchdog forensics identify threads by name); a thread target that
+  emits telemetry (obs/metrics/tracing) without adopting trace
+  context (``tracing.activate``/``tracing.current``) produces
+  trace-orphaned spans on instrumented paths.
+
+Lock identity is ``<pkg>/<module>.py:<Class>.<attr>`` — precise enough
+to order ``runner/queue`` ledger locks against ``service/daemon`` and
+``pipelines/toas`` checkpoint locks, the graph the fleet tentpoles
+need.  Blind spots (documented in docs/LINTING.md): bare
+``.acquire()``/``.release()`` pairs are not modeled, and a lock
+reached only through dynamic dispatch is invisible.
+"""
+
+import ast
+import re
+from pathlib import PurePath
+
+from .rules import dotted_name
+
+__all__ = ["analyze_concurrency", "lock_order_findings", "FuncSummary",
+           "LockEdge"]
+
+_LOCKISH_RE = re.compile(r"lock|mutex|guard", re.I)
+_CONDISH_RE = re.compile(r"cond", re.I)
+_THREADISH_RE = re.compile(
+    r"(^|_)(t|th|thread|threads|w|worker|workers|proc|process)$"
+    r"|thread|worker", re.I)
+_QUEUEISH_RE = re.compile(r"(^|_)(q|jobs|queue|inbox)$|queue", re.I)
+_FILEISH_RE = re.compile(r"(^|_)(fh|file|f)$|file$", re.I)
+_SOCKISH_RE = re.compile(r"sock|conn", re.I)
+
+_SOCKET_METHODS = {"accept", "recv", "recvfrom", "recv_into",
+                   "sendall", "connect"}
+_FILE_METHODS = {"write", "read", "flush", "readline", "readlines",
+                 "truncate"}
+
+# call-target resolution (J007): a terminal name resolves only when it
+# is distinctive — at least 4 chars, not a generic verb, and mapping
+# to at most _MAX_CANDIDATES definitions repo-wide
+_GENERIC_CALLS = {
+    "get", "set", "put", "add", "pop", "run", "stop", "start", "wait",
+    "join", "close", "open", "read", "write", "send", "recv", "next",
+    "items", "keys", "values", "update", "append", "extend", "copy",
+    "clear", "strip", "split", "format", "encode", "decode", "sum",
+    "min", "max", "len", "abs", "int", "float", "str", "bool", "list",
+    "dict", "tuple", "sort", "sorted", "print", "setdefault", "flush",
+    "readline", "readlines", "writelines", "fileno", "seek", "tell",
+    "discard", "remove", "index", "count", "lower", "upper", "match",
+    "search", "group", "exists", "isfile", "isdir", "sleep", "time",
+    "partial", "asarray", "array", "zeros", "ones", "visit", "parse",
+}
+_MAX_CANDIDATES = 4
+
+# telemetry-emission heads for the J008 trace-adoption check
+_EMIT_HEADS = ("obs.", "metrics.", "quality.", "obs.metrics.",
+               "obs.quality.")
+_EMIT_TRACING = ("tracing.emit_span", "obs.tracing.emit_span")
+_ADOPT_CALLS = ("tracing.activate", "tracing.current",
+                "obs.tracing.activate", "obs.tracing.current",
+                "tracing.current_trace_id")
+
+
+def _mod_label(path):
+    parts = PurePath(path).parts
+    return "/".join(parts[-2:]) if len(parts) >= 2 else str(path)
+
+
+def _terminal(node):
+    """Last dotted segment of a call target, or None."""
+    d = dotted_name(node)
+    if d is not None:
+        return d.rsplit(".", 1)[-1]
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class FuncSummary:
+    """What one function definition means to the lock graph."""
+
+    __slots__ = ("qualname", "path", "direct_locks", "calls")
+
+    def __init__(self, qualname, path):
+        self.qualname = qualname
+        self.path = path
+        self.direct_locks = set()
+        # (terminal_name, held_lock_ids_tuple, line, col)
+        self.calls = []
+
+    @property
+    def terminal(self):
+        return self.qualname.rsplit(".", 1)[-1]
+
+
+class LockEdge:
+    """outer lock held while inner lock is (possibly) acquired."""
+
+    __slots__ = ("outer", "inner", "path", "line", "col", "via")
+
+    def __init__(self, outer, inner, path, line, col, via):
+        self.outer = outer
+        self.inner = inner
+        self.path = path
+        self.line = line
+        self.col = col
+        self.via = via
+
+
+class _ConcurrencyVisitor(ast.NodeVisitor):
+    def __init__(self, path):
+        self.path = str(path)
+        self.mod = _mod_label(path)
+        self.findings = []   # (rule, line, col, message)
+        self.edges = []      # syntactic LockEdges
+        self.summaries = []  # FuncSummary per def
+        self._class_stack = []
+        self._func_stack = []   # FuncSummary stack
+        self._held = []         # (lock_id, condish) acquisition stack
+        self._defs = {}         # name -> [FunctionDef] (whole module)
+        self._thread_targets = set()  # names used as Thread targets
+
+    # -- lock identity --------------------------------------------------
+
+    def _lock_id(self, node):
+        d = dotted_name(node)
+        if d is not None:
+            if d.startswith("self."):
+                cls = self._class_stack[-1] if self._class_stack else "?"
+                return "%s:%s.%s" % (self.mod, cls, d[len("self."):])
+            return "%s:%s" % (self.mod, d)
+        if isinstance(node, ast.Attribute):
+            return "%s:%s" % (self.mod, node.attr)
+        return "%s:<expr>" % self.mod
+
+    def _lockish_item(self, item):
+        """(lock_id, condish) for a with-item that acquires a lock,
+        else None."""
+        expr = item.context_expr
+        if isinstance(expr, ast.Call):
+            term = _terminal(expr.func)
+            if term and (_LOCKISH_RE.search(term)
+                         or _CONDISH_RE.search(term)):
+                d = dotted_name(expr.func) or term
+                return ("%s:%s()" % (self.mod, d),
+                        bool(_CONDISH_RE.search(term)))
+            return None
+        term = _terminal(expr)
+        if term and (_LOCKISH_RE.search(term)
+                     or _CONDISH_RE.search(term)):
+            return self._lock_id(expr), bool(_CONDISH_RE.search(term))
+        return None
+
+    # -- scaffolding ----------------------------------------------------
+
+    def visit_Module(self, node):
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._defs.setdefault(sub.name, []).append(sub)
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node):
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_func(self, node):
+        qual = ".".join([c for c in self._class_stack[-1:]] +
+                        [node.name])
+        summary = FuncSummary(qual, self.path)
+        self.summaries.append(summary)
+        self._func_stack.append(summary)
+        held, self._held = self._held, []  # a new frame holds nothing
+        for stmt in node.body:
+            self.visit(stmt)
+        self._held = held
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Lambda(self, node):
+        pass  # deferred body: not executed under the current locks
+
+    # -- with: acquisition tracking + J007 syntactic edges --------------
+
+    def visit_With(self, node):
+        acquired = 0
+        for item in node.items:
+            # a with-item's context expression is evaluated while the
+            # previously listed locks are already held
+            self.visit(item.context_expr)
+            lk = self._lockish_item(item)
+            if lk is None:
+                continue
+            lock_id, condish = lk
+            for outer, _ in self._held:
+                self.edges.append(LockEdge(
+                    outer, lock_id, self.path, item.context_expr.lineno,
+                    item.context_expr.col_offset, "nested with"))
+            if self._func_stack:
+                self._func_stack[-1].direct_locks.add(lock_id)
+            self._held.append((lock_id, condish))
+            acquired += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(acquired):
+            self._held.pop()
+
+    visit_AsyncWith = visit_With
+
+    # -- calls: J006 / J008 + J007 call summaries ------------------------
+
+    def _add(self, rule, node, msg):
+        self.findings.append((rule, node.lineno, node.col_offset, msg))
+
+    def _held_locks(self):
+        return tuple(lid for lid, _ in self._held)
+
+    def visit_Call(self, node):
+        d = dotted_name(node.func)
+        term = _terminal(node.func)
+        if self._func_stack and term:
+            self._func_stack[-1].calls.append(
+                (term, self._held_locks(), node.lineno,
+                 node.col_offset))
+        if self._held:
+            self._check_blocking(node, d, term)
+        if d in ("threading.Thread", "Thread"):
+            self._check_thread(node)
+        self.generic_visit(node)
+
+    # -- J006 ------------------------------------------------------------
+
+    def _check_blocking(self, node, d, term):
+        lock = self._held[-1][0]
+
+        def flag(what):
+            self._add("J006", node,
+                      "%s while %s is held — every sibling of the "
+                      "lock stalls behind it; move the blocking work "
+                      "outside the critical section" % (what, lock))
+
+        if d in ("time.sleep", "sleep"):
+            return flag("time.sleep()")
+        if d is not None and d.startswith("subprocess."):
+            return flag("subprocess call")
+        if d == "open":
+            return flag("open() (file IO)")
+        if d in ("faults.check", "testing.faults.check"):
+            return flag("chaos fault site (an injected hang= sleeps "
+                        "inside the lock)")
+        if not isinstance(node.func, ast.Attribute):
+            return
+        recv = node.func.value
+        recv_term = _terminal(recv) or ""
+        recv_d = dotted_name(recv) or ""
+        kwargs = {kw.arg for kw in node.keywords}
+        if term in _SOCKET_METHODS and _SOCKISH_RE.search(recv_term):
+            return flag("socket .%s()" % term)
+        if term == "join":
+            if isinstance(recv, ast.Constant) or "path" in recv_d:
+                return
+            if _THREADISH_RE.search(recv_term):
+                return flag("thread .join()")
+            return
+        if term == "get" and _QUEUEISH_RE.search(recv_term) and \
+                not node.args and "timeout" not in kwargs:
+            return flag("queue .get() without timeout")
+        if term == "wait":
+            if _CONDISH_RE.search(recv_term):
+                return  # Condition.wait releases the lock: the idiom
+            if not node.args and "timeout" not in kwargs:
+                return flag("unbounded .wait()")
+            return
+        if term in _FILE_METHODS and _FILEISH_RE.search(recv_term):
+            return flag("file .%s()" % term)
+
+    # -- J008 ------------------------------------------------------------
+
+    def _check_thread(self, node):
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        daemon = kw.get("daemon")
+        if daemon is None or (isinstance(daemon, ast.Constant)
+                              and daemon.value is not True):
+            self._add("J008", node,
+                      "threading.Thread without daemon=True — a "
+                      "non-daemon thread wedged in native code aborts "
+                      "interpreter teardown (runner/execute.py "
+                      "abandoned_workers); pass daemon=True and "
+                      "join with a timeout")
+        if "name" not in kw:
+            self._add("J008", node,
+                      "unnamed threading.Thread — obs forensics and "
+                      "the watchdog identify threads by name; pass "
+                      "name='pptpu-...'")
+        target = kw.get("target")
+        tname = _terminal(target) if target is not None else None
+        if tname:
+            self._thread_targets.add(tname)
+            self._check_target_adoption(node, tname)
+
+    def _check_target_adoption(self, node, tname):
+        defs = self._defs.get(tname)
+        if not defs:
+            return
+        for fn in defs:
+            emits = adopts = False
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                d = dotted_name(sub.func)
+                if d is None:
+                    continue
+                if d in _ADOPT_CALLS:
+                    adopts = True
+                elif d in _EMIT_TRACING or (
+                        d.startswith(_EMIT_HEADS)
+                        and not d.startswith(("tracing.",
+                                              "obs.tracing."))):
+                    emits = True
+            if emits and not adopts:
+                self._add("J008", node,
+                          "thread target '%s' emits telemetry but "
+                          "never adopts trace context "
+                          "(tracing.activate/tracing.current) — its "
+                          "spans/metrics are trace-orphaned on "
+                          "instrumented paths "
+                          "(docs/OBSERVABILITY.md Distributed "
+                          "tracing)" % tname)
+                return
+
+
+def analyze_concurrency(tree, path):
+    """(findings, edges, summaries) for one parsed module."""
+    v = _ConcurrencyVisitor(path)
+    v.visit(tree)
+    return v.findings, v.edges, v.summaries
+
+
+# -- J007: the global lock graph -----------------------------------------
+
+
+def _resolvable(term):
+    return len(term) >= 4 and term not in _GENERIC_CALLS
+
+
+def _may_acquire(summaries):
+    """Fixpoint map qualname -> set of lock ids the function may
+    acquire transitively (name-resolved call summaries)."""
+    by_term = {}
+    for s in summaries:
+        by_term.setdefault(s.terminal, []).append(s)
+        # a class constructor is callable by the class name
+        if s.qualname.endswith(".__init__"):
+            by_term.setdefault(s.qualname.rsplit(".", 2)[-2],
+                               []).append(s)
+    acq = {id(s): set(s.direct_locks) for s in summaries}
+    changed = True
+    while changed:
+        changed = False
+        for s in summaries:
+            mine = acq[id(s)]
+            for term, _held, _line, _col in s.calls:
+                if not _resolvable(term):
+                    continue
+                callees = by_term.get(term)
+                if not callees or len(callees) > _MAX_CANDIDATES:
+                    continue
+                for c in callees:
+                    extra = acq[id(c)] - mine
+                    if extra:
+                        mine |= extra
+                        changed = True
+    return acq, by_term
+
+
+def lock_order_findings(edges, summaries):
+    """J007 findings: (path, line, col, message) for every edge that
+    participates in a lock-order cycle (incl. self-loops)."""
+    acq, by_term = _may_acquire(summaries)
+    all_edges = list(edges)
+    for s in summaries:
+        for term, held, line, col in s.calls:
+            if not held or not _resolvable(term):
+                continue
+            callees = by_term.get(term)
+            if not callees or len(callees) > _MAX_CANDIDATES:
+                continue
+            inner = set()
+            for c in callees:
+                inner |= acq[id(c)]
+            for outer in held:
+                for lk in inner:
+                    all_edges.append(LockEdge(
+                        outer, lk, s.path, line, col,
+                        "call to %s()" % term))
+
+    graph = {}
+    for e in all_edges:
+        graph.setdefault(e.outer, set()).add(e.inner)
+
+    def reaches(src, dst):
+        seen, todo = set(), [src]
+        while todo:
+            n = todo.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            todo.extend(graph.get(n, ()))
+        return False
+
+    findings = []
+    for e in all_edges:
+        if e.inner == e.outer:
+            findings.append((e.path, e.line, e.col,
+                             "lock %s may be re-acquired while "
+                             "already held (%s) — self-deadlock "
+                             "candidate for a non-reentrant Lock"
+                             % (e.outer, e.via)))
+        elif reaches(e.inner, e.outer):
+            findings.append((e.path, e.line, e.col,
+                             "lock-order cycle: %s -> %s (%s) while "
+                             "the reverse order also exists — "
+                             "deadlock candidate; pick one global "
+                             "order" % (e.outer, e.inner, e.via)))
+    # one finding per site (several edges can share a call site)
+    return sorted({f for f in findings})

@@ -1,0 +1,268 @@
+"""Cross-artifact drift checker (the ``--drift`` subcommand).
+
+The chaos/observability planes are only trustworthy while four
+artifact families agree, and until PR 16 they agreed by eyeball:
+
+* ``testing/faults.py`` ``SITES`` — the machine-readable single source
+  of fault-site truth;
+* ``faults.check(site=...)`` call sites in the package — every SITES
+  entry must be wired somewhere, and no call may name an unknown site
+  (it would silently never fire);
+* the docs — every site must appear in the faults.py module docstring
+  site table AND (backticked) in a docs/RUNNER.md / docs/SERVICE.md
+  failure-matrix row;
+* chaos-test coverage — every site must be exercised by at least one
+  ``site:<name>`` spec in tests/ or tools/.
+
+Likewise the telemetry names: every ``pps_*`` metric literal in the
+package must appear in the docs/OBSERVABILITY.md reference tables
+(wildcard rows like ``pps_device_*`` cover dynamic families, and the
+Prometheus exposition suffixes ``_bucket``/``_sum``/``_count`` are
+normalized), and every documented name must still exist in code; every
+``obs.event``/``obs.counter`` name in code must appear in the
+OBSERVABILITY.md "Event reference" section and vice versa.
+
+Each check is directional both ways, so a removed site, a renamed
+metric, or an undocumented event all fail the gate — that is the
+seeded-drift self-test in tools/check.sh.
+"""
+
+import ast
+import re
+from pathlib import Path
+
+__all__ = ["check_drift", "main"]
+
+_EXPO_SUFFIXES = ("_bucket", "_sum", "_count")
+_METRIC_CODE_RE = re.compile(r"pps_[a-z0-9_]+")
+_METRIC_DOC_RE = re.compile(r"pps_[a-z0-9_*]+")
+_SPEC_SITE_RE = re.compile(r"site:([a-z_]+)")
+_BACKTICK_NAME_RE = re.compile(r"`([a-z][a-z0-9_]+)`")
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "build", "dist",
+              "jaxlint_fixtures"}
+
+
+def _py_files(root):
+    for f in sorted(Path(root).rglob("*.py")):
+        if not any(p in _SKIP_DIRS for p in f.parts):
+            yield f
+
+
+def _read(path):
+    try:
+        return Path(path).read_text(encoding="utf-8")
+    except OSError:
+        return ""
+
+
+def _parse_sites(faults_file):
+    """(SITES tuple, module docstring) from the faults module AST."""
+    src = _read(faults_file)
+    try:
+        tree = ast.parse(src, filename=str(faults_file))
+    except (SyntaxError, ValueError):
+        return None, ""
+    sites = None
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "SITES" and \
+                        isinstance(node.value, (ast.Tuple, ast.List)):
+                    sites = tuple(
+                        e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str))
+    return sites, (ast.get_docstring(tree) or "")
+
+
+def _check_call_sites(pkg_root):
+    """{site literal -> [path:line]} of faults.check("...") calls."""
+    found = {}
+    for f in _py_files(pkg_root):
+        try:
+            tree = ast.parse(_read(f), filename=str(f))
+        except (SyntaxError, ValueError):
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "check"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "faults"):
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                found.setdefault(node.args[0].value, []).append(
+                    "%s:%d" % (f, node.lineno))
+    return found
+
+
+def _doc_section(text, heading):
+    """Backticked names inside one '## <heading>' section."""
+    lines = text.splitlines()
+    names, inside = set(), False
+    for ln in lines:
+        if ln.startswith("## "):
+            inside = ln[3:].strip().lower().startswith(heading.lower())
+            continue
+        if inside:
+            names.update(_BACKTICK_NAME_RE.findall(ln))
+    return names
+
+
+def _metric_matches(name, doc_exact, doc_wild):
+    def hit(n):
+        if n in doc_exact:
+            return True
+        return any(n.startswith(w) for w in doc_wild)
+    if hit(name):
+        return True
+    for suf in _EXPO_SUFFIXES:
+        if name.endswith(suf) and hit(name[:-len(suf)]):
+            return True
+    return False
+
+
+def check_drift(repo_root=None, faults_file=None):
+    """Cross-reference the artifact families; returns a list of
+    human-readable drift messages (empty == no drift)."""
+    root = Path(repo_root) if repo_root else \
+        Path(__file__).resolve().parents[2]
+    pkg = root / "pulseportraiture_tpu"
+    faults_py = Path(faults_file) if faults_file else \
+        pkg / "testing" / "faults.py"
+    problems = []
+
+    # -- fault sites ----------------------------------------------------
+    sites, docstring = _parse_sites(faults_py)
+    if sites is None:
+        return ["drift: cannot parse SITES from %s" % faults_py]
+    site_set = set(sites)
+    calls = _check_call_sites(pkg)
+    for name, locs in sorted(calls.items()):
+        if name not in site_set:
+            problems.append(
+                "drift: faults.check(%r) at %s names a site missing "
+                "from testing/faults.py SITES — the check can never "
+                "fire" % (name, locs[0]))
+    for name in sites:
+        if name not in calls:
+            problems.append(
+                "drift: fault site %r is declared in SITES but no "
+                "faults.check(%r) call exists in the package — dead "
+                "site" % (name, name))
+        if name not in docstring:
+            problems.append(
+                "drift: fault site %r is missing from the "
+                "testing/faults.py module-docstring site table"
+                % name)
+
+    runner_md = _read(root / "docs" / "RUNNER.md")
+    service_md = _read(root / "docs" / "SERVICE.md")
+    for name in sites:
+        if ("`%s`" % name) not in runner_md and \
+                ("`%s`" % name) not in service_md:
+            problems.append(
+                "drift: fault site %r has no failure-matrix row "
+                "(backticked) in docs/RUNNER.md or docs/SERVICE.md"
+                % name)
+
+    chaos_text = []
+    for d in (root / "tests", root / "tools"):
+        if d.is_dir():
+            for f in sorted(d.rglob("*")):
+                if f.suffix in (".py", ".sh") and f.is_file() and \
+                        not any(p in _SKIP_DIRS for p in f.parts):
+                    chaos_text.append(_read(f))
+    exercised = set()
+    for text in chaos_text:
+        exercised.update(_SPEC_SITE_RE.findall(text))
+    for name in sites:
+        if name not in exercised:
+            problems.append(
+                "drift: fault site %r is never exercised — no "
+                "'site:%s' chaos spec in tests/ or tools/"
+                % (name, name))
+
+    # -- pps_* metric names ---------------------------------------------
+    obs_md = _read(root / "docs" / "OBSERVABILITY.md")
+    code_metrics = set()
+    for f in _py_files(pkg):
+        code_metrics.update(_METRIC_CODE_RE.findall(_read(f)))
+    doc_metrics = set(_METRIC_DOC_RE.findall(obs_md))
+    doc_exact = {m for m in doc_metrics if "*" not in m}
+    doc_wild = {m[:-1] for m in doc_metrics if m.endswith("*")}
+    for name in sorted(code_metrics):
+        if not _metric_matches(name, doc_exact, doc_wild):
+            problems.append(
+                "drift: metric %r appears in code but not in the "
+                "docs/OBSERVABILITY.md reference tables" % name)
+    for name in sorted(doc_exact):
+        base = name
+        for suf in _EXPO_SUFFIXES:
+            if name.endswith(suf):
+                base = name[:-len(suf)]
+        if base not in code_metrics and name not in code_metrics:
+            problems.append(
+                "drift: metric %r is documented in "
+                "docs/OBSERVABILITY.md but no longer appears in code"
+                % name)
+    for w in sorted(doc_wild):
+        if not any(m.startswith(w) for m in code_metrics):
+            problems.append(
+                "drift: metric family %r* is documented in "
+                "docs/OBSERVABILITY.md but no longer appears in code"
+                % w)
+
+    # -- obs event / counter names ---------------------------------------
+    code_events, code_counters = set(), set()
+    for f in _py_files(pkg):
+        try:
+            tree = ast.parse(_read(f), filename=str(f))
+        except (SyntaxError, ValueError):
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            attr = node.func.attr if isinstance(node.func,
+                                                ast.Attribute) else None
+            if attr == "event" or (attr == "emit" and isinstance(
+                    node.func.value, ast.Name)
+                    and node.func.value.id in ("obs", "rec")):
+                code_events.add(node.args[0].value)
+            elif attr == "counter":
+                code_counters.add(node.args[0].value)
+    doc_names = _doc_section(obs_md, "Event reference")
+    if not doc_names:
+        problems.append(
+            "drift: docs/OBSERVABILITY.md has no 'Event reference' "
+            "section — obs event/counter names are unverifiable")
+    else:
+        for name in sorted(code_events | code_counters):
+            if name not in doc_names:
+                kind = "event" if name in code_events else "counter"
+                problems.append(
+                    "drift: obs %s %r is emitted in code but missing "
+                    "from the docs/OBSERVABILITY.md Event reference"
+                    % (kind, name))
+        for name in sorted(doc_names):
+            if name not in code_events | code_counters:
+                problems.append(
+                    "drift: %r is listed in the docs/OBSERVABILITY.md "
+                    "Event reference but never emitted in code" % name)
+    return problems
+
+
+def main(repo_root=None, faults_file=None, stream=None):
+    import sys
+    stream = stream or sys.stdout
+    problems = check_drift(repo_root=repo_root, faults_file=faults_file)
+    for p in problems:
+        print(p, file=stream)
+    print("jaxlint --drift: %d mismatch(es)" % len(problems),
+          file=stream)
+    return 1 if problems else 0

@@ -1,7 +1,22 @@
-"""jaxlint engine: pragma handling, file walking, reporting.
+"""pplint engine: pragma handling, file walking, reporting.
 
-The rule logic lives in rules.py; this module turns (source, path) into
-pragma-filtered Finding records and provides the CLI entry points.
+The rule logic lives in rules.py (J001-J005 jit purity), concurrency.py
+(J006-J008) and protocol.py (J009-J010); this module turns (source,
+path) into pragma-filtered Finding records and provides the CLI entry
+points.
+
+Degradation contract: a file the linter cannot parse — syntax error,
+bad encoding, null bytes, a torn partial write — surfaces as exactly
+ONE J000 finding, never a traceback (a file that cannot be parsed
+cannot be certified clean).  Malformed pragmas surface as JP01: a
+suppression the engine silently ignored would be worse than no
+suppression at all.
+
+Rule J007 (lock-order cycles) is the one whole-program rule: when a
+directory tree is linted, the lock graph is built across every file so
+cross-module cycles (runner/queue vs service/daemon vs pipelines/toas
+checkpoint locks) are visible; linting a single file/source still
+reports intrafile cycles.
 """
 
 import ast
@@ -12,6 +27,8 @@ import tokenize
 from dataclasses import dataclass
 from pathlib import Path
 
+from .concurrency import analyze_concurrency, lock_order_findings
+from .protocol import analyze_protocol
 from .rules import RULES, run_rules
 
 __all__ = ["Finding", "lint_source", "lint_file", "lint_paths", "report"]
@@ -19,6 +36,10 @@ __all__ = ["Finding", "lint_source", "lint_file", "lint_paths", "report"]
 _PRAGMA_RE = re.compile(
     r"#\s*jaxlint:\s*(disable|disable-file)\s*=\s*"
     r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+# any comment that *intends* to be a pragma — used to flag malformed
+# ones (JP01) instead of silently ignoring them
+_PRAGMA_INTENT_RE = re.compile(r"#\s*jaxlint\s*:")
 
 # directories never worth descending into
 _SKIP_DIRS = {"__pycache__", ".git", ".venv", "build", "dist",
@@ -39,30 +60,50 @@ class Finding:
 
 
 def _pragmas(source):
-    """(line -> set of disabled rule IDs, file-wide disabled IDs).
+    """(line -> disabled IDs, file-wide disabled IDs, JP01 raw
+    findings).
 
-    ``# jaxlint: disable=J001[,J002...]`` suppresses on its own line;
+    ``# jaxlint: disable=J001[, J002...]`` suppresses on its own line;
     ``# jaxlint: disable-file=J001`` (any line) suppresses file-wide;
-    the ID ``all`` matches every rule.
+    the ID ``all`` matches every rule.  A comment that *intends* to be
+    a pragma but does not parse, or names a rule this linter does not
+    know, is a JP01 finding — a suppression silently ignored would be
+    obeyed by the author and by nothing else.
     """
     per_line = {}
     per_file = set()
+    bad = []
     try:
         tokens = tokenize.generate_tokens(io.StringIO(source).readline)
         for tok in tokens:
             if tok.type != tokenize.COMMENT:
                 continue
+            if not _PRAGMA_INTENT_RE.search(tok.string):
+                continue
             m = _PRAGMA_RE.search(tok.string)
             if not m:
+                bad.append(("JP01", tok.start[0], tok.start[1],
+                            "malformed jaxlint pragma %r — expected "
+                            "'# jaxlint: disable[-file]=RULE[,RULE...]'"
+                            "; the pragma is ignored"
+                            % tok.string.strip()))
                 continue
             ids = {s.strip().upper() for s in m.group(2).split(",")}
+            for rid in sorted(ids):
+                if rid != "ALL" and rid not in RULES:
+                    bad.append(("JP01", tok.start[0], tok.start[1],
+                                "unknown rule id '%s' in jaxlint "
+                                "pragma — known: %s, all; the id is "
+                                "ignored" % (rid,
+                                             ", ".join(sorted(RULES)))))
+            ids &= set(RULES) | {"ALL"}
             if m.group(1) == "disable-file":
                 per_file |= ids
             else:
                 per_line.setdefault(tok.start[0], set()).update(ids)
     except tokenize.TokenError:
         pass
-    return per_line, per_file
+    return per_line, per_file, bad
 
 
 def _suppressed(rule, line, per_line, per_file):
@@ -72,44 +113,97 @@ def _suppressed(rule, line, per_line, per_file):
     return "ALL" in ids or rule in ids
 
 
-def lint_source(source, path, select=None):
-    """Lint one module's source text.
+def _lint_module(source, path, select):
+    """One module, everything except whole-program J007.
 
-    ``path`` scopes the path-sensitive rules (J003 kernel layers, J005
-    config.py exemption) and labels the findings; ``select`` restricts
-    to an iterable of rule IDs.  Returns (findings, n_suppressed); a
-    syntax error surfaces as a single J000 finding rather than a crash
-    (a file the linter cannot parse cannot be certified clean).
+    Returns (findings, nsup, edges, summaries, per_line, per_file).
+    ``edges``/``summaries`` feed the lock graph; pragma tables come
+    back so the caller can apply suppression to J007 findings landed
+    in this file later.
     """
+    spath = str(path)
     try:
-        tree = ast.parse(source, filename=str(path))
+        tree = ast.parse(source, filename=spath)
     except SyntaxError as e:
-        return [Finding(str(path), e.lineno or 1, (e.offset or 1) - 1,
-                        "J000", "syntax error: %s" % e.msg)], 0
-    per_line, per_file = _pragmas(source)
-    selected = None if select is None else {s.upper() for s in select}
+        return ([Finding(spath, e.lineno or 1, (e.offset or 1) - 1,
+                         "J000", "syntax error: %s" % e.msg)],
+                0, [], [], {}, set())
+    except ValueError as e:
+        # e.g. null bytes from a torn/partial write
+        return ([Finding(spath, 1, 0, "J000",
+                         "unparseable source: %s" % e)],
+                0, [], [], {}, set())
+    per_line, per_file, bad_pragmas = _pragmas(source)
+    raw = list(run_rules(tree, spath))
+    conc, edges, summaries = analyze_concurrency(tree, spath)
+    raw += conc
+    raw += analyze_protocol(tree, spath)
+    raw += bad_pragmas
     findings, nsup = [], 0
-    for rule, line, col, message in run_rules(tree, str(path)):
-        if selected is not None and rule not in selected:
+    for rule, line, col, message in raw:
+        if select is not None and rule not in select:
             continue
         if _suppressed(rule, line, per_line, per_file):
             nsup += 1
             continue
-        findings.append(Finding(str(path), line, col, rule, message))
-    return sorted(findings), nsup
+        findings.append(Finding(spath, line, col, rule, message))
+    return findings, nsup, edges, summaries, per_line, per_file
+
+
+def _j007(edges, summaries, pragma_map, select):
+    """Finalize the lock graph into pragma-filtered J007 Findings."""
+    if select is not None and "J007" not in select:
+        return [], 0
+    findings, nsup = [], 0
+    for path, line, col, message in lock_order_findings(edges,
+                                                        summaries):
+        per_line, per_file = pragma_map.get(path, ({}, set()))
+        if _suppressed("J007", line, per_line, per_file):
+            nsup += 1
+            continue
+        findings.append(Finding(path, line, col, "J007", message))
+    return findings, nsup
+
+
+def lint_source(source, path, select=None):
+    """Lint one module's source text.
+
+    ``path`` scopes the path-sensitive rules (J003 kernel layers, J005
+    config.py exemption, J009 queue.py ownership) and labels the
+    findings; ``select`` restricts to an iterable of rule IDs.
+    Returns (findings, n_suppressed); an unparseable file surfaces as
+    a single J000 finding rather than a crash.  J007 sees only this
+    module's lock graph — lint_paths builds the whole-program graph.
+    """
+    selected = None if select is None else {s.upper() for s in select}
+    f, nsup, edges, summaries, pl, pf = _lint_module(source, path,
+                                                     selected)
+    f7, nsup7 = _j007(edges, summaries, {str(path): (pl, pf)},
+                      selected)
+    return sorted(f + f7), nsup + nsup7
 
 
 def lint_file(path, select=None):
-    with open(path, encoding="utf-8") as fh:
-        return lint_source(fh.read(), path, select=select)
+    try:
+        with open(path, encoding="utf-8") as fh:
+            source = fh.read()
+    except (UnicodeDecodeError, OSError) as e:
+        return [Finding(str(path), 1, 0, "J000",
+                        "unreadable file: %s" % e)], 0
+    return lint_source(source, path, select=select)
 
 
 def _iter_py_files(paths):
+    # skip-dirs are judged relative to the requested root, so a broad
+    # sweep ("tests") omits the seeded fixture corpus but pointing at
+    # the corpus itself still lints it
     for p in paths:
         p = Path(p)
         if p.is_dir():
             for f in sorted(p.rglob("*.py")):
-                if not any(part in _SKIP_DIRS for part in f.parts):
+                rel = f.relative_to(p)
+                if not any(part in _SKIP_DIRS for part in
+                           rel.parts[:-1]):
                     yield f
         elif p.suffix == ".py":
             yield p
@@ -117,14 +211,32 @@ def _iter_py_files(paths):
 
 def lint_paths(paths, select=None):
     """Lint files/directories; returns (findings, n_suppressed,
-    n_files)."""
+    n_files).  The J007 lock graph spans every linted file, so
+    cross-module acquisition-order cycles are visible.
+    """
+    selected = None if select is None else {s.upper() for s in select}
     findings, nsup, nfiles = [], 0, 0
+    all_edges, all_summaries, pragma_map = [], [], {}
     for f in _iter_py_files(paths):
         nfiles += 1
-        fnd, sup = lint_file(f, select=select)
+        try:
+            with open(f, encoding="utf-8") as fh:
+                source = fh.read()
+        except (UnicodeDecodeError, OSError) as e:
+            findings.append(Finding(str(f), 1, 0, "J000",
+                                    "unreadable file: %s" % e))
+            continue
+        fnd, sup, edges, summaries, pl, pf = _lint_module(source, f,
+                                                          selected)
         findings.extend(fnd)
         nsup += sup
-    return findings, nsup, nfiles
+        all_edges.extend(edges)
+        all_summaries.extend(summaries)
+        pragma_map[str(f)] = (pl, pf)
+    f7, nsup7 = _j007(all_edges, all_summaries, pragma_map, selected)
+    findings.extend(f7)
+    nsup += nsup7
+    return sorted(findings), nsup, nfiles
 
 
 def report(findings, nsup, nfiles, stream=sys.stdout, statistics=False):

@@ -1,0 +1,195 @@
+"""Auto-derived host-side API inventory for rule J002.
+
+Until PR 16 every host-side subsystem (obs, metrics, tracing, the
+runner, the service, the chaos harness, ...) was a HAND-MAINTAINED
+name list in rules.py, and every PR that added a module had to extend
+the list plus a fixture by hand.  This module replaces the lists with
+an inventory *scanned from the package tree itself*: the public API of
+every module under ``pulseportraiture_tpu/{obs,runner,service,
+testing}`` is host-side by contract (those packages are orchestration,
+telemetry and fault injection — none of it can exist inside a jit
+trace), so a new module is jit-purity-covered the moment it lands.
+
+For each scanned module the inventory records:
+
+* the module's **heads** — the dotted prefixes under which its API is
+  matched (``metrics.observe``, ``obs.metrics.observe``, ...), plus
+  instance-name variants for the modules whose objects conventionally
+  travel under another name (a ``HostPrefetcher`` is a ``prefetcher``);
+* its **names** — ``__all__`` when declared, otherwise the public
+  top-level functions/classes, plus the public methods of public
+  top-level classes (an instance method called through
+  ``prefetcher.submit`` is as host-side as the module function);
+* **bare names** — the subset distinctive enough to match unqualified
+  (``from ..runner import plan_survey`` idiom): snake_case with an
+  underscore or CamelCase class names.  Short generic words (``run``,
+  ``check``, ``span``) never match bare — only behind a head.
+
+The scan is AST-only (no imports — the linter must run without jax),
+cached per process, and rooted at the repo this file lives in; when
+the package tree is missing (linting an unrelated checkout) the
+inventory is empty and J002 degrades to its core host-sync checks.
+"""
+
+import ast
+import re
+from pathlib import Path
+
+__all__ = ["HostInventory", "host_inventory", "scan_packages"]
+
+# packages whose every public name is host-side by contract
+SCAN_PACKAGES = ("obs", "runner", "service", "testing")
+
+# instance-name heads: objects of these modules conventionally travel
+# under these extra names in instrumented code
+_EXTRA_HEADS = {
+    "prefetch": ("prefetcher",),
+}
+
+# names too generic to ever match bare, even when they carry an
+# underscore or CamelCase (bound methods/classes that collide with
+# stdlib or numpy idioms)
+_BARE_BLOCKLIST = {
+    "Thread", "Lock", "RLock", "Event", "Condition", "Path",
+    "Request",
+}
+
+# message family per scanned subpackage (rules.py renders these);
+# modules without a family entry get the generic message
+FAMILY_OF_PACKAGE = {"obs": "obs", "runner": "runner",
+                     "service": "service", "testing": "faults"}
+
+# the one curated remnant: host-side loader entry points that live in
+# the mixed host/device ``pipelines`` package (not scanned wholesale —
+# it also holds jitted kernels) but are part of the prefetch contract
+_EXTRA_BARE = {"load_archive_data": "prefetch"}
+
+_CAMEL_RE = re.compile(r"^[A-Z][A-Za-z0-9]*$")
+
+
+class HostInventory:
+    """Matchable view of the scanned host-side API surface."""
+
+    def __init__(self):
+        self.heads = {}      # head -> set of member names
+        self.family = {}     # head -> message-family key
+        self.bare = {}       # bare name -> family key
+        self.modules = []    # scanned module paths (diagnostics/tests)
+
+    def match_dotted(self, fname):
+        """(head, name, family) when ``fname`` ('metrics.observe',
+        'obs.metrics.observe', ...) is a host-API member call, else
+        None."""
+        head, _, attr = fname.rpartition(".")
+        if not head:
+            return None
+        for pfx in ("pulseportraiture_tpu.", "pptpu."):
+            if head.startswith(pfx):
+                head = head[len(pfx):]
+        names = self.heads.get(head)
+        if names is not None and attr in names:
+            return head, attr, self.family.get(head, "host")
+        return None
+
+    def match_bare(self, fname):
+        """family key when ``fname`` is a distinctive bare entry
+        point, else None."""
+        return self.bare.get(fname)
+
+
+def _public_api(tree):
+    """(names, method_names) of one module: __all__ when declared
+    (string literals only), else public top-level defs/classes; method
+    names come from public top-level classes either way."""
+    names, methods = set(), set()
+    declared = None
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "__all__" \
+                        and isinstance(node.value, (ast.List, ast.Tuple)):
+                    declared = {e.value for e in node.value.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, str)}
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if not node.name.startswith("_"):
+                names.add(node.name)
+        elif isinstance(node, ast.ClassDef):
+            if node.name.startswith("_"):
+                continue
+            names.add(node.name)
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) and \
+                        not sub.name.startswith("_"):
+                    methods.add(sub.name)
+    return (declared if declared is not None else names), methods
+
+
+def _bare_eligible(name):
+    return name not in _BARE_BLOCKLIST and (
+        "_" in name or (_CAMEL_RE.match(name) and len(name) >= 6))
+
+
+def scan_packages(package_root):
+    """Build a :class:`HostInventory` from
+    ``<package_root>/{obs,runner,service,testing}``."""
+    inv = HostInventory()
+    root = Path(package_root)
+    for pkg in SCAN_PACKAGES:
+        pkg_dir = root / pkg
+        if not pkg_dir.is_dir():
+            continue
+        family = FAMILY_OF_PACKAGE.get(pkg, "host")
+        for mod in sorted(pkg_dir.glob("*.py")):
+            try:
+                tree = ast.parse(mod.read_text(encoding="utf-8"),
+                                 filename=str(mod))
+            except (SyntaxError, ValueError, OSError,
+                    UnicodeDecodeError):
+                continue  # a broken module cannot extend the contract
+            names, methods = _public_api(tree)
+            stem = mod.stem
+            if stem == "__init__":
+                heads = [pkg]
+                fam = family
+            else:
+                heads = [stem, "%s.%s" % (pkg, stem)]
+                heads += list(_EXTRA_HEADS.get(stem, ()))
+                # submodule families: metrics/tracing/... carry their
+                # own tailored message
+                fam = stem if pkg == "obs" else family
+                if stem == "faults":
+                    fam = "faults"
+                elif stem == "prefetch":
+                    fam = "prefetch"
+                elif stem == "warm":
+                    fam = "warm"
+            member = names | methods
+            for head in heads:
+                inv.heads.setdefault(head, set()).update(member)
+                inv.family.setdefault(head, fam)
+            for name in names:
+                if _bare_eligible(name):
+                    inv.bare.setdefault(name, fam)
+            inv.modules.append(str(mod))
+    for name, fam in _EXTRA_BARE.items():
+        inv.bare.setdefault(name, fam)
+    return inv
+
+
+_CACHE = {}
+
+
+def host_inventory(package_root=None):
+    """The cached inventory for ``package_root`` (default: the
+    ``pulseportraiture_tpu`` package of the repo this linter lives
+    in)."""
+    if package_root is None:
+        package_root = Path(__file__).resolve().parents[2] / \
+            "pulseportraiture_tpu"
+    key = str(package_root)
+    inv = _CACHE.get(key)
+    if inv is None:
+        inv = _CACHE[key] = scan_packages(package_root)
+    return inv

@@ -1,0 +1,184 @@
+"""Protocol rules J009-J010: ledger custody and never-fatal telemetry.
+
+* **J009 — ledger writes outside the WorkQueue append API.**  The
+  exactly-once semantics of the million-archive roadmap rest on ONE
+  property: every ledger mutation is an append through
+  ``WorkQueue._append`` (single writer per shard, ``_iolock``
+  serialized, fsync'd, crash-torn tails tolerated on rescan —
+  docs/RUNNER.md).  A raw ``open(<...ledger...>, "a"/"w")`` anywhere
+  else silently forks the protocol: no heartbeat framing, no fault
+  site, no schema versioning.  The rule flags any write/append-mode
+  ``open()``/``.open()`` whose path expression mentions ``ledger``
+  outside ``runner/queue.py``.  Read-mode opens (audit tooling,
+  tests) are fine.
+
+* **J010 — unguarded telemetry emission on background-thread paths.**
+  The obs plane's contract is "never fatal" (docs/OBSERVABILITY.md):
+  the sanctioned module-level wrappers (``obs.event``,
+  ``metrics.inc``, ``tracing.emit_span``, ``quality.*``, ...)
+  swallow sink errors internally.  A *thread target* that bypasses
+  them — calling ``recorder.emit`` / ``registry.bump`` style methods
+  on a state object, or opening a sink file directly — outside any
+  ``try`` block can kill its worker thread on a full disk, and a dead
+  heartbeat/prefetch thread is a correctness event, not a telemetry
+  event.  Scope is deliberately narrow (direct emission in the
+  statically-identified thread-target body) to stay false-positive
+  free; the wrappers themselves are the sanctioned escape hatch.
+"""
+
+import ast
+from pathlib import PurePath
+
+from .rules import dotted_name
+
+__all__ = ["analyze_protocol"]
+
+_WRITE_MODES = ("w", "a", "x", "+")
+
+# state-object receivers whose direct emission methods bypass the
+# never-fatal wrappers
+_EMITTER_RECV = ("rec", "recorder", "registry", "reg", "sink")
+_EMITTER_METHODS = {"emit", "bump", "inc", "observe", "set_gauge",
+                    "emit_span", "record"}
+
+# the WorkQueue implementation itself owns the ledger protocol
+_LEDGER_OWNER = ("runner", "queue.py")
+
+
+def _mentions_ledger(node):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            if "ledger" in sub.value.lower():
+                return True
+        elif isinstance(sub, ast.Name):
+            if "ledger" in sub.id.lower():
+                return True
+        elif isinstance(sub, ast.Attribute):
+            if "ledger" in sub.attr.lower():
+                return True
+    return False
+
+
+def _write_mode(call, mode_slot):
+    """True when an open() call is in a write/append mode (or the mode
+    is dynamic, which cannot be certified read-only).  ``mode_slot``
+    is the positional index of mode: 1 for builtin open(path, mode),
+    0 for the Path.open(mode) method form."""
+    mode = None
+    if len(call.args) > mode_slot:
+        mode = call.args[mode_slot]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return False  # default "r"
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return any(ch in mode.value for ch in _WRITE_MODES)
+    return True
+
+
+class _ProtocolVisitor(ast.NodeVisitor):
+    def __init__(self, path):
+        self.path = str(path)
+        parts = PurePath(path).parts
+        self.is_ledger_owner = tuple(parts[-2:]) == _LEDGER_OWNER
+        self.findings = []
+        self._defs = {}           # name -> [FunctionDef]
+        self._thread_targets = set()
+
+    def _add(self, rule, node, msg):
+        self.findings.append((rule, node.lineno, node.col_offset, msg))
+
+    # -- pass 1: collect defs and thread-target names -------------------
+
+    def visit_Module(self, node):
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._defs.setdefault(sub.name, []).append(sub)
+            elif isinstance(sub, ast.Call):
+                d = dotted_name(sub.func)
+                if d in ("threading.Thread", "Thread"):
+                    for kw in sub.keywords:
+                        if kw.arg == "target":
+                            tname = dotted_name(kw.value)
+                            if tname:
+                                self._thread_targets.add(
+                                    tname.rsplit(".", 1)[-1])
+        self.generic_visit(node)
+        self._check_thread_bodies()
+
+    # -- J009 ------------------------------------------------------------
+
+    def visit_Call(self, node):
+        if not self.is_ledger_owner:
+            d = dotted_name(node.func)
+            if d == "open":
+                is_open, mode_slot = True, 1
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "open":
+                is_open, mode_slot = True, 0
+            else:
+                is_open, mode_slot = False, 1
+            if is_open and _write_mode(node, mode_slot) and \
+                    _mentions_ledger(node):
+                self._add(
+                    "J009", node,
+                    "ledger file opened for writing outside the "
+                    "WorkQueue append API — ledger mutations must go "
+                    "through runner/queue.py (_append: single-writer, "
+                    "fsync'd, torn-tail tolerant; docs/RUNNER.md)")
+        self.generic_visit(node)
+
+    # -- J010 ------------------------------------------------------------
+
+    def _check_thread_bodies(self):
+        for tname in sorted(self._thread_targets):
+            for fn in self._defs.get(tname, ()):
+                self._check_target(fn, tname)
+
+    def _check_target(self, fn, tname):
+        guarded = set()
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Try):
+                for stmt in sub.body:
+                    for inner in ast.walk(stmt):
+                        guarded.add(id(inner))
+        for sub in ast.walk(fn):
+            if id(sub) in guarded or not isinstance(sub, ast.Call):
+                continue
+            d = dotted_name(sub.func)
+            if d == "open":
+                self._add(
+                    "J010", sub,
+                    "raw open() on thread-target path '%s' outside "
+                    "try/except — telemetry/sink IO on a background "
+                    "thread must be never-fatal (a dead worker is a "
+                    "correctness event); guard it or use the "
+                    "sanctioned obs/metrics wrappers" % tname)
+                continue
+            if not isinstance(sub.func, ast.Attribute):
+                continue
+            if sub.func.attr not in _EMITTER_METHODS:
+                continue
+            recv = sub.func.value
+            recv_d = (dotted_name(recv) or
+                      (recv.attr if isinstance(recv, ast.Attribute)
+                       else "")).lower()
+            recv_term = recv_d.rsplit(".", 1)[-1].lstrip("_")
+            if any(recv_term == r or recv_term.endswith("_" + r)
+                   for r in _EMITTER_RECV):
+                self._add(
+                    "J010", sub,
+                    "direct %s.%s() on thread-target path '%s' "
+                    "bypasses the never-fatal telemetry wrappers "
+                    "outside try/except — use obs.*/metrics.* module "
+                    "wrappers or guard the call "
+                    "(docs/OBSERVABILITY.md: emission is never "
+                    "fatal)" % (recv_term, sub.func.attr, tname))
+
+
+def analyze_protocol(tree, path):
+    """J009/J010 findings for one parsed module."""
+    v = _ProtocolVisitor(path)
+    v.visit(tree)
+    return v.findings

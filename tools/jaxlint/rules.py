@@ -1,14 +1,23 @@
-"""AST rules J001-J005.
+"""AST rules J001-J005 (jit purity) + the pplint rule catalogue.
 
 Each rule favors precision over recall: a finding should point at a
 *real* JAX/TPU hazard, and patterns the checker cannot resolve
 statically (locals derived from parameters, cross-function dataflow)
 are deliberately out of scope rather than guessed at.  The catalogue,
 rationale, and known blind spots are documented in docs/LINTING.md.
+
+The concurrency rules (J006-J008) live in concurrency.py, the protocol
+rules (J009-J010) in protocol.py; ``RULES`` here is the single
+registry all of them (and the pragma validator) key on.  The J002
+host-side API surface is no longer a hand list: it is scanned from the
+package tree by inventory.py, so new obs/runner/service/testing
+modules are jit-purity-covered the moment they land.
 """
 
 import ast
 from pathlib import PurePath
+
+from .inventory import host_inventory
 
 RULES = {
     "J001": "Python loop over an array axis inside a jitted function "
@@ -19,6 +28,20 @@ RULES = {
     "J004": "jax.jit cache/retrace hazard (mutable default, per-call "
             "jit construction, or immediate invocation)",
     "J005": "jax.config mutated outside config.py",
+    "J006": "blocking call (sleep/subprocess/file/socket IO, thread "
+            "join, unbounded wait, chaos fault site) while a lock is "
+            "held",
+    "J007": "lock-acquisition-order cycle in the static lock graph "
+            "(deadlock candidate)",
+    "J008": "thread-creation hygiene: non-daemon or unnamed thread, or "
+            "a telemetry-emitting target that never adopts trace "
+            "context",
+    "J009": "ledger file opened for writing outside the WorkQueue "
+            "append API",
+    "J010": "unguarded telemetry emission on a background-thread path "
+            "(the obs plane's never-fatal contract)",
+    "JP01": "malformed jaxlint pragma (bad form or unknown rule id) — "
+            "the pragma is ignored, not obeyed",
 }
 
 # jnp constructors that materialize a FRESH array with a default dtype,
@@ -33,42 +56,6 @@ _HOST_SYNC_CALLS = {"float", "int", "bool", "complex"}
 _HOST_SYNC_NP = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
 _HOST_SYNC_METHODS = {"item", "tolist"}
 
-# observability API (pulseportraiture_tpu.obs): host-side by contract.
-# Inside jit a span would time TRACING (the body runs once, at trace
-# time) and fit telemetry would sync or silently no-op — both are
-# misuse, flagged regardless of argument tracedness.  Matched as
-# ``obs.<name>`` (the repo's import idiom) or the bare re-exported
-# telemetry entry points.
-_OBS_API_NAMES = {"span", "phases", "event", "counter", "gauge",
-                  "fit_telemetry", "configure", "run", "scoped_run",
-                  "trace_capture"}
-_OBS_BARE_CALLS = {"fit_telemetry", "trace_capture"}
-
-# streaming metrics (pulseportraiture_tpu.obs.metrics): host-side by
-# contract — under jit an observe() would record the trace-time value
-# once and never again, a timed() block would time TRACING, and the
-# registry/exporter locks and file IO cannot exist in compiled code.
-# Matched as ``metrics.<name>`` / ``obs.metrics.<name>`` (bare names
-# like ``observe``/``snapshot`` are too generic to match unqualified).
-_METRICS_API_NAMES = {"inc", "set_gauge", "observe", "timed",
-                      "snapshot", "render_prometheus", "render_watch",
-                      "evaluate_slo", "merge_snapshots",
-                      "load_snapshots", "last_snapshot", "quantile",
-                      "percentiles", "Histogram", "MetricsRegistry",
-                      "MetricsExporter"}
-
-# distributed tracing (pulseportraiture_tpu.obs.tracing): host-side by
-# contract — a trace id is a host string and the ambient context lives
-# in a thread-local; under jit a current()/activate() would capture the
-# TRACE-TIME context once and bake it into every execution, and an
-# emit_span's file IO cannot exist in compiled code.  Matched as
-# ``tracing.<name>`` / ``obs.tracing.<name>``.
-_TRACING_API_NAMES = {"current", "current_trace_id", "current_span_id",
-                      "mint", "activate", "new_trace_id",
-                      "new_span_id", "inject", "extract",
-                      "format_traceparent", "parse_traceparent",
-                      "emit_span", "link"}
-
 # parameter names that (by repo convention) carry trace identity as
 # host strings; seeing one consumed by an array op inside jit means a
 # trace id was captured as a traced value — the id seen at trace time
@@ -76,95 +63,81 @@ _TRACING_API_NAMES = {"current", "current_trace_id", "current_span_id",
 _TRACE_ID_NAMES = {"trace_id", "span_id", "parent_span_id",
                    "traceparent", "trace_ctx"}
 
-# obs.devtime (profiler-capture ingestion): host-side FILE PARSING by
-# contract — inside jit it would read gigabyte traces at trace time
-# and its result could never feed compiled code.  Matched as
-# ``devtime.<name>`` / ``obs.devtime.<name>`` or the bare imports.
-_DEVTIME_API_NAMES = {"record_devtime", "summarize_region",
-                      "summarize_trace_dir", "parse_chrome_trace",
-                      "parse_xplane_scopes", "parse_xplane_memory",
-                      "self_times", "find_capture"}
-
-# obs.memory (watermark sampler / OOM forensics): host-side by
-# contract — a sample() reads /proc and device allocator stats, a
-# watermarks() mutates the recorder's mark table under a lock, and a
-# device_memory_dump writes a file; none of that can exist in compiled
-# code, and under jit each would capture one trace-time value forever.
-# Matched as ``memory.<name>`` / ``obs.memory.<name>``.
-_MEMORY_API_NAMES = {"sample", "watermarks", "last", "host_rss_bytes",
-                     "record_oom", "is_oom", "device_memory_dump",
-                     "memory_interval", "MemoryState"}
-
-# obs.quality (fit-quality fingerprints): host-side by contract —
-# record_archive / summarize pull per-subint arrays through numpy,
-# bump recorder counters under a lock and append a JSONL event; under
-# jit each would fingerprint the tracer seen at trace time (and the
-# runtime _has_tracer guard degrades them to no-ops anyway — the call
-# is dead code inside a trace).  Matched as ``quality.<name>`` /
-# ``obs.quality.<name>``.
-_QUALITY_API_NAMES = {"record_archive", "summarize", "fingerprint",
-                      "group_fingerprints", "gt_fingerprint",
-                      "whiteness_r1", "QualityState"}
-
-# survey-runner API (pulseportraiture_tpu.runner): host-side
-# orchestration by contract — file IO (header scans, JSONL ledger
-# appends, checkpoint rewrites) and process partitioning have no
-# meaning inside a trace.  Matched as ``runner.<name>`` or the bare
-# imported entry points.  The workload subsystem (runner/workloads.py)
-# is part of the same contract: registry lookups, JSONL checkpoint
-# appends and ledger transitions are host-side engine plumbing.
-_RUNNER_API_NAMES = {"plan_survey", "run_survey", "scan_archive_header",
-                     "pad_databunch", "canonical_shape", "survey_status",
-                     "merge_obs_shards", "WorkQueue",
-                     "resolve_workload", "get_workload",
-                     "register_workload", "workload_names",
-                     "read_jsonl_checkpoint", "append_jsonl_checkpoint",
-                     "drop_jsonl_checkpoint_blocks"}
-
-# chaos harness (pulseportraiture_tpu.testing.faults): fault sites are
-# host-only by construction — a check() under jit would fire once at
-# trace time, and the injected control flow (raise / hang / signal)
-# cannot exist in compiled code.  Matched as ``faults.<name>`` /
-# ``testing.faults.<name>`` (the bare name ``check`` is far too
-# generic to match unqualified).
-_FAULTS_API_NAMES = {"check", "configure", "reset", "fired", "active",
-                     "spec_string"}
-
-# host prefetch pipeline (pulseportraiture_tpu.runner.prefetch + the
-# archive loaders it schedules): thread pools, hand-off events and
-# FITS decode are host-side by construction — under jit a submit would
-# spawn threads at trace time and the decoded buffer could never feed
-# the compiled program.  The generic method names (submit, consume,
-# stop, ...) match only behind a ``prefetch.``/``prefetcher.`` head;
-# the distinctive entry points also match bare.
-_PREFETCH_METHOD_NAMES = {"submit", "try_submit", "consume", "discard",
-                          "stop"}
-_PREFETCH_BARE_NAMES = {"HostPrefetcher", "PrefetchTicket",
-                        "load_bucketed_databunch", "load_archive_data"}
-
-# TOA service (pulseportraiture_tpu.service): host-side daemon
-# orchestration by contract — socket IO, ledger intake, thread
-# barriers and warm-up drive the jit boundary from OUTSIDE; under jit
-# each call would fire once at trace time and its threading/file IO
-# cannot exist in compiled code.  Matched as ``service.<name>`` or the
-# bare exported entry points.
-_SERVICE_API_NAMES = {"TOAService", "MicroBatcher", "ServiceServer",
-                      "warm_plan", "program_specs", "client_request",
-                      "synth_databunch", "enable_persistent_cache"}
-
-# warm core (pulseportraiture_tpu.runner.warm, re-exported by
-# service.warm): host-side by contract — warm drives the jit boundary
-# from OUTSIDE (AOT lower/compile into the persistent cache, synthetic
-# archive IO, per-program obs events); under jit a warm call would
-# fire once at trace time and its compilation/file IO cannot exist in
-# compiled code.  The entry points shared with the service shim
-# (warm_plan, program_specs, ...) already match bare via
-# _SERVICE_API_NAMES; this set adds the ``warm.``/``runner.warm.``
-# heads plus the warm-only names, which also match bare.
-_WARM_API_NAMES = {"warm_plan", "program_specs", "synth_databunch",
-                   "enable_persistent_cache", "WarmSpec",
-                   "solver_program", "write_warm_archive"}
-_WARM_BARE_NAMES = {"solver_program", "write_warm_archive"}
+# J002 host-API matching is inventory-driven (inventory.py scans the
+# package tree); only the MESSAGE per subsystem family stays curated
+# here, because the rationale is the useful part of a finding.
+_J002_FAMILY_MSG = {
+    "obs": "obs API call inside a jitted function — telemetry is "
+           "host-side by contract: under jit a span times tracing "
+           "(the body runs once, at trace time) and fit telemetry "
+           "would sync a traced value; move it after the jit "
+           "boundary (docs/OBSERVABILITY.md)",
+    "metrics": "obs.metrics call inside a jitted function — "
+               "streaming metrics are host-side by contract: under "
+               "jit an observe() records the trace-time value once, "
+               "a timed() block times tracing, and the registry "
+               "locks / snapshot IO cannot exist in compiled code; "
+               "record after the jit boundary "
+               "(docs/OBSERVABILITY.md)",
+    "tracing": "obs.tracing call inside a jitted function — trace "
+               "context is host-side by contract: under jit the "
+               "ambient context read at trace time is baked into "
+               "every execution of the compiled program, and span "
+               "emission's file IO cannot exist in compiled code; "
+               "propagate context around the jit boundary "
+               "(docs/OBSERVABILITY.md)",
+    "devtime": "obs.devtime call inside a jitted function — "
+               "profiler-capture ingestion is host-side file "
+               "parsing; under jit it runs once at trace time and "
+               "cannot see the program it is part of "
+               "(docs/OBSERVABILITY.md)",
+    "memory": "obs.memory call inside a jitted function — memory "
+              "watermarks are host-side by contract: a sample reads "
+              "/proc and allocator stats once at trace time, and the "
+              "sampler's locks / dump-file IO cannot exist in "
+              "compiled code; sample around the jit boundary "
+              "(docs/OBSERVABILITY.md)",
+    "quality": "obs.quality call inside a jitted function — "
+               "fit-quality fingerprints are host-side by contract: "
+               "they pull per-subint arrays through numpy and append "
+               "recorder events, none of which can exist in compiled "
+               "code; record quality after the device_get boundary "
+               "(docs/OBSERVABILITY.md)",
+    "faults": "testing.faults call inside a jitted function — "
+              "fault-injection sites are host-only by construction: "
+              "under jit the check fires once at trace time, and the "
+              "injected raise/hang/signal cannot exist in compiled "
+              "code (docs/RUNNER.md)",
+    "runner": "survey-runner call inside a jitted function — the "
+              "runner is host-side orchestration (header scans, "
+              "ledger appends, checkpoint rewrites); under jit it "
+              "would run once at trace time and its file IO is "
+              "unreachable from compiled code (docs/RUNNER.md)",
+    "prefetch": "host-prefetch call inside a jitted function — the "
+                "prefetch pipeline is host-side by construction "
+                "(worker threads, hand-off events, FITS decode); "
+                "under jit it would run once at trace time and its "
+                "buffers cannot feed compiled code (docs/RUNNER.md "
+                "Host pipeline)",
+    "warm": "warm-core call inside a jitted function — zero-cold-"
+            "start warm drives the jit boundary from OUTSIDE (AOT "
+            "lower/compile into the persistent compile cache, "
+            "synthetic-archive IO, per-program obs events); under "
+            "jit it would fire once at trace time and its "
+            "compilation/file IO cannot exist in compiled code "
+            "(docs/RUNNER.md Warm start)",
+    "service": "TOA-service call inside a jitted function — the "
+               "service is host-side daemon orchestration (socket "
+               "IO, ledger intake, micro-batch barriers, warm-up); "
+               "under jit it would run once at trace time and its "
+               "threading/file IO cannot exist in compiled code "
+               "(docs/SERVICE.md)",
+}
+_J002_GENERIC_MSG = (
+    "host-side API call inside a jitted function — this name is part "
+    "of the scanned pulseportraiture_tpu/{obs,runner,service,testing} "
+    "surface, which is orchestration/telemetry by contract and "
+    "cannot exist in compiled code (docs/LINTING.md J002)")
 
 _JNP_PREFIXES = ("jnp.", "jax.numpy.")
 
@@ -286,6 +259,7 @@ class RuleVisitor(ast.NodeVisitor):
         self.dtype_scope = any(p in ("ops", "fit") for p in parts)
         self.is_config = parts[-1] == "config.py" if parts else False
         self.stack = []
+        self._inv = host_inventory()
         # inner jit-calls already reported as immediate invocations
         self._reported_jit_calls = set()
 
@@ -454,43 +428,6 @@ class RuleVisitor(ast.NodeVisitor):
                           "function — host sync breaks tracing"
                           % node.func.attr)
             elif fname is not None and (
-                    (fname.startswith("obs.")
-                     and fname.split(".", 1)[1] in _OBS_API_NAMES)
-                    or fname in _OBS_BARE_CALLS):
-                self._add("J002", node,
-                          "obs API call inside a jitted function — "
-                          "telemetry is host-side by contract: under "
-                          "jit a span times tracing (the body runs "
-                          "once, at trace time) and fit telemetry "
-                          "would sync a traced value; move it after "
-                          "the jit boundary (docs/OBSERVABILITY.md)")
-            elif fname is not None and (
-                    fname.rsplit(".", 1)[-1] in _METRICS_API_NAMES
-                    and fname.startswith(("metrics.",
-                                          "obs.metrics."))):
-                self._add("J002", node,
-                          "obs.metrics call inside a jitted function "
-                          "— streaming metrics are host-side by "
-                          "contract: under jit an observe() records "
-                          "the trace-time value once, a timed() block "
-                          "times tracing, and the registry locks / "
-                          "snapshot IO cannot exist in compiled code; "
-                          "record after the jit boundary "
-                          "(docs/OBSERVABILITY.md)")
-            elif fname is not None and (
-                    fname.rsplit(".", 1)[-1] in _TRACING_API_NAMES
-                    and fname.startswith(("tracing.",
-                                          "obs.tracing."))):
-                self._add("J002", node,
-                          "obs.tracing call inside a jitted function "
-                          "— trace context is host-side by contract: "
-                          "under jit the ambient context read at "
-                          "trace time is baked into every execution "
-                          "of the compiled program, and span "
-                          "emission's file IO cannot exist in "
-                          "compiled code; propagate context around "
-                          "the jit boundary (docs/OBSERVABILITY.md)")
-            elif fname is not None and (
                     fname.startswith(_JNP_PREFIXES
                                      + ("jax.lax.", "lax."))
                     and any(isinstance(a, ast.Name)
@@ -505,41 +442,6 @@ class RuleVisitor(ast.NodeVisitor):
                           "sync to read it back); keep trace ids "
                           "outside the jit boundary "
                           "(docs/OBSERVABILITY.md)")
-            elif fname is not None and (
-                    fname.rsplit(".", 1)[-1] in _DEVTIME_API_NAMES
-                    and (fname in _DEVTIME_API_NAMES
-                         or fname.startswith(("devtime.",
-                                              "obs.devtime.")))):
-                self._add("J002", node,
-                          "obs.devtime call inside a jitted function "
-                          "— profiler-capture ingestion is host-side "
-                          "file parsing; under jit it runs once at "
-                          "trace time and cannot see the program it "
-                          "is part of (docs/OBSERVABILITY.md)")
-            elif fname is not None and (
-                    fname.rsplit(".", 1)[-1] in _MEMORY_API_NAMES
-                    and fname.startswith(("memory.",
-                                          "obs.memory."))):
-                self._add("J002", node,
-                          "obs.memory call inside a jitted function "
-                          "— memory watermarks are host-side by "
-                          "contract: a sample reads /proc and "
-                          "allocator stats once at trace time, and "
-                          "the sampler's locks / dump-file IO cannot "
-                          "exist in compiled code; sample around the "
-                          "jit boundary (docs/OBSERVABILITY.md)")
-            elif fname is not None and (
-                    fname.rsplit(".", 1)[-1] in _QUALITY_API_NAMES
-                    and fname.startswith(("quality.",
-                                          "obs.quality."))):
-                self._add("J002", node,
-                          "obs.quality call inside a jitted function "
-                          "— fit-quality fingerprints are host-side "
-                          "by contract: they pull per-subint arrays "
-                          "through numpy and append recorder events, "
-                          "none of which can exist in compiled code; "
-                          "record quality after the device_get "
-                          "boundary (docs/OBSERVABILITY.md)")
             elif fname in ("jax.named_scope", "named_scope") and \
                     node.args and self._refs_traced(node.args[0]):
                 self._add("J002", node,
@@ -549,67 +451,16 @@ class RuleVisitor(ast.NodeVisitor):
                           "host sync (or burns the value seen at "
                           "trace time into every execution); use a "
                           "static label (docs/OBSERVABILITY.md)")
-            elif fname is not None and (
-                    fname.rsplit(".", 1)[-1] in _FAULTS_API_NAMES
-                    and fname.startswith(("faults.",
-                                          "testing.faults."))):
+            elif fname is not None and "." in fname and \
+                    self._inv.match_dotted(fname) is not None:
+                _head, _attr, fam = self._inv.match_dotted(fname)
                 self._add("J002", node,
-                          "testing.faults call inside a jitted "
-                          "function — fault-injection sites are "
-                          "host-only by construction: under jit the "
-                          "check fires once at trace time, and the "
-                          "injected raise/hang/signal cannot exist in "
-                          "compiled code (docs/RUNNER.md)")
-            elif fname is not None and (
-                    (fname.startswith("runner.")
-                     and fname.split(".", 1)[1] in _RUNNER_API_NAMES)
-                    or fname in _RUNNER_API_NAMES):
+                          _J002_FAMILY_MSG.get(fam, _J002_GENERIC_MSG))
+            elif fname is not None and "." not in fname and \
+                    self._inv.match_bare(fname) is not None:
+                fam = self._inv.match_bare(fname)
                 self._add("J002", node,
-                          "survey-runner call inside a jitted function "
-                          "— the runner is host-side orchestration "
-                          "(header scans, ledger appends, checkpoint "
-                          "rewrites); under jit it would run once at "
-                          "trace time and its file IO is unreachable "
-                          "from compiled code (docs/RUNNER.md)")
-            elif fname is not None and (
-                    (fname.rsplit(".", 1)[-1] in _PREFETCH_METHOD_NAMES
-                     and fname.startswith(("prefetch.", "prefetcher.",
-                                           "runner.prefetch.")))
-                    or fname.rsplit(".", 1)[-1] in _PREFETCH_BARE_NAMES):
-                self._add("J002", node,
-                          "host-prefetch call inside a jitted function "
-                          "— the prefetch pipeline is host-side by "
-                          "construction (worker threads, hand-off "
-                          "events, FITS decode); under jit it would "
-                          "run once at trace time and its buffers "
-                          "cannot feed compiled code (docs/RUNNER.md "
-                          "Host pipeline)")
-            elif fname is not None and (
-                    (fname.rsplit(".", 1)[-1] in _WARM_API_NAMES
-                     and fname.startswith(("warm.", "runner.warm.")))
-                    or fname in _WARM_BARE_NAMES):
-                self._add("J002", node,
-                          "warm-core call inside a jitted function — "
-                          "zero-cold-start warm drives the jit "
-                          "boundary from OUTSIDE (AOT lower/compile "
-                          "into the persistent compile cache, "
-                          "synthetic-archive IO, per-program obs "
-                          "events); under jit it would fire once at "
-                          "trace time and its compilation/file IO "
-                          "cannot exist in compiled code "
-                          "(docs/RUNNER.md Warm start)")
-            elif fname is not None and (
-                    (fname.startswith("service.")
-                     and fname.split(".", 1)[1] in _SERVICE_API_NAMES)
-                    or fname in _SERVICE_API_NAMES):
-                self._add("J002", node,
-                          "TOA-service call inside a jitted function "
-                          "— the service is host-side daemon "
-                          "orchestration (socket IO, ledger intake, "
-                          "micro-batch barriers, warm-up); under jit "
-                          "it would run once at trace time and its "
-                          "threading/file IO cannot exist in compiled "
-                          "code (docs/SERVICE.md)")
+                          _J002_FAMILY_MSG.get(fam, _J002_GENERIC_MSG))
             elif fname is not None and "." in fname:
                 head, attr = fname.rsplit(".", 1)
                 if attr in _HOST_SYNC_METHODS and \

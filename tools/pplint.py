@@ -1,0 +1,15 @@
+"""pplint — alias entry point for the grown jaxlint analyzer.
+
+``python -m tools.pplint`` and ``python -m tools.jaxlint`` are the
+same tool; the jaxlint name is kept because every pragma, doc and CI
+stage already spells it, the pplint name because the analyzer long ago
+outgrew "jit lint" (concurrency, protocol and drift checking —
+docs/LINTING.md).
+"""
+
+import sys
+
+from .jaxlint.__main__ import main
+
+if __name__ == "__main__":
+    sys.exit(main())
