@@ -705,6 +705,15 @@ def _bench():
             if st is not None:
                 result["extra"]["peak_device_bytes"] = \
                     st.run_peak_bytes
+    # health plane (obs/health.py): a committed BENCH line that fired
+    # alerts mid-bench documents it — obs_diff's new-alerts gate then
+    # catches a candidate that alerts where the baseline did not
+    rec = obs.current()
+    if rec is not None:
+        result["extra"]["alerts_fired"] = int(
+            rec.counters.get("alerts_fired", 0))
+        result["extra"]["postmortems_written"] = int(
+            rec.counters.get("postmortems_written", 0))
     obs.event("result", payload=result)
     return result
 

@@ -12,6 +12,7 @@ submit/inspect over the daemon's local socket.
     python -m pulseportraiture_tpu.cli.ppserve submit -w workdir \\
         -t alice --wait archive.fits
     python -m pulseportraiture_tpu.cli.ppserve status -w workdir
+    python -m pulseportraiture_tpu.cli.ppserve health -w workdir
     python -m pulseportraiture_tpu.cli.ppserve shutdown -w workdir
 
 SIGTERM/SIGINT drain the daemon: intake starts rejecting, everything
@@ -121,6 +122,8 @@ def build_parser():
     sb.add_argument("archives", nargs="+")
 
     for name, help_text in (("status", "Daemon status snapshot."),
+                            ("health", "Liveness/readiness probe + "
+                                       "firing alerts."),
                             ("shutdown", "Begin a graceful drain."),
                             ("ping", "Liveness check.")):
         c = sub.add_parser(name, help=help_text)
@@ -276,7 +279,8 @@ def _cmd_simple(op):
         from ..service import client_request
 
         resp = client_request(_socket_path(args), {"op": op})
-        print(json.dumps(resp, indent=1 if op == "status" else None))
+        print(json.dumps(
+            resp, indent=1 if op in ("status", "health") else None))
         return 0 if resp.get("ok") else 1
     return run
 
@@ -334,6 +338,7 @@ def main(argv=None):
         return 1
     return {"start": _cmd_start, "warm": _cmd_warm,
             "submit": _cmd_submit, "status": _cmd_status,
+            "health": _cmd_simple("health"),
             "shutdown": _cmd_simple("shutdown"),
             "ping": _cmd_simple("ping")}[args.command](args)
 

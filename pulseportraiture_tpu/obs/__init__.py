@@ -33,6 +33,15 @@ Layout:
   gauges + log-bucketed latency histograms with exact deterministic
   merge, periodic ``metrics.jsonl`` snapshots, Prometheus text
   rendering, SLO evaluation (``pploadgen``), the ``--watch`` frames
+* :mod:`.health`   — live health plane: declarative alert rules
+  (threshold / rate / ratio / SLO burn-rate) over windowed registry
+  snapshots with a pending→firing→resolved lifecycle
+  (``alert_firing`` / ``alert_resolved`` events, the
+  ``pps_alerts_firing`` / ``pps_alerts_total`` series), evaluated on
+  the exporter cadence and each claim cycle
+* :mod:`.flight`   — flight recorder: always-on bounded in-memory
+  ring of recent events that freezes into postmortem bundles
+  (``<run>/postmortem/``) on OOM/watchdog/quarantine/alert triggers
 * :mod:`.tracing`  — distributed tracing: ``trace_id`` / ``span_id``
   / ``parent_span_id`` on every span and event via a thread-ambient
   context, ``traceparent`` carriers across processes, span links for
@@ -47,8 +56,8 @@ contract (jaxlint J002 enforces it statically; ``fit_telemetry``
 additionally passes tracers through untouched at runtime).
 """
 
-from . import (devtime, memory, metrics, monitor, quality,  # noqa: F401
-               tracing)
+from . import (devtime, flight, health, memory, metrics,  # noqa: F401
+               monitor, quality, tracing)
 from .core import (Recorder, configure, counter, current, enabled,
                    event, fit_telemetry, gauge, list_event_files,
                    obs_dir, obs_max_bytes, phases, run, scoped_run,
@@ -57,8 +66,8 @@ from .merge import merge_obs_shards
 from .trace import trace_capture, trace_dir
 
 __all__ = ["Recorder", "configure", "counter", "current", "devtime",
-           "enabled", "event", "fit_telemetry", "gauge",
-           "list_event_files", "memory", "merge_obs_shards", "metrics",
-           "obs_dir", "obs_max_bytes", "phases", "quality", "run",
-           "scoped_run", "span", "trace_capture", "trace_dir",
+           "enabled", "event", "fit_telemetry", "flight", "gauge",
+           "health", "list_event_files", "memory", "merge_obs_shards",
+           "metrics", "obs_dir", "obs_max_bytes", "phases", "quality",
+           "run", "scoped_run", "span", "trace_capture", "trace_dir",
            "monitor", "tracing"]

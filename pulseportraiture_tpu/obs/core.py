@@ -157,6 +157,12 @@ class Recorder:
         self.events_path = os.path.join(self.dir, "events.jsonl")
         self.manifest_path = os.path.join(self.dir, "manifest.json")
         self._lock = threading.Lock()
+        # always-on flight ring (obs/flight.py): every emitted event
+        # also lands in a bounded in-memory deque, so a postmortem can
+        # show the last few seconds even when the sink itself is dead
+        from .flight import FlightRecorder
+
+        self.flight = FlightRecorder(self)
         self._fh = open(self.events_path, "a", encoding="utf-8")
         # size-based sink rotation (PPTPU_OBS_MAX_BYTES): survey-scale
         # runs emit one fit event per archive batch and must not grow
@@ -190,6 +196,10 @@ class Recorder:
         # the first quality record — a run that fits nothing pays
         # nothing
         self._quality = None
+        # alert-rule engine (obs/health.py): created lazily on the
+        # first health evaluation (runner claim cycle, service health
+        # verb), same gating as the states above
+        self._health = None
         self._closed = False
 
     def metrics_registry(self):
@@ -244,12 +254,45 @@ class Recorder:
                     return None
             return self._quality
 
+    def health_state(self):
+        """The run's alert-rule engine (obs/health.py), created on
+        first use; None when PPTPU_HEALTH=0 or creation failed —
+        never fatal."""
+        st = self._health
+        if st is not None:
+            return st
+        from .health import HealthState, health_enabled
+
+        if not health_enabled():
+            return None
+        # materialize the registry first: HealthState samples it, and
+        # self._lock is not reentrant
+        self.metrics_registry()
+        with self._lock:
+            if self._health is None and not self._closed:
+                try:
+                    # registry materialized above: no re-entry (jaxlint J007)
+                    self._health = HealthState(self)  # jaxlint: disable=J007
+                except Exception:
+                    return None
+        exporter = self._metrics_exporter
+        if exporter is not None and self._health is not None:
+            # evaluate on the exporter cadence, just before each
+            # periodic snapshot, so the alert gauges land in the
+            # metrics.jsonl line that tick writes
+            exporter.on_tick = self._health.evaluate
+        return self._health
+
     # -- event stream ---------------------------------------------------
 
     def emit(self, kind, **fields):
         """Append one timestamped JSON event; never raises."""
         rec = {"t": round(time.time(), 6), "kind": kind}
         rec.update(fields)
+        # flight ring first (obs/flight.py): the in-memory trail must
+        # survive a sink-write failure — that failure is exactly what
+        # a postmortem needs to explain
+        self.flight.record(rec)
         try:
             line = json.dumps(rec, default=_json_default)
         except Exception:
@@ -295,6 +338,23 @@ class Recorder:
     def bump(self, name, inc=1):
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + inc
+
+    def counter(self, name, inc=1):
+        """Per-recorder form of the module-level :func:`counter` (the
+        health/flight planes bump their own recorder's counters)."""
+        self.bump(name, inc)
+
+    def event(self, name, **fields):
+        """Per-recorder form of the module-level :func:`event`: a
+        one-off JSON event on THIS recorder, ambient-trace-stamped
+        the same way (the health/flight planes emit their lifecycle
+        events through the recorder they observe)."""
+        ctx = getattr(_tls, "trace", None)
+        if ctx is not None:
+            fields.setdefault("trace_id", ctx[0])
+            if ctx[1] is not None:
+                fields.setdefault("span_id", ctx[1])
+        self.emit("event", name=name, **fields)
 
     def set_gauge(self, name, value):
         with self._lock:
@@ -382,6 +442,14 @@ class Recorder:
             # must make the manifest written below
             try:
                 self._quality.stop()
+            except Exception:
+                pass
+        if self._health is not None:
+            # final rule pass BEFORE the exporter stop: the closing
+            # alert gauges must land in the final metrics.jsonl
+            # snapshot
+            try:
+                self._health.stop()
             except Exception:
                 pass
         if self._metrics_exporter is not None:

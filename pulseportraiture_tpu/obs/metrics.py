@@ -436,6 +436,10 @@ class MetricsExporter:
         self.interval_s = metrics_interval() if interval_s is None \
             else float(interval_s)
         self.dropped = 0
+        # health-plane hook (obs/health.py): called before each
+        # periodic snapshot so that tick's alert gauges land in the
+        # metrics.jsonl line it writes; failures never kill the loop
+        self.on_tick = None
         self._stop = threading.Event()
         self._thread = None
         if self.interval_s > 0:
@@ -446,6 +450,12 @@ class MetricsExporter:
 
     def _loop(self):
         while not self._stop.wait(self.interval_s):
+            cb = self.on_tick
+            if cb is not None:
+                try:
+                    cb()
+                except Exception:
+                    pass
             self.write_snapshot()
 
     def write_snapshot(self):
@@ -880,6 +890,45 @@ def _compile_cache_row(snap):
                                                          rate)
 
 
+def _alerts_row(snap):
+    """The ``--watch`` alerts line (obs/health.py): firing rules from
+    the rule-labeled ``pps_alerts_firing`` gauges (a rule counts as
+    firing when its flag is truthy on ANY ``p<proc>/`` merge prefix —
+    gauges are never summed into rates) plus the fired total from the
+    ``pps_alerts_total`` counters (summed across prefixes); None when
+    the snapshot carries no alert series (pre-health runs keep their
+    original frame)."""
+    firing = set()
+    seen = False
+    for key, v in (snap.get("gauges") or {}).items():
+        name, labels = parse_series(key.rsplit("/", 1)[-1])
+        if name != "pps_alerts_firing":
+            continue
+        seen = True
+        rule = labels.get("rule")
+        try:
+            if rule and float(v):
+                firing.add(rule)
+        except (TypeError, ValueError):
+            continue
+    fired = 0
+    for key, v in (snap.get("counters") or {}).items():
+        name, _labels = parse_series(key.rsplit("/", 1)[-1])
+        if name != "pps_alerts_total":
+            continue
+        seen = True
+        try:
+            fired += int(v)
+        except (TypeError, ValueError):
+            continue
+    if not seen:
+        return None
+    if firing:
+        return "alerts: %d firing (%s)  %d fired total" % (
+            len(firing), ", ".join(sorted(firing)), fired)
+    return "alerts: none firing  %d fired total" % fired
+
+
 def render_watch(snap, prev=None, title=""):
     """A terminal dashboard frame from one snapshot (pptop-style).
 
@@ -976,6 +1025,11 @@ def render_watch(snap, prev=None, title=""):
         if not mem and not qual:
             lines.append("")
         lines.append(cache)
+    alerts = _alerts_row(snap)
+    if alerts:
+        if not mem and not qual and not cache:
+            lines.append("")
+        lines.append(alerts)
     if gauges:
         lines.append("")
         lines.append("gauges: " + "  ".join(

@@ -61,7 +61,7 @@ import time
 import numpy as np
 
 from .. import obs
-from ..obs import memory, metrics, quality, tracing
+from ..obs import flight, health, memory, metrics, quality, tracing
 from ..obs.merge import merge_obs_shards, write_shard
 from ..obs.metrics import PHASE_HISTOGRAM
 from ..pipelines.toas import _PRELOAD_MISS, GetTOAs, \
@@ -422,6 +422,9 @@ def _try_claim(queue, wl, info, owner, workdir, ipass, pid, t_arch0,
                   prev_owner=prev_rec.get("owner"),
                   lease_expires_at=prev_rec.get("lease_expires_at"))
         obs.counter("leases_expired")
+        # health-rule signal (obs/health.py lease_expiry_spike): the
+        # metrics twin of the manifest counter, windowable live
+        metrics.inc("pps_lease_expirations_total")
     takeover = claim.get("takeover_from")
     n_scrubbed = 0
     if takeover:
@@ -529,6 +532,16 @@ def _fit_one(gt, queue, info, checkpoint, padded, get_toas_kw, quiet,
               workload=queue.workload,
               state=rec["state"], attempts=rec.get("attempts", 0),
               reason=rec.get("reason"))
+    if rec["state"] == QUARANTINED:
+        # every quarantine path (OOM, poison, retries exhausted) feeds
+        # the quarantine_spike health rule and freezes a postmortem of
+        # the events that led here — the runner_archive record above
+        # is already in the flight ring when the bundle is cut
+        reason = str(rec.get("reason") or "")
+        metrics.inc("pps_quarantined_total", workload=queue.workload)
+        flight.dump("oom" if reason.startswith("oom") else "quarantine",
+                    archive=info.path, workload=queue.workload,
+                    reason=reason[:200])
     return rec["state"]
 
 
@@ -579,6 +592,10 @@ def _fit_one_guarded(wl, state, queue, info, checkpoint, padded, quiet,
         obs.event("watchdog_fired", archive=info.path,
                   timeout_s=watchdog_s)
         obs.counter("watchdog_fired")
+        # freeze the trail while it is hot: the ring still holds the
+        # spans/events of the dispatch that just wedged
+        flight.dump("watchdog", archive=info.path,
+                    timeout_s=watchdog_s)
         if not queue.owns(info.path, refresh=True):
             # the hang outlived the lease and someone took over: the
             # taker's record stands, the watchdog records nothing
@@ -935,6 +952,10 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                             plan, modelfile, get_toas_kw=get_toas_kw,
                             narrowband=narrowband, quiet=quiet,
                             workloads=(wl.name,))
+                    # compile-cache misses after this point are a
+                    # zero-cold-start leak, not a cold start: arm the
+                    # compile_cache_postwarm health rule's guard
+                    metrics.set_gauge("pps_warm_complete", 1)
                 except Exception as e:
                     # never fatal: the run proceeds with first-use
                     # compiles
@@ -1192,6 +1213,10 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                         outstanding = queue.outstanding()
                         metrics.set_gauge("pps_outstanding",
                                           len(outstanding))
+                        # live health pass on the claim cadence, so
+                        # alert rules advance even when the exporter
+                        # thread is disabled (PPTPU_METRICS_INTERVAL=0)
+                        health.evaluate()
                         if stop or drain["sig"] or not outstanding:
                             break
                         if ran:

@@ -62,7 +62,8 @@ import time
 
 from .. import obs
 from ..io.timfile import format_toa_line
-from ..obs import memory, metrics, quality, tracing
+from ..obs import flight, memory, metrics, quality, tracing
+from ..obs import health as obs_health
 from ..obs.metrics import PHASE_HISTOGRAM
 from ..obs.core import Recorder
 from ..runner.execute import _BucketedGetTOAs, _fit_one
@@ -323,6 +324,14 @@ class TOAService:
                     "run_bytes_max": self.run_bytes_max,
                     "mem_budget_bytes": self.mem_budget_bytes,
                     "prefetch": self.prefetch}))
+        if self.mem_budget_bytes:
+            # the memory_watermark health rule prices device usage
+            # against this budget gauge (obs/health.py)
+            metrics.set_gauge("pps_mem_budget_bytes",
+                              self.mem_budget_bytes)
+        # prime the alert-rule engine so the exporter evaluates on
+        # every snapshot tick from the first one
+        obs_health.evaluate()
         if self.prefetch:
             # before recovery: recovered requests prefetch like fresh
             # ones, so a restarted daemon's first cycle is warm too
@@ -359,6 +368,9 @@ class TOAService:
             # happened before the first request (docs/SERVICE.md)
             obs.gauge("warm_backend_compiles",
                       int(rec.counters.get("backend_compiles", 0)))
+        # compile-cache misses after this point are a warm-path leak:
+        # arm the compile_cache_postwarm health rule's guard
+        metrics.set_gauge("pps_warm_complete", 1)
         return self.warm_summary
 
     def request_drain(self):
@@ -890,6 +902,13 @@ class TOAService:
                           tenant=rq.tenant)
         metrics.set_gauge("pps_open_requests", len(self._requests))
         self._emit_request(rq, "terminal")
+        if state != DONE:
+            # quarantine forensics: the terminal service_request event
+            # above is already in the flight ring when the bundle is
+            # cut, and the quarantine_spike health rule sees the inc
+            metrics.inc("pps_quarantined_total", tenant=rq.tenant)
+            flight.dump("quarantine", request=rq.id, tenant=rq.tenant,
+                        archive=rq.path, reason=str(reason)[:200])
         self._close_request_recorder(rq)
         rq.done_evt.set()
 
@@ -1019,4 +1038,34 @@ class TOAService:
                                      "backend_compiles",
                                      "compile_cache_hits",
                                      "compile_cache_misses")}
+        return out
+
+    def health(self):
+        """Liveness/readiness + firing alerts — the ``health`` socket
+        verb (docs/SERVICE.md), the probe surface a fleet router or
+        autoscaler consumes.  Liveness is the dispatcher thread;
+        readiness is "accepting new work" (live and not draining).
+        Runs a fresh rule pass (obs/health.py) so the answer reflects
+        now, not the last exporter tick."""
+        alerts = obs_health.evaluate()
+        if alerts is None:
+            alerts = []
+        live = self._thread is not None and self._thread.is_alive()
+        with self._lock:
+            draining = self._draining
+            open_requests = len(self._requests)
+        out = {"ok": live,
+               "live": live,
+               "ready": live and not draining,
+               "draining": draining,
+               "open_requests": open_requests,
+               "alerts_firing": len(alerts),
+               "alerts": alerts}
+        rec = obs.current()
+        if rec is not None:
+            out["alerts_fired"] = int(
+                rec.counters.get("alerts_fired", 0))
+            out["postmortems_written"] = int(
+                rec.counters.get("postmortems_written", 0))
+            out["obs_run"] = rec.dir
         return out

@@ -16,6 +16,7 @@ Ops (all responses carry ``ok``)::
      "traceparent": "00-<32hex>-<16hex>-01"}   # optional W3C carrier
     {"op": "wait", "request_id": "r000001", "timeout_s": 300}
     {"op": "status"}
+    {"op": "health"}            # liveness/readiness + firing alerts
     {"op": "metrics"}           # live streaming-metrics snapshot
     {"op": "metrics", "format": "prometheus"}   # + text exposition
     {"op": "shutdown"}          # begins a drain; daemon exits 0 after
@@ -143,6 +144,8 @@ class ServiceServer:
                             timeout=req.get("timeout_s"))
         if op == "status":
             return svc.status()
+        if op == "health":
+            return svc.health()
         if op == "metrics":
             snap = svc.metrics_snapshot()
             resp = {"ok": True, "snapshot": snap}

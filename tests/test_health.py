@@ -1,0 +1,371 @@
+"""Health-plane + flight-recorder unit tests (the ISSUE 17 contracts).
+
+Covers what docs/OBSERVABILITY.md "Health & alerting" declares: the
+rule overlay (``PPTPU_HEALTH_RULES`` dict patches / list appends /
+garbage never fatal), ``PPTPU_HEALTH=0`` disables the plane, the
+pending→firing→resolved lifecycle over windowed counter deltas with
+its ``alert_firing`` / ``alert_resolved`` events and the
+``pps_alerts_firing`` / ``pps_alerts_total`` series, absent series
+never firing, guard/quiet gating, budget-derived thresholds, broken
+rules reading as healthy, the always-on flight ring
+(``PPTPU_FLIGHT_CAPACITY`` bound, 0 disables), postmortem bundle
+contents and the per-run dump cap, sanitized bundle filenames, and
+``load_postmortems`` skipping torn bundles — a dead shard's partial
+dump must never corrupt a survivor's forensics.
+"""
+
+import json
+import os
+import re
+
+import pytest
+
+from pulseportraiture_tpu import obs
+from pulseportraiture_tpu.obs import flight, health
+
+
+def _events(run_dir):
+    out = []
+    for path in obs.list_event_files(run_dir):
+        with open(path, encoding="utf-8") as fh:
+            out.extend(json.loads(ln) for ln in fh if ln.strip())
+    return out
+
+
+def _manifest(run_dir):
+    with open(os.path.join(run_dir, "manifest.json"),
+              encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _event_names(run_dir):
+    return [e.get("name") for e in _events(run_dir)
+            if e.get("kind") == "event"]
+
+
+# -- rule overlay (pure env parsing) ------------------------------------
+
+
+def test_health_rules_defaults_are_fresh_copies(monkeypatch):
+    monkeypatch.delenv("PPTPU_HEALTH_RULES", raising=False)
+    rules = health.health_rules()
+    assert [r["name"] for r in rules] == \
+        [r["name"] for r in health.BUILTIN_RULES]
+    # mutating the returned rules must not poison the builtins
+    rules[0]["threshold"] = 10 ** 9
+    assert health.BUILTIN_RULES[0]["threshold"] != 10 ** 9
+
+
+def test_health_rules_dict_overlay_patches_and_drops(monkeypatch):
+    monkeypatch.setenv("PPTPU_HEALTH_RULES", json.dumps({
+        "quarantine_spike": {"threshold": 1, "window_s": 5.0},
+        "retry_burn": {"disabled": True},
+    }))
+    rules = {r["name"]: r for r in health.health_rules()}
+    assert rules["quarantine_spike"]["threshold"] == 1
+    assert rules["quarantine_spike"]["window_s"] == 5.0
+    assert "retry_burn" not in rules
+    # untouched builtins ride through unchanged
+    assert rules["slo_burn"]["window_s"] == 120.0
+
+
+def test_health_rules_list_overlay_appends_valid_only(monkeypatch):
+    monkeypatch.setenv("PPTPU_HEALTH_RULES", json.dumps([
+        {"name": "custom", "kind": "rate",
+         "signal": ["pps_widgets_total"], "threshold": 1},
+        {"name": "no_kind"},          # missing kind: ignored
+        "garbage",                    # not a dict: ignored
+    ]))
+    rules = health.health_rules()
+    assert len(rules) == len(health.BUILTIN_RULES) + 1
+    assert rules[-1]["name"] == "custom"
+
+
+def test_health_rules_garbage_overlay_never_fatal(monkeypatch):
+    for raw in ("not json {", "42", '"a string"'):
+        monkeypatch.setenv("PPTPU_HEALTH_RULES", raw)
+        assert [r["name"] for r in health.health_rules()] == \
+            [r["name"] for r in health.BUILTIN_RULES]
+
+
+def test_health_enabled_env(monkeypatch):
+    monkeypatch.delenv("PPTPU_HEALTH", raising=False)
+    assert health.health_enabled()
+    monkeypatch.setenv("PPTPU_HEALTH", "0")
+    assert not health.health_enabled()
+
+
+# -- disabled / inactive paths ------------------------------------------
+
+
+def test_module_noops_without_active_run(monkeypatch):
+    monkeypatch.delenv("PPTPU_OBS_DIR", raising=False)
+    assert obs.current() is None
+    assert health.evaluate() is None
+    assert health.firing() == []
+    assert flight.dump("nobody-home") is None
+
+
+def test_health_disabled_by_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    monkeypatch.setenv("PPTPU_HEALTH", "0")
+    with obs.run("nohealth") as rec:
+        assert rec.health_state() is None
+        assert health.evaluate() is None
+        assert health.firing() == []
+
+
+def test_health_state_lazy_and_cached(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    with obs.run("lazy") as rec:
+        assert rec._health is None
+        hs = rec.health_state()
+        assert hs is not None
+        assert rec.health_state() is hs
+
+
+# -- rule lifecycle -----------------------------------------------------
+
+RATE_RULE = {"name": "qspike", "kind": "rate", "severity": "critical",
+             "signal": ("pps_quarantined_total",),
+             "op": ">=", "threshold": 2, "window_s": 30.0,
+             "for_s": 5.0, "summary": "test spike"}
+
+
+def test_rate_rule_pending_firing_resolved(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    with obs.run("life") as rec:
+        run_dir = rec.dir
+        reg = rec.metrics_registry()
+        hs = health.HealthState(rec, rules=[dict(RATE_RULE)])
+        # wire it where health_state() would, so flight bundles see
+        # the firing alerts
+        rec._health = hs
+        # absent series: healthy, not pending
+        assert hs.evaluate(now=1000.0) == []
+        assert hs.states()["qspike"]["state"] == "ok"
+
+        reg.inc("pps_quarantined_total", 3, tenant="a")
+        # breaching but inside for_s: pending, nothing fires yet
+        assert hs.evaluate(now=1001.0) == []
+        assert hs.states()["qspike"]["state"] == "pending"
+        assert rec.counters.get("alerts_fired", 0) == 0
+
+        # held past for_s: firing, with events/metrics/postmortem
+        firing = hs.evaluate(now=1007.0)
+        assert [a["rule"] for a in firing] == ["qspike"]
+        assert firing[0]["severity"] == "critical"
+        assert firing[0]["since"] == 1007.0
+        assert firing[0]["measured"]["delta"] == 3
+        snap = reg.snapshot()
+        assert snap["gauges"]["pps_alerts_firing"] == 1
+        assert snap["gauges"]['pps_alerts_firing{rule="qspike"}'] == 1
+        assert snap["counters"]['pps_alerts_total{rule="qspike"}'] == 1
+        assert rec.counters["alerts_fired"] == 1
+        bundles = flight.load_postmortems(run_dir)
+        assert [b["trigger"] for b in bundles] == ["alert:qspike"]
+        assert bundles[0]["alerts_firing"][0]["rule"] == "qspike"
+
+        # window slides past the burst: resolved, gauges drop to zero
+        assert hs.evaluate(now=1050.0) == []
+        assert hs.states()["qspike"]["state"] == "ok"
+        snap = reg.snapshot()
+        assert snap["gauges"]["pps_alerts_firing"] == 0
+        assert snap["gauges"]['pps_alerts_firing{rule="qspike"}'] == 0
+        assert rec.counters["alerts_resolved"] == 1
+        # re-firing is a fresh lifecycle, not a re-entry
+        reg.inc("pps_quarantined_total", 5, tenant="b")
+        hs.evaluate(now=1051.0)
+        assert hs.states()["qspike"]["state"] == "pending"
+    names = _event_names(run_dir)
+    assert "alert_firing" in names and "alert_resolved" in names
+    assert "postmortem_written" in names
+    fired = [e for e in _events(run_dir)
+             if e.get("name") == "alert_firing"][0]
+    assert fired["rule"] == "qspike" and fired["severity"] == "critical"
+    man = _manifest(run_dir)
+    assert man["counters"]["alerts_fired"] == 1
+    assert man["counters"]["alerts_resolved"] == 1
+    assert man["counters"]["postmortems_written"] == 1
+
+
+def test_guard_gauge_and_quiet_gating(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    with obs.run("gates") as rec:
+        reg = rec.metrics_registry()
+        guarded = {"name": "postwarm", "kind": "rate",
+                   "signal": ("pps_compile_cache_misses_total",),
+                   "guard_gauge": "pps_warm_complete",
+                   "guard_value": 1, "threshold": 1,
+                   "window_s": 60.0, "for_s": 0.0}
+        quiet = {"name": "stall", "kind": "rate",
+                 "signal": ("pps_prefetch_misses",),
+                 "quiet": ("pps_prefetch_hits",), "threshold": 1,
+                 "window_s": 60.0, "for_s": 0.0}
+        hs = health.HealthState(rec, rules=[guarded, quiet])
+        hs.evaluate(now=0.0)
+        reg.inc("pps_compile_cache_misses_total", 5)
+        reg.inc("pps_prefetch_misses", 5)
+        reg.inc("pps_prefetch_hits", 1)
+        # guard gauge unset + quiet counter moving: both stay armed-off
+        assert hs.evaluate(now=1.0) == []
+        # guard satisfied: the guarded rule fires; quiet still gated
+        reg.set_gauge("pps_warm_complete", 1)
+        reg.inc("pps_compile_cache_misses_total", 1)
+        firing = hs.evaluate(now=2.0)
+        assert [a["rule"] for a in firing] == ["postwarm"]
+        assert hs.states()["stall"]["state"] == "ok"
+
+
+def test_threshold_rule_budget_derived_limit(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    with obs.run("budget") as rec:
+        reg = rec.metrics_registry()
+        rule = {"name": "mem", "kind": "threshold",
+                "gauge": "pps_device_bytes_in_use",
+                "budget_gauge": "pps_mem_budget_bytes",
+                "budget_frac": 0.9, "op": ">=",
+                "window_s": 60.0, "for_s": 0.0}
+        hs = health.HealthState(rec, rules=[rule])
+        reg.set_gauge("pps_device_bytes_in_use", 950)
+        # no budget gauge published: the rule stays quiet
+        assert hs.evaluate(now=0.0) == []
+        reg.set_gauge("pps_mem_budget_bytes", 1000)
+        firing = hs.evaluate(now=1.0)
+        assert [a["rule"] for a in firing] == ["mem"]
+        assert firing[0]["measured"]["limit"] == pytest.approx(900.0)
+        reg.set_gauge("pps_device_bytes_in_use", 100)
+        assert hs.evaluate(now=2.0) == []
+        assert rec.counters["alerts_resolved"] == 1
+
+
+def test_broken_and_unknown_rules_read_healthy(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    with obs.run("broken") as rec:
+        rec.metrics_registry()
+        rules = [{"name": "nogauge", "kind": "threshold"},
+                 {"name": "mystery", "kind": "quantum"},
+                 dict(RATE_RULE, for_s=0.0, threshold=1)]
+        hs = health.HealthState(rec, rules=rules)
+        assert hs.evaluate(now=0.0) == []
+        # the broken rule didn't wedge the evaluator for later passes
+        rec.metrics_registry().inc("pps_quarantined_total")
+        firing = hs.evaluate(now=1.0)
+        assert [a["rule"] for a in firing] == ["qspike"]
+
+
+# -- flight recorder ----------------------------------------------------
+
+
+def test_flight_env_parsing(monkeypatch):
+    monkeypatch.delenv("PPTPU_FLIGHT_CAPACITY", raising=False)
+    monkeypatch.delenv("PPTPU_FLIGHT_MAX_DUMPS", raising=False)
+    assert flight.flight_capacity() == 256
+    assert flight.flight_max_dumps() == 8
+    monkeypatch.setenv("PPTPU_FLIGHT_CAPACITY", "17")
+    monkeypatch.setenv("PPTPU_FLIGHT_MAX_DUMPS", "2")
+    assert flight.flight_capacity() == 17
+    assert flight.flight_max_dumps() == 2
+    monkeypatch.setenv("PPTPU_FLIGHT_CAPACITY", "-3")
+    assert flight.flight_capacity() == 0
+    monkeypatch.setenv("PPTPU_FLIGHT_CAPACITY", "garbage")
+    monkeypatch.setenv("PPTPU_FLIGHT_MAX_DUMPS", "garbage")
+    assert flight.flight_capacity() == 256
+    assert flight.flight_max_dumps() == 8
+
+
+def test_flight_ring_bounded_oldest_evicted(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    monkeypatch.setenv("PPTPU_FLIGHT_CAPACITY", "4")
+    with obs.run("ring") as rec:
+        assert rec.flight.capacity == 4
+        for i in range(10):
+            obs.event("tick", i=i)
+        ring = rec.flight.snapshot_ring()
+        assert len(ring) == 4
+        assert [r["i"] for r in ring] == [6, 7, 8, 9]
+
+
+def test_flight_capacity_zero_disables_dumps(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    monkeypatch.setenv("PPTPU_FLIGHT_CAPACITY", "0")
+    with obs.run("noring") as rec:
+        run_dir = rec.dir
+        obs.event("tick")
+        assert rec.flight.capacity == 0
+        assert rec.flight.snapshot_ring() == []
+        assert flight.dump("oom") is None
+    assert not os.path.isdir(os.path.join(run_dir, "postmortem"))
+    assert flight.load_postmortems(run_dir) == []
+
+
+def test_dump_bundle_contents(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    with obs.run("bundle") as rec:
+        run_dir = rec.dir
+        obs.event("boom", x=1)
+        obs.counter("things")
+        rec.metrics_registry().set_gauge("pps_device_bytes_in_use", 7)
+        path = flight.dump("oom", device="tpu:0")
+        assert path is not None and os.path.isfile(path)
+        assert os.path.dirname(path) == \
+            os.path.join(run_dir, "postmortem")
+        with open(path, encoding="utf-8") as fh:
+            bundle = json.load(fh)
+        assert bundle["schema"] == flight.FLIGHT_SCHEMA
+        assert bundle["trigger"] == "oom"
+        assert bundle["context"] == {"device": "tpu:0"}
+        assert any(r.get("name") == "boom" for r in bundle["ring"])
+        assert bundle["metrics"]["gauges"][
+            "pps_device_bytes_in_use"] == 7
+        assert bundle["alerts_firing"] == []
+        assert set(bundle["manifest"]) <= \
+            set(flight._MANIFEST_EXCERPT_KEYS)
+        assert bundle["manifest"]["name"] == "bundle"
+        assert bundle["counters"]["things"] == 1
+        assert rec.counters["postmortems_written"] == 1
+    assert "postmortem_written" in _event_names(run_dir)
+
+
+def test_dump_cap_and_sanitized_filenames(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    monkeypatch.setenv("PPTPU_FLIGHT_MAX_DUMPS", "2")
+    with obs.run("capped") as rec:
+        run_dir = rec.dir
+        p1 = flight.dump("alert:weird name!")
+        p2 = flight.dump("")
+        assert p1 and p2
+        assert flight.dump("third") is None
+        names = sorted(os.listdir(os.path.join(run_dir, "postmortem")))
+        assert len(names) == 2
+        assert names[0].startswith("001-") and \
+            names[1].startswith("002-")
+        assert names[1] == "002-dump.json"   # empty trigger fallback
+        for n in names:
+            assert re.fullmatch(r"[A-Za-z0-9_.-]+\.json", n)
+        assert rec.counters["postmortems_written"] == 2
+
+
+def test_load_postmortems_skips_torn_bundles(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    with obs.run("torn") as rec:
+        run_dir = rec.dir
+        flight.dump("first")
+        flight.dump("second")
+    pm_dir = os.path.join(run_dir, "postmortem")
+    # a sigkilled shard's partial write, a non-bundle JSON value and a
+    # stray non-json file must all be skipped, never raise
+    with open(os.path.join(pm_dir, "000-torn.json"), "w",
+              encoding="utf-8") as fh:
+        fh.write('{"schema": "pptpu-postmortem-v1", "ring": [')
+    with open(os.path.join(pm_dir, "zzz-list.json"), "w",
+              encoding="utf-8") as fh:
+        fh.write("[1, 2, 3]\n")
+    with open(os.path.join(pm_dir, "notes.txt"), "w",
+              encoding="utf-8") as fh:
+        fh.write("not a bundle\n")
+    bundles = flight.load_postmortems(run_dir)
+    assert [b["trigger"] for b in bundles] == ["first", "second"]
+    assert [b["file"] for b in bundles] == \
+        ["001-first.json", "002-second.json"]
+    assert flight.load_postmortems(str(tmp_path / "no-such-run")) == []

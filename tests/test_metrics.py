@@ -343,3 +343,45 @@ def test_render_watch_rates_and_phases():
            if ln.startswith("fit")][0]
     assert " 1.00" in row
     assert M.render_watch(None) == "(no metrics snapshot yet)"
+
+
+def test_render_watch_alerts_row_merged_absent_torn(tmp_path):
+    """The --watch alerts row (obs/health.py's series): firing rules
+    summed across merge prefixes, absent entirely for pre-health
+    snapshots, and still rendered from a torn-tailed metrics.jsonl."""
+    reg = M.MetricsRegistry()
+    reg.inc("pps_requests_total", tenant="a", outcome="done")
+    # pre-health snapshot: no alert series -> no alerts row at all
+    frame = M.render_watch(reg.snapshot(), title="t")
+    assert "alerts:" not in frame
+    # single-process firing rule + fired totals
+    reg.set_gauge("pps_alerts_firing", 1, rule="quarantine_spike")
+    reg.inc("pps_alerts_total", rule="quarantine_spike")
+    frame = M.render_watch(reg.snapshot(), title="t")
+    assert "alerts: 1 firing (quarantine_spike)" in frame, frame
+    assert "1 fired total" in frame, frame
+    # merged snapshot: gauges carry p<proc>/ prefixes, counters sum
+    snap = reg.snapshot()
+    snap["gauges"] = {"p0/%s" % k: v
+                      for k, v in snap["gauges"].items()}
+    snap["gauges"]['p1/pps_alerts_firing{rule="retry_burn"}'] = 1
+    # a resolved rule on another shard must NOT count as firing
+    snap["gauges"]['p1/pps_alerts_firing{rule="slo_burn"}'] = 0
+    frame = M.render_watch(snap, title="t")
+    assert "alerts: 2 firing (quarantine_spike, retry_burn)" \
+        in frame, frame
+    # torn tail: the last parseable snapshot still renders the row
+    run = tmp_path / "run"
+    run.mkdir()
+    good = dict(reg.snapshot())
+    good["schema"] = M.SNAPSHOT_SCHEMA
+    with open(run / "metrics.jsonl", "w") as fh:
+        fh.write(json.dumps(good) + "\n")
+        fh.write('{"schema": "pptpu-metrics-v1", "gauges": {"pps_al')
+    snap = M.last_snapshot(str(run))
+    assert "alerts: 1 firing (quarantine_spike)" \
+        in M.render_watch(snap, title="t")
+    # all-resolved: the row degrades to "none firing" + history
+    reg.set_gauge("pps_alerts_firing", 0, rule="quarantine_spike")
+    frame = M.render_watch(reg.snapshot(), title="t")
+    assert "alerts: none firing" in frame, frame

@@ -433,3 +433,54 @@ def test_archive_pad_fault_quarantines_after_retries(survey, tmp_path):
     key = WorkQueue.key_for(bad)
     assert set(quar) == {key}
     assert "retries exhausted" in quar[key]
+
+
+def test_sigkilled_shard_torn_bundle_never_corrupts_survivor(
+        survey, tmp_path, monkeypatch):
+    """Acceptance (flight forensics): a quarantine freezes a postmortem
+    bundle of the events that led there, and a SIGKILLed shard's
+    partial dump (torn ``.json``, orphaned ``.tmp``) sitting in the
+    same ``postmortem/`` directory never corrupts the survivor's
+    forensics — ``load_postmortems`` skips it and the obs report's
+    health section still renders."""
+    from pulseportraiture_tpu.obs import flight
+    from tools.obs_report import summarize
+
+    monkeypatch.setenv("PPTPU_HEALTH_RULES", json.dumps(
+        {"quarantine_spike": {"threshold": 1, "window_s": 60.0}}))
+    bad = survey.files[2]
+    spec = "site:archive_pad@0.5,seed=%d" % _seed_firing_only(
+        survey.files, bad, site="archive_pad")
+    faults.configure(spec)
+    plan = plan_survey(survey.files, modelfile=survey.gm)
+    wd = str(tmp_path / "wd")
+    s = run_survey(plan, wd, process_index=0, process_count=1,
+                   bary=False, backoff_s=0.0, max_attempts=2,
+                   merge=False)
+    faults.reset()
+    assert s["counts"]["quarantined"] == 1
+    run_dir = s["obs_run"]
+
+    bundles = flight.load_postmortems(run_dir)
+    triggers = [b["trigger"] for b in bundles]
+    assert "quarantine" in triggers
+    quar = next(b for b in bundles if b["trigger"] == "quarantine")
+    assert quar["context"]["archive"] == bad
+    # the runner_archive record that led here is already in the ring
+    assert any(r.get("name") == "runner_archive" and
+               r.get("state") == "quarantined" for r in quar["ring"])
+    assert quar["counters"].get("postmortems_written", 0) >= 0
+
+    # a dead shard mid-dump: truncated bundle + orphaned tmp file
+    pm_dir = os.path.join(run_dir, "postmortem")
+    with open(os.path.join(pm_dir, "000-dead-shard.json"), "w") as fh:
+        fh.write('{"schema": "pptpu-postmortem-v1", "ring": [{"par')
+    with open(os.path.join(pm_dir, "000-dead.json.tmp"), "w") as fh:
+        fh.write("{")
+    survivors = flight.load_postmortems(run_dir)
+    assert [b["trigger"] for b in survivors] == triggers
+    assert "000-dead-shard.json" not in [b["file"] for b in survivors]
+
+    text = summarize(run_dir)
+    assert "## health (alerts & postmortems)" in text
+    assert "postmortems:" in text and "quarantine" in text

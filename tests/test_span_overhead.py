@@ -20,6 +20,7 @@ if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 
 from tools.span_overhead import (BUDGET_FRACTION, CALLS_PER_ARCHIVE,
+                                 HEALTH_CALLS_PER_ARCHIVE,
                                  MEMORY_CALLS_PER_ARCHIVE,
                                  METRICS_CALLS_PER_ARCHIVE,
                                  TRACING_CALLS_PER_ARCHIVE,
@@ -32,7 +33,8 @@ def test_probe_schema_and_sanity():
                  "metrics_observe", "metrics_timed", "metrics_inc",
                  "metrics_gauge", "tracing_current",
                  "tracing_activate", "span_traced", "observe_traced",
-                 "memory_watermarks", "memory_last"):
+                 "memory_watermarks", "memory_last",
+                 "health_evaluate", "flight_dump"):
         assert out["%s_off_s" % name] > 0.0
         assert out["%s_on_s" % name] > 0.0
     assert out["archive_off_s"] == pytest.approx(
@@ -49,6 +51,11 @@ def test_probe_schema_and_sanity():
         MEMORY_CALLS_PER_ARCHIVE * out["memory_watermarks_off_s"])
     assert out["hot_fit_memory_off_s"] == pytest.approx(
         out["hot_fit_tracing_off_s"] + out["memory_archive_off_s"])
+    assert HEALTH_CALLS_PER_ARCHIVE == 2
+    assert out["health_archive_off_s"] == pytest.approx(
+        out["health_evaluate_off_s"] + out["flight_dump_off_s"])
+    assert out["hot_fit_health_off_s"] == pytest.approx(
+        out["hot_fit_memory_off_s"] + out["health_archive_off_s"])
     # disabled primitives are nanosecond-scale dict lookups; even a
     # very loaded CI box keeps them under 50 us/call
     assert out["span_off_s"] < 50e-6
@@ -65,6 +72,10 @@ def test_probe_schema_and_sanity():
     # read is one module-global read + None check
     assert out["memory_watermarks_off_s"] < 50e-6
     assert out["memory_last_off_s"] < 50e-6
+    # disabled-health/flight guard: with no run active an alert-rule
+    # evaluate or a flight dump is one module-global read + None check
+    assert out["health_evaluate_off_s"] < 50e-6
+    assert out["flight_dump_off_s"] < 50e-6
 
 
 @pytest.mark.slow
@@ -127,3 +138,11 @@ def test_disabled_overhead_within_budget():
         (out["hot_fit_memory_off_s"], fit_wall)
     assert out["memory_archive_on_s"] < fit_wall, \
         (out["memory_archive_on_s"], fit_wall)
+    # health plane + flight recorder: the fully-instrumented disabled
+    # path — everything above plus the claim-cycle rule pass and the
+    # quarantine-branch dump check — still fits the <2% budget, and
+    # even the ENABLED rule pass stays far below one archive's fit
+    assert out["hot_fit_health_off_s"] < BUDGET_FRACTION * fit_wall, \
+        (out["hot_fit_health_off_s"], fit_wall)
+    assert out["health_archive_on_s"] < fit_wall, \
+        (out["health_archive_on_s"], fit_wall)

@@ -88,7 +88,17 @@
 #                 merged report, and an incremental re-warm of an
 #                 extended plan must compile ONLY the new bucket
 #                 (docs/RUNNER.md "Warm start")
-#  15. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
+#  15. health smoke — the live health plane end to end: an in-process
+#                 service pair (healthy + dispatch-faulted) must show
+#                 the quarantine_spike rule walking pending -> firing
+#                 (health socket verb + alert_firing event) with the
+#                 flight recorder freezing postmortem bundles whose
+#                 rings hold the triggering events, then resolving
+#                 once the rule window slides past the fault; the
+#                 healthy run self-diffs clean while healthy-vs-
+#                 faulted trips obs_diff's exact new-alerts gate
+#                 (docs/OBSERVABILITY.md Health)
+#  16. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
 #
 # Usage: tools/check.sh [--lint-only]
 #   --lint-only   run only the static stages (pplint + ruff + drift +
@@ -276,6 +286,17 @@ if [ $? -ne 0 ]; then
     fail=1
 else
     tail -1 /tmp/_warm_smoke.log
+fi
+
+echo
+echo "== health smoke (alert rules + flight recorder, docs/OBSERVABILITY.md) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu PPTPU_OBS_DIR="" PPTPU_FAULTS="" \
+    python -m tools.health_smoke >/tmp/_health_smoke.log 2>&1
+if [ $? -ne 0 ]; then
+    tail -40 /tmp/_health_smoke.log
+    fail=1
+else
+    tail -1 /tmp/_health_smoke.log
 fi
 
 echo

@@ -1,0 +1,251 @@
+"""Health smoke gate: the live health plane end to end (wired into
+tools/check.sh).
+
+Drives an in-process TOA service twice over the same tiny corpus and
+asserts the alerting contract docs/OBSERVABILITY.md names:
+
+* **healthy baseline**: the ``health`` socket verb reports live +
+  ready with zero firing alerts, and the closed run's report carries
+  no ``## health`` section at all — absence is not breakage;
+* **injected fault**: with ``site:dispatch@nth=1`` active and
+  ``max_attempts=1`` the first request quarantines; the tightened
+  ``quarantine_spike`` rule (``PPTPU_HEALTH_RULES`` overlay) walks
+  pending → firing — the verb shows the alert, an ``alert_firing``
+  event lands in the stream, and the flight recorder freezes TWO
+  postmortem bundles: the quarantine's (terminal ``service_request``
+  in its ring) and the alert's (``alert_firing`` in its ring);
+* **recovery**: the next request (fault spent) completes; once the
+  rule window slides past the quarantine the verb goes clean again
+  and ``alert_resolved`` lands — alerts have a full lifecycle, not a
+  latch;
+* **gates**: an ``obs_diff`` self-diff of the healthy run passes,
+  while healthy-vs-faulted trips the exact new-alerts-fired gate
+  (exit 1) — the regression gate fails when new alerts fire and only
+  then.
+
+Run:  env JAX_PLATFORMS=cpu python -m tools.health_smoke
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+# one-quarantine sensitivity, short windows so resolution is testable
+# (slo_burn legitimately sees the quarantine as a 50% error rate —
+# shrink its window too so it resolves inside the smoke's poll)
+RULES_OVERLAY = {"quarantine_spike":
+                 {"threshold": 1, "window_s": 3.0, "for_s": 0.0},
+                 "slo_burn": {"window_s": 3.0, "for_s": 0.0}}
+FAULT_SPEC = "site:dispatch@nth=1"
+
+
+def _build_inputs(workroot):
+    from pulseportraiture_tpu.io.archive import make_fake_pulsar
+    from pulseportraiture_tpu.io.gmodel import write_model
+
+    gm = os.path.join(workroot, "smoke.gmodel")
+    write_model(gm, "smoke", "000", 1500.0,
+                np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5]),
+                np.ones(8, int), -4.0, 0, quiet=True)
+    par = os.path.join(workroot, "smoke.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    files = []
+    for i in range(2):
+        fits = os.path.join(workroot, "req%d.fits" % i)
+        make_fake_pulsar(gm, par, fits, nsub=2, nchan=8, nbin=64,
+                         nu0=1500.0, bw=800.0, tsub=60.0,
+                         phase=0.03 * (i + 1), dDM=5e-4,
+                         noise_stds=0.01, dedispersed=False,
+                         seed=31 + i, quiet=True)
+        files.append(fits)
+    return gm, files
+
+
+def _health_until(sock, pred, timeout_s=30.0, what="condition"):
+    """Poll the ``health`` verb (each call runs a fresh rule pass)
+    until ``pred(resp)`` holds; returns the matching response."""
+    from pulseportraiture_tpu.service import client_request
+
+    deadline = time.monotonic() + timeout_s
+    resp = None
+    while time.monotonic() < deadline:
+        resp = client_request(sock, {"op": "health"}, timeout=30)
+        if pred(resp):
+            return resp
+        time.sleep(0.2)
+    raise AssertionError("health verb never reached %s: %r"
+                         % (what, resp))
+
+
+def _run_service(gm, files, workdir, tag):
+    """One service lifetime: submit both archives, probe the health
+    verb, shut down; returns (obs run dir, responses)."""
+    from pulseportraiture_tpu import obs
+    from pulseportraiture_tpu.service import (ServiceServer,
+                                              TOAService,
+                                              client_request)
+
+    svc = TOAService(gm, workdir, batch_window_s=0.2, batch_max=4,
+                     backoff_s=0.0, max_attempts=1,
+                     get_toas_kw={"bary": False}, quiet=True).start()
+    sock = os.path.join(workdir, "hs.sock")
+    server = ServiceServer(svc, sock).start()
+    states = []
+    try:
+        run_dir = obs.current().dir
+        h0 = client_request(sock, {"op": "health"}, timeout=30)
+        assert h0["ok"] and h0["live"] and h0["ready"], h0
+        r0 = client_request(sock, {"op": "submit", "tenant": "alice",
+                                   "archive": files[0], "wait": True,
+                                   "timeout_s": 300}, timeout=330)
+        states.append(r0["state"])
+        firing = None
+        if tag == "faulted":
+            assert r0["state"] == "quarantined", r0
+            # the rule walks pending -> firing on the verb's own
+            # evaluate cadence; readiness must survive a firing alert
+            firing = _health_until(
+                sock, lambda h: h.get("alerts_firing"),
+                what="a firing alert")
+            rules = [a.get("rule") for a in firing["alerts"]]
+            assert "quarantine_spike" in rules, firing
+            assert firing["live"] and firing["ready"], firing
+        else:
+            assert r0["state"] == "done", r0
+        r1 = client_request(sock, {"op": "submit", "tenant": "bob",
+                                   "archive": files[1], "wait": True,
+                                   "timeout_s": 300}, timeout=330)
+        states.append(r1["state"])
+        assert r1["state"] == "done", r1     # fault spent: recovery
+        # healthy again once the rule window slides past the fault
+        clean = _health_until(
+            sock, lambda h: not h.get("alerts_firing"),
+            timeout_s=RULES_OVERLAY["quarantine_spike"]["window_s"]
+            + 30.0, what="zero firing alerts")
+        assert clean["live"] and clean["ready"], clean
+        if tag == "faulted":
+            assert clean.get("alerts_fired", 0) >= 1, clean
+            assert clean.get("postmortems_written", 0) >= 1, clean
+    finally:
+        server.stop()
+        assert svc.shutdown(timeout=120)
+    return run_dir, states
+
+
+def _events(run_dir):
+    from pulseportraiture_tpu import obs
+
+    out = []
+    for path in obs.list_event_files(run_dir):
+        with open(path, encoding="utf-8") as fh:
+            out.extend(json.loads(ln) for ln in fh if ln.strip())
+    return out
+
+
+def main():
+    workroot = tempfile.mkdtemp(prefix="pptpu_health_smoke_")
+    saved = {k: os.environ.get(k)
+             for k in ("PPTPU_FAULTS", "PPTPU_HEALTH_RULES",
+                       "PPTPU_METRICS_INTERVAL")}
+    try:
+        os.environ["PPTPU_HEALTH_RULES"] = json.dumps(RULES_OVERLAY)
+        os.environ["PPTPU_METRICS_INTERVAL"] = "0.2"
+        os.environ.pop("PPTPU_FAULTS", None)
+
+        from tools import obs_diff
+        from tools.obs_report import summarize
+
+        gm, files = _build_inputs(workroot)
+
+        # 1. healthy baseline: verb clean, no ## health section
+        run_a, states_a = _run_service(
+            gm, files, os.path.join(workroot, "wd_a"), "healthy")
+        assert states_a == ["done", "done"], states_a
+        text_a = summarize(run_a)
+        assert "## health" not in text_a, text_a
+
+        # 2. faulted run: quarantine -> firing -> postmortems ->
+        #    recovery -> resolved
+        os.environ["PPTPU_FAULTS"] = FAULT_SPEC
+        run_b, states_b = _run_service(
+            gm, files, os.path.join(workroot, "wd_b"), "faulted")
+        os.environ.pop("PPTPU_FAULTS", None)
+        assert states_b == ["quarantined", "done"], states_b
+
+        from pulseportraiture_tpu.obs import flight
+
+        manifest = json.load(open(os.path.join(run_b,
+                                               "manifest.json")))
+        counters = manifest.get("counters") or {}
+        assert counters.get("alerts_fired", 0) >= 1, counters
+        assert counters.get("alerts_resolved", 0) >= 1, counters
+        assert counters.get("postmortems_written", 0) >= 2, counters
+
+        names = [e.get("name") for e in _events(run_b)
+                 if e.get("kind") == "event"]
+        assert "alert_firing" in names and "alert_resolved" in names \
+            and "postmortem_written" in names, sorted(set(names))
+
+        bundles = flight.load_postmortems(run_b)
+        by_trigger = {b["trigger"]: b for b in bundles}
+        quar = by_trigger.get("quarantine")
+        assert quar is not None, sorted(by_trigger)
+        # the triggering event is IN the ring: the terminal
+        # service_request was emitted before the bundle was cut
+        assert any(r.get("name") == "service_request"
+                   and r.get("state") == "quarantined"
+                   for r in quar["ring"]), quar["ring"][-5:]
+        alert = by_trigger.get("alert:quarantine_spike")
+        assert alert is not None, sorted(by_trigger)
+        assert any(r.get("name") == "alert_firing"
+                   for r in alert["ring"]), alert["ring"][-5:]
+        assert any(a.get("rule") == "quarantine_spike"
+                   for a in alert["alerts_firing"]), alert
+
+        text_b = summarize(run_b)
+        assert "## health (alerts & postmortems)" in text_b, text_b
+        assert "quarantine_spike" in text_b, text_b
+        assert "postmortems:" in text_b, text_b
+
+        # 3. self-diff of the healthy run passes (alerts gate quiet)
+        rc = obs_diff.main([run_a, run_a, "--rel", "5.0",
+                            "--min-s", "5.0"])
+        assert rc == 0, "healthy self-diff failed (rc %d)" % rc
+
+        # 4. healthy-vs-faulted trips the exact new-alerts gate
+        a = obs_diff.run_summary(run_a)
+        b = obs_diff.run_summary(run_b)
+        d = obs_diff.diff_runs(a, b, rel=1e9, min_s=1e9,
+                               bad_allow=10**6)
+        alert_regs = [r for r in d.regressions
+                      if r.startswith("alerts.")
+                      and "new alerts fired" in r]
+        assert alert_regs, d.regressions
+        rc = obs_diff.main([run_a, run_b, "--rel", "5.0",
+                            "--min-s", "5.0"])
+        assert rc == 1, "new-alerts gate missed (rc %d)" % rc
+
+        print("health smoke OK: fault -> quarantine_spike fired + "
+              "%d postmortems -> resolved; verb live/ready "
+              "throughout; new-alerts gate caught %s at %s"
+              % (counters.get("postmortems_written", 0),
+                 alert_regs[0].split(":")[0], run_b))
+        return 0
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

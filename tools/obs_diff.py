@@ -43,6 +43,12 @@ What is compared (run-vs-run mode):
   self-diff gate is bit-tight) against the same threshold.  Without
   the flag quality rows are informational; runs predating the quality
   plane contribute no rows at all.
+* health (always exact, no flag): a candidate run may not fire more
+  alerts of any rule than the baseline did (obs/health.py) — a
+  "faster" run that tripped ``quarantine_spike`` on the way is a
+  regression, and two identical healthy runs trivially pass.  Runs
+  predating the health plane (or where neither side ever alerted)
+  contribute no rows.
 
 Exit status: 0 = no regression, 1 = regression(s), 2 = usage/IO error.
 Wired into tools/check.sh as a smoke-vs-smoke self-diff stage (two
@@ -98,6 +104,26 @@ def quality_slice(manifest, run_dir):
         "median_toa_err_us": quantile(qhists.get(q.HIST_TOA_ERR), 0.5),
         "hists": qhists,
     }
+
+
+def alerts_slice(manifest, events):
+    """The comparable health slice of one run (obs/health.py):
+    per-rule ``alert_firing`` counts from the event stream plus the
+    run totals from the manifest counters.  None for a run that
+    predates the health plane or never alerted — the gate then treats
+    it as all-zeros, so only *new* alerts can regress."""
+    counters = manifest.get("counters") or {}
+    fired = {}
+    for e in events:
+        if e.get("kind") == "event" and e.get("name") == "alert_firing":
+            rule = str(e.get("rule") or "?")
+            fired[rule] = fired.get(rule, 0) + 1
+    total = int(merged_gauge(counters, "alerts_fired"))
+    if not fired and not total:
+        return None
+    return {"fired": fired, "total": max(total, sum(fired.values())),
+            "postmortems": int(merged_gauge(counters,
+                                            "postmortems_written"))}
 
 
 def tv_distance(ha, hb):
@@ -168,6 +194,7 @@ def run_summary(run_dir):
         "fit_subints": n_sub,
         "counters": counters,
         "quality": quality_slice(manifest, run_dir),
+        "alerts": alerts_slice(manifest, events),
     }
 
 
@@ -303,6 +330,29 @@ def _diff_quality(d, qa, qb, quality_rel, quality_min_subints):
             d.rows.append((metric, "0", "%.4f" % tv, "-", "ok"))
 
 
+def _diff_alerts(d, aa, ab):
+    """Health rows of a run-vs-run diff: an exact new-alerts-fired
+    gate.  Always on — there is no threshold to tune, because a fired
+    alert is a discrete event, not a noisy measurement; absence on
+    both sides contributes no rows (pre-health runs stay diffable)."""
+    if not aa and not ab:
+        return
+    fa = (aa or {}).get("fired") or {}
+    fb = (ab or {}).get("fired") or {}
+    for rule in sorted(set(fa) | set(fb)):
+        na, nb = int(fa.get(rule, 0)), int(fb.get(rule, 0))
+        metric = "alerts.%s.fired" % rule
+        if nb > na:
+            d.regressions.append(
+                "%s: %d -> %d (new alerts fired)" % (metric, na, nb))
+            d.rows.append((metric, na, nb, "-", "REGRESSION"))
+        else:
+            d.rows.append((metric, na, nb, "-", "ok"))
+    d.rows.append(("alerts.postmortems_written",
+                   _fmt((aa or {}).get("postmortems")),
+                   _fmt((ab or {}).get("postmortems")), "-", "info"))
+
+
 def diff_runs(a, b, rel=0.3, min_s=0.05, compile_rel=None,
               bad_allow=0, mem_rel=None, mem_min_bytes=1 << 20,
               quality_rel=None, quality_min_subints=8):
@@ -368,6 +418,7 @@ def diff_runs(a, b, rel=0.3, min_s=0.05, compile_rel=None,
             d.rows.append(("n_bad", nb_a, nb_b, "-", "ok"))
     _diff_quality(d, a.get("quality"), b.get("quality"), quality_rel,
                   quality_min_subints)
+    _diff_alerts(d, a.get("alerts"), b.get("alerts"))
     return d
 
 

@@ -575,6 +575,57 @@ def summarize_robustness(events):
     return "\n".join(lines)
 
 
+_ALERT_EVENTS = ("alert_firing", "alert_resolved")
+
+
+def summarize_health(manifest, events, run_dir):
+    """The ``## health`` section: the alert timeline
+    (``alert_firing`` / ``alert_resolved`` lifecycle events from
+    obs/health.py) plus the postmortem-bundle index the flight
+    recorder wrote (obs/flight.py).  Absent — returns None — for runs
+    that predate the health plane or never alerted: absence is not
+    breakage."""
+    from pulseportraiture_tpu.obs import flight
+
+    evs = [e for e in events if e.get("kind") == "event"
+           and e.get("name") in _ALERT_EVENTS]
+    bundles = flight.load_postmortems(run_dir)
+    counters = manifest.get("counters") or {}
+    totals = {k: counters[k] for k in ("alerts_fired",
+                                       "alerts_resolved",
+                                       "postmortems_written")
+              if counters.get(k)}
+    if not evs and not bundles and not totals:
+        return None
+    lines = []
+    if totals:
+        lines.append("  ".join("%s: %d" % (k, v)
+                               for k, v in sorted(totals.items())))
+    if evs:
+        lines.append("alert timeline:")
+        for e in evs[:40]:
+            detail = {k: v for k, v in e.items()
+                      if k not in ("kind", "t", "name")
+                      and v is not None}
+            try:
+                lines.append("- %s %s" % (e["name"],
+                                          json.dumps(detail,
+                                                     sort_keys=True)))
+            except (TypeError, ValueError):
+                lines.append("- %s" % e["name"])
+        if len(evs) > 40:
+            lines.append("- ... %d more" % (len(evs) - 40))
+    if bundles:
+        rows = [(b.get("file", "?"), b.get("trigger", "?"),
+                 len(b.get("ring") or []),
+                 len(b.get("alerts_firing") or []))
+                for b in bundles]
+        lines.append("postmortems:")
+        lines.append(_table(("bundle", "trigger", "ring events",
+                             "alerts firing"), rows))
+    return "\n".join(lines)
+
+
 _LATENCY_PHASE_ORDER = ["queue_wait", "checkout", "park", "dispatch",
                         "fit", "checkpoint", "total", "claim",
                         "archive"]
@@ -915,6 +966,11 @@ def summarize(run_dir):
         out.append("")
         out.append("## faults & robustness")
         out.append(rob)
+    health = summarize_health(manifest, events, run_dir)
+    if health:
+        out.append("")
+        out.append("## health (alerts & postmortems)")
+        out.append(health)
     counters = manifest.get("counters") or {}
     gauges = manifest.get("gauges") or {}
     caches = manifest.get("jit_cache_sizes") or {}

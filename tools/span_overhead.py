@@ -38,6 +38,11 @@ TRACING_CALLS_PER_ARCHIVE = 10
 # span boundary folds a sample into the open marks — 2 boundary
 # samples per phase span (docs/OBSERVABILITY.md Memory)
 MEMORY_CALLS_PER_ARCHIVE = 10
+# health/flight touch points per archive (obs/health.py, flight.py):
+# one alert-rule pass per claim cycle plus the flight-dump fast-path
+# check on the (rare) quarantine branch; the ring append itself rides
+# inside every emit and is therefore priced by the event/span probes
+HEALTH_CALLS_PER_ARCHIVE = 2
 BUDGET_FRACTION = 0.02
 
 
@@ -54,7 +59,8 @@ def measure(n=2000):
     (obs/metrics.py: observe / timed / inc / gauge), with obs disabled
     and enabled."""
     from pulseportraiture_tpu import obs
-    from pulseportraiture_tpu.obs import memory, metrics, tracing
+    from pulseportraiture_tpu.obs import (flight, health, memory,
+                                          metrics, tracing)
 
     fit_result = {"nfeval": np.full(8, 12),
                   "red_chi2": np.ones(8),
@@ -124,6 +130,17 @@ def measure(n=2000):
         # the OOM-forensics read: most recent sample, no new probe
         memory.last()
 
+    def one_health_evaluate():
+        # the disabled-health contract (docs/OBSERVABILITY.md): with
+        # no run active this is one module-global read + None check;
+        # enabled it is a full windowed rule pass over the registry
+        health.evaluate()
+
+    def one_flight_dump():
+        # the quarantine-branch fast path: disabled = one global read;
+        # enabled, past the PPTPU_FLIGHT_MAX_DUMPS cap, one seq check
+        flight.dump("probe")
+
     probes = {"span": one_span, "phases": one_phases,
               "event": one_event, "fit_telemetry": one_fit_telemetry,
               "metrics_observe": one_metrics_observe,
@@ -135,7 +152,9 @@ def measure(n=2000):
               "span_traced": one_span_traced,
               "observe_traced": one_observe_traced,
               "memory_watermarks": one_memory_watermarks,
-              "memory_last": one_memory_last}
+              "memory_last": one_memory_last,
+              "health_evaluate": one_health_evaluate,
+              "flight_dump": one_flight_dump}
 
     out = {}
     saved = os.environ.pop("PPTPU_OBS_DIR", None)
@@ -190,6 +209,16 @@ def measure(n=2000):
         MEMORY_CALLS_PER_ARCHIVE * out["memory_watermarks_on_s"])
     out["hot_fit_memory_off_s"] = out["hot_fit_tracing_off_s"] \
         + out["memory_archive_off_s"]
+    # health plane + flight recorder (docs/OBSERVABILITY.md Health):
+    # disabled = the no-run fast paths of the claim-cycle rule pass
+    # and the quarantine-branch dump check; the ring append is inside
+    # emit, so the event/span enabled probes already price it
+    out["health_archive_off_s"] = (
+        out["health_evaluate_off_s"] + out["flight_dump_off_s"])
+    out["health_archive_on_s"] = (
+        out["health_evaluate_on_s"] + out["flight_dump_on_s"])
+    out["hot_fit_health_off_s"] = out["hot_fit_memory_off_s"] \
+        + out["health_archive_off_s"]
     return out
 
 
