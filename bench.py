@@ -353,6 +353,145 @@ def _survey_warm_stage():
         shutil.rmtree(wdir, ignore_errors=True)
 
 
+def _fleet_slo_stage():
+    """Fleet scaling (docs/SERVICE.md "Fleet"): a 3-daemon
+    FleetRouter vs ONE fixed-window daemon on the same mixed-bucket
+    corpus and the same persistent compile cache, both driven
+    closed-loop by the in-process load generator.  The baseline runs
+    with ``--solo-window`` == ``--window`` — the pre-adaptive parking
+    semantics the router replaced — so BENCH_*.json track exactly the
+    win the fleet subsystem claims.  Returns (fleet req/s, single-
+    daemon req/s, fleet p99 seconds, deadline miss rate)."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    from pulseportraiture_tpu.cli.pploadgen import (build_requests,
+                                                    run_load,
+                                                    summarize_load)
+    from pulseportraiture_tpu.io.archive import make_fake_pulsar
+    from pulseportraiture_tpu.runner.plan import plan_survey
+    from pulseportraiture_tpu.service import (
+        DEFAULT_ROUTER_SOCKET_NAME, FleetRouter, ServiceServer,
+        client_request)
+
+    window = 1.0
+    wdir = tempfile.mkdtemp(prefix="pp_bench_fleet_")
+    base_proc = None
+    router = None
+    rserver = None
+    try:
+        gm, par = _bench_source(wdir)
+        archives = []
+        for i, (nchan, nbin) in enumerate([(8, 64), (16, 64),
+                                           (16, 64), (8, 128)]):
+            out = os.path.join(wdir, "f%03d.fits" % i)
+            make_fake_pulsar(gm, par, out, nsub=2, nchan=nchan,
+                             nbin=nbin, nu0=1500.0, bw=800.0,
+                             tsub=60.0, phase=0.02 * (i + 1),
+                             dDM=5e-4, noise_stds=0.01,
+                             dedispersed=False, seed=820 + i,
+                             quiet=True)
+            archives.append(out)
+        plan = plan_survey(archives, modelfile=gm)
+        plan_path = os.path.join(wdir, "plan.json")
+        plan.save(plan_path)
+        cache = os.path.join(wdir, "fleet_cache")
+        tenants = ["alice", "bob", "bob", "bob"]
+        priorities = [1, 0, 0, 0]
+        deadlines = [5.0, 120.0, 120.0, 120.0]
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PPTPU_OBS_DIR"] = ""
+        env.pop("PPTPU_FAULTS", None)
+
+        _stage('fleet slo: fixed-window single-daemon baseline')
+        base_proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "pulseportraiture_tpu.cli.ppserve", "start",
+             "-w", os.path.join(wdir, "single"), "-m", gm,
+             "--plan", plan_path, "--warm", "--compile-cache", cache,
+             "--window", str(window), "--solo-window", str(window),
+             "--batch", "4", "--backoff", "0", "--no_bary",
+             "--quiet"],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL)
+        ready = None
+        deadline = time.time() + 420
+        while time.time() < deadline:
+            line = base_proc.stdout.readline()
+            if not line:
+                raise RuntimeError("baseline daemon died: rc=%s"
+                                   % base_proc.poll())
+            line = line.decode("utf-8", "replace").strip()
+            if line.startswith("PPSERVE_READY "):
+                ready = json.loads(line[len("PPSERVE_READY "):])
+                break
+        if ready is None:
+            raise RuntimeError("baseline daemon never became ready")
+        reqs = build_requests(archives, 8, tenants,
+                              os.path.join(wdir, "spool_b"), seed=1)
+        results, wall = run_load(ready["socket"], reqs,
+                                 mode="closed", concurrency=4,
+                                 timeout=300.0,
+                                 priorities=priorities)
+        if not all(r.ok for r in results):
+            raise RuntimeError("baseline load errors: %s"
+                               % [r.error for r in results
+                                  if not r.ok])
+        single_rps = summarize_load(results, wall)["client"][
+            "throughput_rps"]
+        client_request(ready["socket"], {"op": "shutdown"},
+                       timeout=10.0)
+        base_proc.wait(timeout=120)
+        base_proc = None
+
+        _stage('fleet slo: 3-daemon fleet on the same compile cache')
+        router = FleetRouter(
+            gm, os.path.join(wdir, "fleet"), n_daemons=3,
+            plan=plan_path, compile_cache=cache, warm=True,
+            batch_window_s=window, batch_max=4,
+            daemon_args=["--no_bary", "--backoff", "0"],
+            daemon_env=env, quiet=True)
+        router.start(ready_timeout=420)
+        rsock = os.path.join(wdir, "fleet",
+                             DEFAULT_ROUTER_SOCKET_NAME)
+        rserver = ServiceServer(router, rsock).start()
+        reqs = build_requests(archives, 16, tenants,
+                              os.path.join(wdir, "spool_f"), seed=2)
+        results, wall = run_load(rsock, reqs, mode="closed",
+                                 concurrency=4, timeout=300.0,
+                                 priorities=priorities,
+                                 deadlines=deadlines)
+        if not all(r.ok for r in results):
+            raise RuntimeError("fleet load errors: %s"
+                               % [r.error for r in results
+                                  if not r.ok])
+        rep = summarize_load(results, wall)
+        fleet_rps = rep["client"]["throughput_rps"]
+        fleet_p99 = rep["client"]["p99_s"]
+        miss_rate = sum(1 for r in results if r.deadline_miss) \
+            / float(len(results))
+        rserver.stop()
+        rserver = None
+        router.shutdown(timeout=120)
+        router = None
+        _stage('fleet slo: fleet %.2f req/s vs single %.2f req/s'
+               % (fleet_rps, single_rps))
+        return single_rps, fleet_rps, fleet_p99, miss_rate
+    finally:
+        if base_proc is not None and base_proc.poll() is None:
+            base_proc.kill()
+        if rserver is not None:
+            rserver.stop()
+        if router is not None:
+            try:
+                router.shutdown(timeout=30)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        shutil.rmtree(wdir, ignore_errors=True)
+
+
 def main():
     """Open the bench obs run and print the BENCH line from it.
 
@@ -614,6 +753,11 @@ def _bench():
         ttff_cold, ttff_warm, warm_hit_rate, warm_wall = \
             _survey_warm_stage()
 
+    # ---- fleet scaling: router vs fixed-window single daemon ----------
+    with obs.span("fleet_slo"):
+        single_rps, fleet_rps, fleet_p99, fleet_miss_rate = \
+            _fleet_slo_stage()
+
     # ---- rough sustained FLOP/s for the main config -------------------
     # per subint: rFFT (5 N log2 N per channel) + ~n_iter fused moment
     # passes of ~40 flops per (channel, harmonic)
@@ -674,6 +818,11 @@ def _bench():
             if warm_hit_rate is None else round(warm_hit_rate, 3),
             "warm_s": None if warm_wall is None
             else round(warm_wall, 3),
+            "fleet_req_per_s": round(fleet_rps, 3),
+            "single_daemon_req_per_s": round(single_rps, 3),
+            "fleet_p99_s": None if fleet_p99 is None
+            else round(fleet_p99, 4),
+            "deadline_miss_rate": round(fleet_miss_rate, 4),
             "gflops_approx": round(float(gflops), 1),
             "backend_fallback": ns.backend_fallback,
         },

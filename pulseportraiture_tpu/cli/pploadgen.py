@@ -94,7 +94,8 @@ def load_slo(spec):
 
 class _Result:
     __slots__ = ("tenant", "archive", "latency_s", "ok", "state",
-                 "error", "cached", "trace_id")
+                 "error", "cached", "trace_id", "priority",
+                 "deadline_s", "rerouted", "deadline_miss")
 
     def __init__(self, tenant, archive):
         self.tenant = tenant
@@ -105,6 +106,18 @@ class _Result:
         self.error = None
         self.cached = False
         self.trace_id = None
+        self.priority = 0
+        self.deadline_s = None
+        self.rerouted = 0
+        self.deadline_miss = False
+
+
+# a "draining" rejection is re-routable, not a failure: the daemon
+# (or a fleet member being replaced behind a router) provably did NOT
+# accept the work, so the client retries — against a router the retry
+# lands on the re-routed bucket owner
+_DRAIN_RETRIES = 5
+_DRAIN_BACKOFF_S = 0.2
 
 
 def _submit_one(socket_path, res, timeout):
@@ -118,6 +131,10 @@ def _submit_one(socket_path, res, timeout):
     With no obs run active the span no-ops and no carrier is sent (the
     daemon then mints its own trace); ids stamped here still feed the
     client histogram's exemplars either way.
+
+    ``draining`` rejections retry (bounded) instead of erroring;
+    retry delay stays inside the measured latency — the honest client
+    experience of a fleet mid-respawn.
     """
     from ..obs import tracing
     from ..service import client_request
@@ -125,6 +142,10 @@ def _submit_one(socket_path, res, timeout):
     payload = {"op": "submit", "tenant": res.tenant,
                "archive": res.archive, "wait": True,
                "timeout_s": timeout}
+    if res.priority:
+        payload["priority"] = res.priority
+    if res.deadline_s is not None:
+        payload["deadline_s"] = res.deadline_s
     ctx = tracing.mint()
     res.trace_id = ctx[0]
     t0 = time.perf_counter()
@@ -135,32 +156,61 @@ def _submit_one(socket_path, res, timeout):
                       archive=os.path.basename(res.archive)):
             if tracing.current_span_id() is not None:
                 tracing.inject(payload)
-            try:
-                resp = client_request(socket_path, payload,
-                                      timeout=timeout + 30.0)
-            except (OSError, ValueError) as e:
-                res.error = "%s: %s" % (type(e).__name__, e)
-                return res
+            while True:
+                try:
+                    resp = client_request(socket_path, payload,
+                                          timeout=timeout + 30.0)
+                except (OSError, ValueError) as e:
+                    res.error = "%s: %s" % (type(e).__name__, e)
+                    return res
+                if not resp.get("ok") \
+                        and resp.get("error") == "draining" \
+                        and res.rerouted < _DRAIN_RETRIES:
+                    res.rerouted += 1
+                    time.sleep(_DRAIN_BACKOFF_S * res.rerouted)
+                    continue
+                break
     res.latency_s = time.perf_counter() - t0
     res.state = resp.get("state")
     res.cached = bool(resp.get("cached"))
     res.ok = bool(resp.get("ok")) and res.state == "done"
+    if res.deadline_s is not None and res.latency_s is not None:
+        res.deadline_miss = res.latency_s > res.deadline_s
     if not res.ok:
         res.error = resp.get("error") or resp.get("reason") \
             or ("state=%s" % res.state)
+    from ..obs import metrics
+
+    if res.latency_s is not None:
+        # per-priority client series: deadline classes diff separately
+        # in the obs_client run (pps_phase_seconds{...,priority=...})
+        metrics.observe(metrics.PHASE_HISTOGRAM, res.latency_s,
+                        phase="client_total", tenant=res.tenant,
+                        priority=str(res.priority))
     return res
 
 
 def run_load(socket_path, requests, mode="closed", rate=1.0,
-             concurrency=4, seed=0, timeout=600.0, quiet=True):
+             concurrency=4, seed=0, timeout=600.0, quiet=True,
+             priorities=None, deadlines=None):
     """Execute the load; returns (results, wall_s).
 
     Open loop: one thread per request fired at its seeded arrival
     offset.  Closed loop: ``concurrency`` workers drain the request
     list back-to-back.  Both are deterministic in *schedule*; actual
     latencies are, of course, the measurement.
+
+    ``priorities`` / ``deadlines`` (lists; a None deadline = no
+    deadline) are assigned round-robin across the schedule, so a
+    mixed-deadline-class run is deterministic too.
     """
     results = [_Result(t, a) for t, a in requests]
+    for i, res in enumerate(results):
+        if priorities:
+            res.priority = int(priorities[i % len(priorities)])
+        if deadlines:
+            d = deadlines[i % len(deadlines)]
+            res.deadline_s = None if d is None else float(d)
     t_start = time.perf_counter()
     if mode == "open":
         sched = arrival_schedule(len(results), rate, seed)
@@ -212,18 +262,25 @@ def summarize_load(results, wall_s, server_snapshot=None, slo=None):
 
     hist = metrics.Histogram()
     n_ok = n_err = n_cached = 0
+    n_rerouted = n_deadline_miss = 0
+    by_prio = {}
     for res in results:
         if res.latency_s is not None:
             # the client histogram carries exemplars too: a slow
             # client-side bucket resolves to its trace without asking
             # the daemon
             hist.observe(res.latency_s, exemplar=res.trace_id)
+            ph = by_prio.setdefault(res.priority, metrics.Histogram())
+            ph.observe(res.latency_s)
         if res.ok:
             n_ok += 1
         else:
             n_err += 1
         if res.cached:
             n_cached += 1
+        n_rerouted += res.rerouted
+        if res.deadline_miss:
+            n_deadline_miss += 1
     snap = hist.to_snapshot()
     verdict = metrics.evaluate_slo(slo or {}, snap, n_ok, n_err,
                                    wall_s)
@@ -233,6 +290,8 @@ def summarize_load(results, wall_s, server_snapshot=None, slo=None):
         "n_ok": n_ok,
         "n_err": n_err,
         "n_cached": n_cached,
+        "n_rerouted": n_rerouted,
+        "n_deadline_miss": n_deadline_miss,
         "wall_s": round(wall_s, 6),
         "client": {
             "histogram": snap,
@@ -243,6 +302,12 @@ def summarize_load(results, wall_s, server_snapshot=None, slo=None):
             "max_s": snap.get("max"),
             "throughput_rps": round(n_ok / wall_s, 6)
             if wall_s > 0 else None,
+            "priorities": {
+                str(p): {"n": h.count,
+                         "p50_s": h.quantile(0.5),
+                         "p99_s": h.quantile(0.99),
+                         "max_s": h.max}
+                for p, h in sorted(by_prio.items())},
         },
         "errors": [{"tenant": r.tenant,
                     "archive": os.path.basename(r.archive),
@@ -288,7 +353,13 @@ def build_parser():
                         "under it).")
     p.add_argument("--socket", default=None,
                    help="Unix socket path (default: "
-                        "<workdir>/ppserve.sock).")
+                        "<workdir>/ppserve.sock, or "
+                        "<workdir>/pprouter.sock with --router).")
+    p.add_argument("--router", action="store_true",
+                   help="Target a pprouter fleet socket instead of a "
+                        "single daemon (same protocol; 'draining' "
+                        "rejections from a respawning fleet member "
+                        "retry instead of erroring).")
     p.add_argument("-t", "--tenants", default="loadgen",
                    help="Comma-separated tenant names, round-robined "
                         "across requests.")
@@ -309,6 +380,15 @@ def build_parser():
                    help="Schedule + spool-name seed (deterministic).")
     p.add_argument("--timeout", type=float, default=600.0,
                    help="Per-request wait timeout [s].")
+    p.add_argument("--priorities", default=None, metavar="P,P,...",
+                   help="Comma-separated integer priorities assigned "
+                        "round-robin across requests (higher "
+                        "dispatches first).")
+    p.add_argument("--deadlines", default=None, metavar="S,S,...",
+                   help="Comma-separated per-request deadlines [s] "
+                        "assigned round-robin ('none' = no deadline "
+                        "for that slot); drives the daemon's "
+                        "deadline-aware parking window.")
     p.add_argument("--spool", default=None,
                    help="Spool dir for per-request archive copies "
                         "(default: <workdir>/loadgen_spool).")
@@ -328,12 +408,33 @@ def build_parser():
     return p
 
 
+def parse_classes(priorities, deadlines):
+    """(priorities list, deadlines list) from the CLI comma strings;
+    'none'/'-' deadline slots mean no deadline."""
+    prios = None
+    if priorities:
+        prios = [int(x) for x in priorities.split(",") if x.strip()]
+    dls = None
+    if deadlines:
+        dls = []
+        for x in deadlines.split(","):
+            x = x.strip()
+            if not x:
+                continue
+            dls.append(None if x.lower() in ("none", "-")
+                       else float(x))
+    return prios, dls
+
+
 def main(argv=None):
     args = build_parser().parse_args(argv)
-    from ..service import DEFAULT_SOCKET_NAME, client_request
+    from ..service import DEFAULT_ROUTER_SOCKET_NAME, \
+        DEFAULT_SOCKET_NAME, client_request
 
-    sock = args.socket or os.path.join(args.workdir,
-                                       DEFAULT_SOCKET_NAME)
+    sock = args.socket or os.path.join(
+        args.workdir,
+        DEFAULT_ROUTER_SOCKET_NAME if args.router
+        else DEFAULT_SOCKET_NAME)
     try:
         slo = load_slo(args.slo)
     except (OSError, json.JSONDecodeError) as e:
@@ -364,11 +465,18 @@ def main(argv=None):
     client_run = contextlib.nullcontext() if args.no_trace else \
         obs.run("pploadgen",
                 base_dir=os.path.join(args.workdir, "obs_client"))
+    try:
+        prios, dls = parse_classes(args.priorities, args.deadlines)
+    except ValueError as e:
+        print("pploadgen: bad --priorities/--deadlines: %s" % e,
+              file=sys.stderr)
+        return 2
     with client_run:
         results, wall_s = run_load(
             sock, requests, mode=args.mode, rate=args.rate,
             concurrency=args.concurrency, seed=args.seed,
-            timeout=args.timeout, quiet=args.quiet)
+            timeout=args.timeout, quiet=args.quiet,
+            priorities=prios, deadlines=dls)
     try:
         server_snap = client_request(
             sock, {"op": "metrics"}, timeout=30.0).get("snapshot")
@@ -384,6 +492,10 @@ def main(argv=None):
                                    "wall_s")}
     line.update({k: report["client"][k]
                  for k in ("p50_s", "p99_s", "throughput_rps")})
+    if report["n_rerouted"]:
+        line["n_rerouted"] = report["n_rerouted"]
+    if dls:
+        line["n_deadline_miss"] = report["n_deadline_miss"]
     if slo:
         line["slo_ok"] = report["slo"]["ok"]
     print(json.dumps(line))

@@ -66,6 +66,12 @@ def build_parser():
                          "device dispatch.")
     st.add_argument("--batch", type=int, default=8, dest="batch_max",
                     help="Max requests per micro-batch cycle.")
+    st.add_argument("--solo-window", type=float, default=0.1,
+                    metavar="S", dest="solo_window_s",
+                    help="Grace window [s] when a cycle has no other "
+                         "parked candidate to coalesce with — a solo "
+                         "late arriver dispatches after this instead "
+                         "of the full --window.")
     st.add_argument("--max-inflight", type=int, default=4,
                     dest="tenant_max_inflight",
                     help="Per-tenant cap on slots in one cycle "
@@ -185,6 +191,7 @@ def _cmd_start(args):
         args.modelfile, args.workdir, plan=plan,
         narrowband=args.narrowband,
         batch_window_s=args.batch_window_s, batch_max=args.batch_max,
+        solo_window_s=args.solo_window_s,
         tenant_max_inflight=args.tenant_max_inflight,
         tenant_max_queue=args.tenant_max_queue,
         max_attempts=args.max_attempts, backoff_s=args.backoff,

@@ -101,10 +101,15 @@ BUILTIN_RULES = (
     {"name": "compile_cache_postwarm", "kind": "rate",
      "severity": "warning",
      "signal": ("pps_compile_cache_misses_total",),
-     "guard_gauge": WARM_GAUGE, "guard_value": 1,
      "op": ">=", "threshold": 1, "window_s": 120.0, "for_s": 0.0,
+     "guard_gauge": WARM_GAUGE, "guard_value": 1,
      "summary": "compile-cache misses after warm-up: the zero-cold-"
                 "start contract is leaking compiles"},
+    {"name": "daemon_churn", "kind": "rate", "severity": "warning",
+     "signal": ("pps_respawns_total",),
+     "op": ">=", "threshold": 2, "window_s": 300.0, "for_s": 0.0,
+     "summary": "fleet daemons respawning repeatedly (crash-looping "
+                "replica or poisoned bucket)"},
 )
 
 _OPS = {

@@ -12,6 +12,7 @@ called inside jit (jaxlint J002 covers the ``service.*`` surface).
 
 from .batcher import MicroBatcher
 from .daemon import Request, TOAService
+from .router import DEFAULT_ROUTER_SOCKET_NAME, FleetRouter
 from .server import DEFAULT_SOCKET_NAME, ServiceServer, client_request
 from .warm import (enable_persistent_cache, program_specs,
                    synth_databunch, warm_plan)
@@ -19,4 +20,5 @@ from .warm import (enable_persistent_cache, program_specs,
 __all__ = ["TOAService", "Request", "MicroBatcher", "ServiceServer",
            "client_request", "DEFAULT_SOCKET_NAME", "warm_plan",
            "program_specs", "synth_databunch",
-           "enable_persistent_cache"]
+           "enable_persistent_cache", "FleetRouter",
+           "DEFAULT_ROUTER_SOCKET_NAME"]

@@ -112,6 +112,10 @@ class MicroBatcher:
         self._parked = []
         self._expected = 0
         self._done = 0
+        # absolute dispatch deadline for the current cycle (service
+        # dispatcher: the most urgent member's completion deadline);
+        # None = window-only semantics
+        self._deadline = None
         # cumulative stats (service status / obs)
         self.n_dispatches = 0
         self.n_calls = 0
@@ -119,12 +123,20 @@ class MicroBatcher:
 
     # -- cycle management ----------------------------------------------
 
-    def begin(self, n):
-        """Open a cycle of ``n`` workers (dispatcher thread)."""
+    def begin(self, n, deadline=None):
+        """Open a cycle of ``n`` workers (dispatcher thread).
+
+        ``deadline`` (absolute ``time.time()``) caps how long parked
+        members wait for stragglers: past it, whoever notices leads a
+        partial dispatch — a stalled sibling cannot park the rest of
+        the cycle beyond the most urgent member's deadline.
+        """
         with self._lock:
             self._expected = int(n)
             self._done = 0
             self._parked = []
+            self._deadline = None if deadline is None \
+                else float(deadline)
 
     def worker_done(self):
         """A worker of the cycle finished (fit call resolved, or it
@@ -154,13 +166,11 @@ class MicroBatcher:
             if self._barrier_met():
                 self._fire_locked()
             else:
-                deadline = threading.TIMEOUT_MAX if self.window_s <= 0 \
-                    else self.window_s
                 while not slot.event.is_set():
-                    if not self._cond.wait(timeout=deadline):
-                        # window expired: whoever notices first leads a
-                        # partial dispatch so one slow sibling cannot
-                        # hold the batch hostage
+                    if not self._cond.wait(timeout=self._park_timeout()):
+                        # window (or cycle deadline) expired: whoever
+                        # notices first leads a partial dispatch so one
+                        # slow sibling cannot hold the batch hostage
                         if not slot.event.is_set():
                             self._fire_locked()
                         break
@@ -172,6 +182,17 @@ class MicroBatcher:
         if slot.error is not None:
             raise slot.error
         return slot.result
+
+    def _park_timeout(self):
+        """In-barrier wait budget: the configured window, trimmed to
+        the cycle deadline when one is nearer (caller holds the
+        lock)."""
+        timeout = threading.TIMEOUT_MAX if self.window_s <= 0 \
+            else self.window_s
+        if self._deadline is not None:
+            timeout = min(timeout,
+                          max(0.01, self._deadline - time.time()))
+        return timeout
 
     def _barrier_met(self):
         # every expected worker is either parked here or fully done:

@@ -51,6 +51,7 @@ Request run dirs are pruned to a count/byte budget
 process cannot grow obs state without bound.
 """
 
+import collections
 import contextlib
 import functools
 import itertools
@@ -86,6 +87,19 @@ DISPATCHING = "dispatching"
 
 _REQ_SEQ = itertools.count(1)
 
+# deadline-aware parking (docs/SERVICE.md "Deadline semantics"): a
+# request is never parked past this fraction of its deadline budget —
+# the rest is reserved for the fit itself
+PARK_FRACTION = 0.5
+
+# adaptive window ceiling: under sustained load the parking window
+# stretches up to this multiple of ``batch_window_s`` (denser batches
+# when arrivals keep coming), never beyond
+WINDOW_STRETCH_MAX = 4.0
+
+# arrival-rate window feeding the load stretch [s]
+_LOAD_WINDOW_S = 1.0
+
 
 def _blabel(key):
     """Metrics label for a shape bucket ('-' before classification)."""
@@ -108,9 +122,11 @@ class Request:
                  "nsub", "nchan", "nbin", "state", "reason", "attempts",
                  "n_toas", "toa_lines", "quality", "t_submit", "t_done",
                  "done_evt", "recorder", "recovered", "batch_id",
-                 "trace_id", "parent_span_id", "span_id", "ticket")
+                 "trace_id", "parent_span_id", "span_id", "ticket",
+                 "priority", "deadline_s")
 
-    def __init__(self, req_id, tenant, path, key, config):
+    def __init__(self, req_id, tenant, path, key, config,
+                 priority=0, deadline_s=None):
         self.id = req_id
         self.tenant = tenant
         self.path = path
@@ -126,6 +142,13 @@ class Request:
         # fit-quality fingerprint of the request's archive
         # (obs/quality.py gt_fingerprint, stamped before checkin)
         self.quality = None
+        # deadline class (docs/SERVICE.md): higher priority seeds
+        # cycles first; ``deadline_s`` is a completion budget from
+        # submit time — the dispatcher never parks the request past
+        # PARK_FRACTION of it (None = no deadline, window semantics)
+        self.priority = int(priority or 0)
+        self.deadline_s = None if deadline_s is None \
+            else max(0.0, float(deadline_s))
         self.t_submit = time.time()
         self.t_done = None
         self.done_evt = threading.Event()
@@ -148,6 +171,20 @@ class Request:
         parent on."""
         return (self.trace_id, self.span_id)
 
+    def park_cutoff(self):
+        """Absolute time by which this request must leave the parking
+        window (half its deadline budget spent), or None when it has
+        no deadline."""
+        if self.deadline_s is None:
+            return None
+        return self.t_submit + PARK_FRACTION * self.deadline_s
+
+    def deadline_at(self):
+        """Absolute completion deadline, or None."""
+        if self.deadline_s is None:
+            return None
+        return self.t_submit + self.deadline_s
+
     def payload(self, cached=False):
         out = {"ok": True, "request_id": self.id, "tenant": self.tenant,
                "archive": self.path, "state": self.state,
@@ -156,6 +193,13 @@ class Request:
             out["trace_id"] = self.trace_id
         if self.bucket:
             out["bucket"] = "%dx%d" % self.bucket
+        if self.priority:
+            out["priority"] = self.priority
+        if self.deadline_s is not None:
+            out["deadline_s"] = self.deadline_s
+            if self.t_done is not None:
+                out["deadline_miss"] = \
+                    (self.t_done - self.t_submit) > self.deadline_s
         if self.reason:
             out["reason"] = self.reason
         if self.state == DONE:
@@ -241,7 +285,7 @@ class TOAService:
     """
 
     def __init__(self, modelfile, workdir, plan=None, narrowband=False,
-                 batch_window_s=0.25, batch_max=8,
+                 batch_window_s=0.25, batch_max=8, solo_window_s=0.1,
                  tenant_max_inflight=4, tenant_max_queue=64,
                  max_attempts=3, backoff_s=0.0, run_dirs_max=None,
                  run_bytes_max=None, mem_budget_bytes=None,
@@ -255,6 +299,12 @@ class TOAService:
         self.narrowband = bool(narrowband)
         self.batch_window_s = float(batch_window_s)
         self.batch_max = max(1, int(batch_max))
+        # adaptive-window floor: a cycle with no other joinable
+        # candidate dispatches after this grace instead of the full
+        # window — the window only ever buys coalescing, never pure
+        # latency (the solo-late-arriver fix, docs/SERVICE.md)
+        self.solo_window_s = min(float(solo_window_s),
+                                 self.batch_window_s)
         self.tenant_max_inflight = max(1, int(tenant_max_inflight))
         self.tenant_max_queue = max(1, int(tenant_max_queue))
         self.max_attempts = int(max_attempts)
@@ -295,6 +345,9 @@ class TOAService:
         self._done_keep = 4096
         self._buckets = {}
         self._draining = False
+        # recent submit timestamps: the arrival-rate signal the
+        # adaptive parking window stretches on (bounded, lock-held)
+        self._recent_submits = collections.deque(maxlen=64)
         self._stopped = threading.Event()
         self._drained = threading.Event()
         self._thread = None
@@ -316,6 +369,7 @@ class TOAService:
             config={"modelfile": self.modelfile,
                     "narrowband": self.narrowband,
                     "batch_window_s": self.batch_window_s,
+                    "solo_window_s": self.solo_window_s,
                     "batch_max": self.batch_max,
                     "tenant_max_inflight": self.tenant_max_inflight,
                     "tenant_max_queue": self.tenant_max_queue,
@@ -475,11 +529,12 @@ class TOAService:
             ctx=rq.ctx())
 
     def _new_request(self, tenant, path, key, config, recovered=False,
-                     traceparent=None):
+                     traceparent=None, priority=0, deadline_s=None):
         """Register an open request (caller holds the lock)."""
         rq = Request("r%06d" % next(_REQ_SEQ), tenant.name, path, key,
-                     config)
+                     config, priority=priority, deadline_s=deadline_s)
         rq.recovered = recovered
+        self._recent_submits.append(rq.t_submit)
         # join the client's trace (traceparent carrier) or mint a new
         # one: every accepted request is traceable, client-aware or not
         ctx = tracing.parse_traceparent(traceparent)
@@ -500,8 +555,15 @@ class TOAService:
         return rq
 
     def submit(self, tenant, archive, config=None, wait=False,
-               timeout=None, traceparent=None):
+               timeout=None, traceparent=None, priority=0,
+               deadline_s=None):
         """Accept one TOA request; returns the response payload.
+
+        ``priority`` (int, higher = more urgent) orders cycle seeding;
+        ``deadline_s`` is a completion budget from submit time: the
+        dispatcher never parks the request past ``PARK_FRACTION`` of
+        it, and a terminal result past it counts a deadline miss
+        (``pps_deadline_total``).
 
         Replays: an archive this tenant's ledger already records as
         done/quarantined responds with the recorded outcome instead of
@@ -563,7 +625,9 @@ class TOAService:
                     return {"ok": False, "error": "backpressure",
                             "tenant": tenant, "open": len(t.fifo)}
                 rq = self._new_request(t, path, key, config,
-                                       traceparent=traceparent)
+                                       traceparent=traceparent,
+                                       priority=priority,
+                                       deadline_s=deadline_s)
                 obs.counter("service_requests")
         if rq.bucket is None:
             if self._classify(rq):
@@ -658,12 +722,68 @@ class TOAService:
             return now >= rec.get("retry_at", 0.0)
         return rec["state"] not in (DONE, QUARANTINED)
 
+    @staticmethod
+    def _seed_key(rq):
+        """Cycle-seeding order: highest priority class first; within
+        a class the nearest park cutoff (deadline-bearing requests),
+        then oldest.  Deadline-free requests sort by age alone, the
+        pre-deadline behavior."""
+        cut = rq.park_cutoff()
+        return (-rq.priority,
+                cut if cut is not None else float("inf"),
+                rq.t_submit)
+
+    def _joinable_locked(self, batch, seed):
+        """Could waiting grow this cycle?  True when any other open
+        request might still land in the seed's bucket (unclassified
+        requests count: their bucket is not known yet)."""
+        members = {rq.id for rq in batch}
+        for rq in self._requests.values():
+            if rq.id in members or rq.state != PENDING:
+                continue
+            if rq.bucket is not None and rq.bucket != seed.bucket:
+                continue
+            return True
+        return False
+
+    def _fire_at_locked(self, batch, seed, now):
+        """Absolute dispatch time for the assembled cycle — the
+        adaptive parking window (docs/SERVICE.md "Deadline
+        semantics"):
+
+        * base window anchored at the seed's submit time;
+        * stretched up to ``WINDOW_STRETCH_MAX``× by the recent
+          arrival rate (denser batches under load);
+        * collapsed to ``solo_window_s`` when nothing else can join
+          (a solo late arriver never pays the full window);
+        * clamped to the earliest member's park cutoff — a request is
+          never parked past ``PARK_FRACTION`` of its deadline.
+        """
+        window = self.batch_window_s
+        if window > 0:
+            if len(batch) == 1 and not self._joinable_locked(batch,
+                                                             seed):
+                window = self.solo_window_s
+            else:
+                cutoff = now - _LOAD_WINDOW_S
+                arrivals = sum(1 for t in self._recent_submits
+                               if t >= cutoff)
+                stretch = min(WINDOW_STRETCH_MAX,
+                              1.0 + arrivals / float(self.batch_max))
+                window *= stretch
+        t_fire = seed.t_submit + window
+        for rq in batch:
+            cut = rq.park_cutoff()
+            if cut is not None:
+                t_fire = min(t_fire, cut)
+        return t_fire
+
     def _collect_batch(self):
-        """Assemble the next micro-batch: seed from the tenant whose
-        oldest ready request waited longest, fill with same-bucket
-        ready requests (oldest first, per-tenant inflight cap), and
-        hold the cycle open until the seed has aged ``batch_window_s``
-        or the batch is full."""
+        """Assemble the next micro-batch: seed by priority class /
+        park cutoff / age (:meth:`_seed_key`), fill with same-bucket
+        ready requests (seed order, per-tenant inflight cap), and hold
+        the cycle open until the adaptive window expires
+        (:meth:`_fire_at_locked`) or the batch is full."""
         with self._lock:
             while True:
                 if self._stopped.is_set():
@@ -678,11 +798,10 @@ class TOAService:
                     # submission, or a drain
                     self._cond.wait(timeout=0.1)
                     continue
-                seed = min(ready, key=lambda rq: rq.t_submit)
-                age = now - seed.t_submit
+                seed = min(ready, key=self._seed_key)
                 batch = self._fill_batch_locked(ready, seed)
-                if len(batch) >= self.batch_max \
-                        or age >= self.batch_window_s:
+                t_fire = self._fire_at_locked(batch, seed, now)
+                if len(batch) >= self.batch_max or now >= t_fire:
                     for rq in batch:
                         rq.state = DISPATCHING
                         self._tenants[rq.tenant].inflight += 1
@@ -691,13 +810,12 @@ class TOAService:
                             "pps_inflight",
                             self._tenants[name].inflight, tenant=name)
                     return batch
-                self._cond.wait(timeout=max(0.01,
-                                            self.batch_window_s - age))
+                self._cond.wait(timeout=max(0.01, t_fire - now))
 
     def _fill_batch_locked(self, ready, seed):
         per_tenant = {}
         batch = []
-        for rq in sorted(ready, key=lambda r: r.t_submit):
+        for rq in sorted(ready, key=self._seed_key):
             if rq.bucket != seed.bucket:
                 continue
             n = per_tenant.get(rq.tenant, 0)
@@ -747,7 +865,13 @@ class TOAService:
             tracing.emit_span("queue_wait", wait_s, ctx=rq.ctx(),
                               request=rq.id, batch=batch_id)
             self._emit_request(rq, "dispatching")
-        bucket.batcher.begin(len(batch))
+        # deadline hint: a stalled cycle sibling cannot hold the
+        # barrier past the most urgent member's completion deadline
+        deadlines = [rq.deadline_at() for rq in batch]
+        deadlines = [d for d in deadlines if d is not None]
+        bucket.batcher.begin(len(batch),
+                             deadline=min(deadlines) if deadlines
+                             else None)
         workers = []
         for rq in batch:
             w = threading.Thread(target=self._run_one,
@@ -888,7 +1012,18 @@ class TOAService:
         metrics.observe(PHASE_HISTOGRAM, total_s,
                         phase="total", tenant=rq.tenant,
                         bucket=_blabel(rq.bucket),
+                        priority=str(rq.priority),
                         exemplar=rq.trace_id)
+        if rq.deadline_s is not None:
+            missed = total_s > rq.deadline_s
+            metrics.inc("pps_deadline_total", tenant=rq.tenant,
+                        outcome="miss" if missed else "met")
+            if missed:
+                obs.event("service_deadline_miss", request=rq.id,
+                          tenant=rq.tenant, archive=rq.path,
+                          deadline_s=rq.deadline_s,
+                          wall_s=round(total_s, 6),
+                          priority=rq.priority, state=state)
         # the daemon-side request span: the root every lifecycle child
         # (queue_wait/checkout/fit/...) parents on, itself a child of
         # the client's submit span when a traceparent arrived
@@ -1027,6 +1162,7 @@ class TOAService:
                    "tenants": tenants, "buckets": buckets,
                    "narrowband": self.narrowband,
                    "batch_window_s": self.batch_window_s,
+                   "solo_window_s": self.solo_window_s,
                    "batch_max": self.batch_max}
         rec = obs.current()
         if rec is not None:

@@ -13,6 +13,7 @@ Ops (all responses carry ``ok``)::
     {"op": "ping"}
     {"op": "submit", "tenant": T, "archive": PATH,
      "config": {...}, "wait": true, "timeout_s": 300,
+     "priority": 1, "deadline_s": 5.0,          # deadline class
      "traceparent": "00-<32hex>-<16hex>-01"}   # optional W3C carrier
     {"op": "wait", "request_id": "r000001", "timeout_s": 300}
     {"op": "status"}
@@ -138,7 +139,9 @@ class ServiceServer:
                               config=req.get("config"),
                               wait=bool(req.get("wait")),
                               timeout=req.get("timeout_s"),
-                              traceparent=req.get("traceparent"))
+                              traceparent=req.get("traceparent"),
+                              priority=req.get("priority") or 0,
+                              deadline_s=req.get("deadline_s"))
         if op == "wait":
             return svc.wait(req.get("request_id"),
                             timeout=req.get("timeout_s"))

@@ -375,6 +375,86 @@ def test_request_run_dir_budget(corpus, tmp_path):
     assert len(kept) <= 2, kept
 
 
+# -- adaptive parking window (deadline-aware scheduling) ---------------
+
+
+def test_adaptive_window_schedule_math(corpus, tmp_path):
+    """Unit math for the adaptive parking window: solo grace, full
+    window when joinable, deadline clamp, load stretch, seed order."""
+    from pulseportraiture_tpu.service import Request
+    from pulseportraiture_tpu.service.daemon import (PARK_FRACTION,
+                                                     PENDING,
+                                                     WINDOW_STRETCH_MAX)
+
+    svc = _service(corpus, tmp_path / "wd", batch_window_s=1.0,
+                   batch_max=4, solo_window_s=0.05)
+    now = time.time()
+
+    def mk(i, priority=0, deadline_s=None):
+        rq = Request("r%06d" % i, "t", "/a%d.fits" % i, "k%d" % i,
+                     None, priority=priority, deadline_s=deadline_s)
+        rq.t_submit = now
+        assert rq.state == PENDING
+        return rq
+
+    solo = mk(1)
+    # no other parked candidate: the solo grace, not the full window
+    assert svc._fire_at_locked([solo], solo, now) == \
+        pytest.approx(now + 0.05)
+    # another open request could still join: keep the full window
+    other = mk(2)
+    svc._requests[other.id] = other
+    assert svc._fire_at_locked([solo], solo, now) == \
+        pytest.approx(now + 1.0)
+    # a deadline-bearing member clamps the cycle to its park cutoff
+    tight = mk(3, deadline_s=0.4)
+    assert svc._fire_at_locked([solo, tight], solo, now) == \
+        pytest.approx(now + PARK_FRACTION * 0.4)
+    # arrival pressure stretches the window (bounded)
+    for _ in range(8):
+        svc._recent_submits.append(now)
+    assert svc._fire_at_locked([solo, other], solo, now) == \
+        pytest.approx(now + 1.0 * min(WINDOW_STRETCH_MAX,
+                                      1.0 + 8 / 4.0))
+    # seeding: higher priority first; then nearest park cutoff
+    lo, hi = mk(4), mk(5, priority=2)
+    near = mk(6, priority=2, deadline_s=0.2)
+    assert min([lo, hi, near], key=svc._seed_key) is near
+    assert min([lo, hi], key=svc._seed_key) is hi
+
+
+def test_solo_late_arriver_skips_window(corpus, tmp_path):
+    """A solo late arriver must NOT pay the full parking window: with
+    no other parked candidate the cycle dispatches after the solo
+    grace (docs/SERVICE.md deadline semantics).  Pre-fix, queue_wait
+    here was >= the full 5 s window."""
+    from pulseportraiture_tpu.obs import metrics as M
+
+    svc = _service(corpus, tmp_path / "wd",
+                   batch_window_s=5.0).start()
+    try:
+        r = svc.submit("alice", corpus.files[1], wait=True,
+                       timeout=300, deadline_s=120.0)
+        assert r["state"] == "done", r
+        assert r.get("deadline_miss") is False
+        snap = svc.metrics_snapshot()
+        qmax = 0.0
+        for key, h in (snap.get("histograms") or {}).items():
+            name, labels = M.parse_series(key)
+            if name == M.PHASE_HISTOGRAM \
+                    and labels.get("phase") == "queue_wait":
+                qmax = max(qmax, h.get("max") or 0.0)
+        assert 0.0 < qmax < 2.0, \
+            "solo dispatch waited the full window (%.3fs)" % qmax
+        # the deadline verdict lands in the outcome counter too
+        met = sum(v for k, v in snap["counters"].items()
+                  if k.startswith("pps_deadline_total")
+                  and 'outcome="met"' in k)
+        assert met == 1
+    finally:
+        assert svc.shutdown(timeout=120)
+
+
 # -- micro-batcher unit behavior ---------------------------------------
 
 
@@ -572,8 +652,9 @@ def test_metrics_lifecycle_histograms_and_socket_verb(corpus,
             assert phases.get(ph), (ph, phases)
         assert phases["total"] == 2 and phases["queue_wait"] == 2
         # per-tenant labeled series exist for the end-to-end phase
+        # (priority label: deadline classes diff separately)
         assert 'pps_phase_seconds{bucket="8x64",phase="total",' \
-               'tenant="alice"}' in snap["histograms"]
+               'priority="0",tenant="alice"}' in snap["histograms"]
         done = sum(v for k, v in snap["counters"].items()
                    if k.startswith('pps_requests_total')
                    and 'outcome="done"' in k)
@@ -581,7 +662,7 @@ def test_metrics_lifecycle_histograms_and_socket_verb(corpus,
         # total >= fit for the same request stream
         tot = M.quantile(snap["histograms"][
             'pps_phase_seconds{bucket="8x64",phase="total",'
-            'tenant="alice"}'], 0.5)
+            'priority="0",tenant="alice"}'], 0.5)
         assert tot and tot > 0.0
 
         prom = client_request(sock, {"op": "metrics",

@@ -98,7 +98,18 @@
 #                 healthy run self-diffs clean while healthy-vs-
 #                 faulted trips obs_diff's exact new-alerts gate
 #                 (docs/OBSERVABILITY.md Health)
-#  16. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
+#  16. fleet smoke — the bucket-routed serving fleet end to end: a
+#                 3-daemon FleetRouter on ONE persistent compile
+#                 cache vs a fixed-window single daemon on the same
+#                 mixed-bucket 2-tenant corpus must sustain >= 2.5x
+#                 the closed-loop throughput with zero deadline
+#                 misses and no deadline-class inversion (tight p99
+#                 < loose p99), then survive a mid-run SIGKILL of a
+#                 loose-bucket daemon: respawn in place, zero client
+#                 errors, exactly-once pp_done blocks fleet-wide,
+#                 and a merged obs report with the "## fleet"
+#                 section (docs/SERVICE.md Fleet)
+#  17. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
 #
 # Usage: tools/check.sh [--lint-only]
 #   --lint-only   run only the static stages (pplint + ruff + drift +
@@ -297,6 +308,17 @@ if [ $? -ne 0 ]; then
     fail=1
 else
     tail -1 /tmp/_health_smoke.log
+fi
+
+echo
+echo "== fleet smoke (bucket-routed fleet + SIGKILL respawn, docs/SERVICE.md) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu PPTPU_OBS_DIR="" PPTPU_FAULTS="" \
+    python -m tools.fleet_smoke >/tmp/_fleet_smoke.log 2>&1
+if [ $? -ne 0 ]; then
+    tail -40 /tmp/_fleet_smoke.log
+    fail=1
+else
+    tail -1 /tmp/_fleet_smoke.log
 fi
 
 echo

@@ -1,0 +1,347 @@
+"""Fleet smoke gate: router + 3 daemons on one compile cache must beat
+the fixed-window single daemon, honor deadline classes, and keep
+results exactly-once across a mid-run SIGKILL (wired into
+tools/check.sh).
+
+The scenario (ISSUE 18 / docs/SERVICE.md "Fleet"):
+
+* a corpus of three shape buckets; two tenants with mixed deadline
+  classes — ``alice`` tight-deadline high-priority traffic on one
+  bucket, ``bob`` loose-deadline traffic on the other two (one of
+  bob's buckets carries two concurrent streams, so it genuinely
+  coalesces and parks).
+* **baseline**: one ``ppserve`` daemon with the pre-fleet fixed
+  parking window (``--solo-window`` == ``--window``: every cycle —
+  solo or not — pays the full window, the semantics this PR's
+  adaptive window replaced).  Its warm-up also populates the shared
+  persistent compile cache.
+* **fleet**: a 3-daemon :class:`FleetRouter` on the SAME compile
+  cache and plan, driven closed-loop through the router socket with
+  the same traffic shape.  Gates: closed-loop throughput >= 2.5x the
+  baseline, overall p99 inside the SLO spec, ZERO deadline misses
+  (every class's deadline >= 2x the warm fit p99), and no
+  deadline-class inversion (tight p99 < loose p99 — deadline-aware
+  parking must actually prioritize).
+* **chaos**: a second fleet load burst with the daemon owning a
+  loose bucket SIGKILLed mid-run.  The router respawns it in place,
+  re-routes its bucket for new work, and the per-tenant ledgers keep
+  every archive exactly-once (one ``pp_done`` block per archive
+  across the whole fleet); the client sees zero errors.  The merged
+  obs report renders the "## fleet" section with the churn.
+
+Run:  env JAX_PLATFORMS=cpu python -m tools.fleet_smoke
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+THROUGHPUT_GAIN = 2.5      # fleet vs fixed-window single daemon
+WINDOW_S = 1.0             # parking window both sides run with
+N_BASE = 8                 # baseline closed-loop requests
+N_FLEET = 16               # fleet throughput-phase requests
+N_CHAOS = 24               # chaos-phase requests
+
+
+def _p99(vals):
+    vals = sorted(v for v in vals if v is not None)
+    if not vals:
+        return None
+    return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+
+
+def _done_blocks(root):
+    """pp_done checkpoint blocks per archive basename under a service
+    workdir tree (the exactly-once ledger evidence)."""
+    out = {}
+    for dirpath, _dirs, names in os.walk(root):
+        for name in names:
+            if name != "toas.tim":
+                continue
+            with open(os.path.join(dirpath, name),
+                      encoding="utf-8") as fh:
+                for ln in fh:
+                    parts = ln.split()
+                    if parts[:2] == ["C", "pp_done"]:
+                        base = os.path.basename(parts[2]) \
+                            if len(parts) > 2 else "?"
+                        out[base] = out.get(base, 0) + 1
+    return out
+
+
+def _wait_ready(proc, timeout=420.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise AssertionError(
+                "daemon exited before ready: rc=%s" % proc.poll())
+        line = line.decode("utf-8", "replace").strip()
+        if line.startswith("PPSERVE_READY "):
+            return json.loads(line[len("PPSERVE_READY "):])
+    raise AssertionError("daemon never became ready")
+
+
+def main():
+    workroot = tempfile.mkdtemp(prefix="pptpu_fleet_smoke_")
+    base_proc = None
+    router = None
+    rserver = None
+    try:
+        from pulseportraiture_tpu.cli.pploadgen import (build_requests,
+                                                        run_load,
+                                                        summarize_load)
+        from pulseportraiture_tpu.io.archive import make_fake_pulsar
+        from pulseportraiture_tpu.io.gmodel import write_model
+        from pulseportraiture_tpu.runner.plan import plan_survey
+        from pulseportraiture_tpu.service import (
+            DEFAULT_ROUTER_SOCKET_NAME, FleetRouter, ServiceServer,
+            client_request)
+
+        t_all = time.monotonic()
+        gm = os.path.join(workroot, "fleet.gmodel")
+        write_model(gm, "fleet", "000", 1500.0,
+                    np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0,
+                              -0.5]),
+                    np.ones(8, int), -4.0, 0, quiet=True)
+        par = os.path.join(workroot, "fleet.par")
+        with open(par, "w") as f:
+            f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                    "PEPOCH 56000.0\nDM 30.0\n")
+        # three shape buckets; b1 twice so bob's traffic coalesces
+        shapes = [("a0", 8, 64), ("b1a", 16, 64), ("b1b", 16, 64),
+                  ("b2", 8, 128)]
+        archives = []
+        for i, (tag, nchan, nbin) in enumerate(shapes):
+            fits = os.path.join(workroot, tag + ".fits")
+            make_fake_pulsar(gm, par, fits, nsub=2, nchan=nchan,
+                             nbin=nbin, nu0=1500.0, bw=800.0,
+                             tsub=60.0, phase=0.02 * (i + 1),
+                             dDM=5e-4, noise_stds=0.01,
+                             dedispersed=False, seed=61 + i,
+                             quiet=True)
+            archives.append(fits)
+        plan = plan_survey(archives, modelfile=gm)
+        assert len(plan.buckets) == 3, plan.to_dict()
+        plan_path = os.path.join(workroot, "plan.json")
+        plan.save(plan_path)
+        cache = os.path.join(workroot, "compile_cache")
+
+        # request slot i -> tenant/class (round-robin, matching the
+        # archives order): alice tight+priority on a0, bob loose on
+        # b1a/b1b/b2
+        tenants = ["alice", "bob", "bob", "bob"]
+        priorities = [1, 0, 0, 0]
+
+        # -- baseline: fixed-window single daemon --------------------
+        # --solo-window == --window reproduces the pre-adaptive
+        # semantics: a solo late arriver pays the full window
+        base_wd = os.path.join(workroot, "single")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PPTPU_FAULTS", None)
+        base_proc = subprocess.Popen(
+            [sys.executable, "-m", "pulseportraiture_tpu.cli.ppserve",
+             "start", "-w", base_wd, "-m", gm, "--plan", plan_path,
+             "--warm", "--compile-cache", cache,
+             "--window", str(WINDOW_S),
+             "--solo-window", str(WINDOW_S),
+             "--batch", "4", "--backoff", "0", "--no_bary",
+             "--quiet"],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL)
+        ready = _wait_ready(base_proc)
+        assert ready["warmed"], ready
+        print("fleet smoke: baseline daemon warm after %.1fs"
+              % (time.monotonic() - t_all))
+
+        base_reqs = build_requests(
+            archives, N_BASE, tenants,
+            os.path.join(workroot, "spool_base"), seed=1)
+        base_results, base_wall = run_load(
+            ready["socket"], base_reqs, mode="closed", concurrency=4,
+            timeout=300.0, priorities=priorities,
+            deadlines=None)  # the fixed window has no deadline lever
+        assert all(r.ok for r in base_results), \
+            [r.error for r in base_results if not r.ok]
+        try:
+            snap = client_request(ready["socket"], {"op": "metrics"},
+                                  timeout=30.0).get("snapshot")
+        except (OSError, ValueError):
+            snap = None
+        base_report = summarize_load(base_results, base_wall,
+                                     server_snapshot=snap)
+        single_rps = base_report["client"]["throughput_rps"]
+        fit_p99 = ((base_report.get("server") or {}).get("phases")
+                   or {}).get("fit", {}).get("p99_s") or 0.5
+        client_request(ready["socket"], {"op": "shutdown"},
+                       timeout=10.0)
+        assert base_proc.wait(timeout=120) == 0
+        base_proc = None
+        print("fleet smoke: baseline %.3f req/s (fixed %.1fs window), "
+              "warm fit p99 %.3fs" % (single_rps, WINDOW_S, fit_p99))
+
+        # deadline classes: both >= 2x the warm fit p99, so the
+        # zero-miss gate covers every request (tight gets extra
+        # headroom for single-core contention with 4 workers)
+        tight_d = max(3.0, 4.0 * fit_p99)
+        loose_d = max(120.0, 10.0 * tight_d)
+        deadlines = [tight_d, loose_d, loose_d, loose_d]
+
+        # -- the fleet: 3 daemons, same cache, same plan -------------
+        fleet_wd = os.path.join(workroot, "fleet")
+        router = FleetRouter(
+            gm, fleet_wd, n_daemons=3, plan=plan_path,
+            compile_cache=cache, warm=True,
+            batch_window_s=WINDOW_S, batch_max=4,
+            health_interval_s=0.5, unhealthy_after=2,
+            daemon_args=["--no_bary", "--backoff", "0"], quiet=True)
+        router.start(ready_timeout=420)
+        assert all(d.ready.is_set() for d in router._daemons), \
+            router.status()
+        rsock = os.path.join(fleet_wd, DEFAULT_ROUTER_SOCKET_NAME)
+        rserver = ServiceServer(router, rsock).start()
+        print("fleet smoke: 3-daemon fleet warm after %.1fs"
+              % (time.monotonic() - t_all))
+
+        # phase A: healthy-fleet throughput + deadline semantics
+        fleet_reqs = build_requests(
+            archives, N_FLEET, tenants,
+            os.path.join(workroot, "spool_fleet"), seed=2)
+        slo = {"p99_s": 20.0, "max_error_rate": 0.0,
+               "min_requests": N_FLEET}
+        fleet_results, fleet_wall = run_load(
+            rsock, fleet_reqs, mode="closed", concurrency=4,
+            timeout=300.0, priorities=priorities,
+            deadlines=deadlines)
+        assert all(r.ok for r in fleet_results), \
+            [r.error for r in fleet_results if not r.ok]
+        merged = router.metrics_snapshot()
+        fleet_report = summarize_load(fleet_results, fleet_wall,
+                                      server_snapshot=None, slo=slo)
+        fleet_rps = fleet_report["client"]["throughput_rps"]
+        fleet_p99 = fleet_report["client"]["p99_s"]
+        assert fleet_report["slo"]["ok"], fleet_report["slo"]
+        misses = [r for r in fleet_results if r.deadline_miss]
+        miss_rate = len(misses) / float(len(fleet_results))
+        assert not misses, \
+            [(r.archive, r.latency_s, r.deadline_s) for r in misses]
+        tight_p99 = _p99([r.latency_s for r in fleet_results
+                          if r.deadline_s == tight_d])
+        loose_p99 = _p99([r.latency_s for r in fleet_results
+                          if r.deadline_s == loose_d])
+        assert tight_p99 < loose_p99, (tight_p99, loose_p99)
+        gain = fleet_rps / single_rps
+        print("fleet smoke: fleet %.3f req/s (%.2fx baseline), "
+              "p99 %.3fs, tight p99 %.3fs < loose p99 %.3fs, "
+              "0 deadline misses"
+              % (fleet_rps, gain, fleet_p99, tight_p99, loose_p99))
+        assert gain >= THROUGHPUT_GAIN, \
+            "fleet %.3f req/s vs single %.3f req/s = %.2fx < %.1fx" \
+            % (fleet_rps, single_rps, gain, THROUGHPUT_GAIN)
+        # the merged snapshot really covers router + members
+        assert len(merged.get("merged_from") or []) == 4, \
+            merged.get("merged_from")
+
+        # phase B: SIGKILL the daemon owning a loose bucket mid-run
+        # (never alice's tight bucket — in-flight work pinned to the
+        # dead daemon waits out the respawn, which a tight deadline
+        # would not survive; loose deadlines absorb it)
+        victim = router._assign.get((8, 128))
+        tight_owner = router._assign.get((8, 64))
+        if victim is None or victim is tight_owner:
+            victim = next(d for d in router._daemons
+                          if d is not tight_owner and d.proc)
+        victim_name = victim.name
+
+        def _kill():
+            time.sleep(0.4)
+            if victim.proc is not None:
+                os.kill(victim.proc.pid, signal.SIGKILL)
+
+        killer = threading.Thread(target=_kill, daemon=True,
+                                  name="pptpu-fleet-killer")
+        chaos_reqs = build_requests(
+            archives, N_CHAOS, tenants,
+            os.path.join(workroot, "spool_chaos"), seed=3)
+        killer.start()
+        chaos_results, chaos_wall = run_load(
+            rsock, chaos_reqs, mode="closed", concurrency=4,
+            timeout=300.0, priorities=priorities,
+            deadlines=[tight_d] + [loose_d] * 3)
+        killer.join(10.0)
+        assert all(r.ok for r in chaos_results), \
+            [(r.archive, r.error) for r in chaos_results if not r.ok]
+        for _ in range(600):  # supervisor may still be respawning
+            if victim.respawns >= 1:
+                break
+            time.sleep(0.1)
+        assert victim.respawns >= 1, \
+            "victim %s never respawned" % victim_name
+        print("fleet smoke: chaos burst survived SIGKILL of %s "
+              "(respawned, %.1fs wall, 0 client errors)"
+              % (victim_name, chaos_wall))
+
+        # exactly-once across the whole fleet: every spooled archive
+        # has exactly ONE pp_done checkpoint block fleet-wide
+        blocks = _done_blocks(fleet_wd)
+        expect = {os.path.basename(r.archive): 1
+                  for r in fleet_results + chaos_results}
+        assert blocks == expect, \
+            {k: v for k, v in blocks.items() if expect.get(k) != v}
+
+        ok = router.shutdown(timeout=180)
+        assert ok, "fleet drain timed out"
+        rserver.stop()
+        rserver = None
+
+        # merged fleet report: the router run renders "## fleet" with
+        # the churn the SIGKILL caused
+        from tools.obs_report import summarize
+
+        obs_base = os.path.join(fleet_wd, "obs")
+        runs = sorted(os.path.join(obs_base, d)
+                      for d in os.listdir(obs_base))
+        assert runs, "no router obs run recorded"
+        text = summarize(runs[-1])
+        assert "## fleet" in text, text
+        assert victim_name in text, text
+        assert "respawn" in text, text
+        router = None
+
+        result = {
+            "fleet_req_per_s": round(fleet_rps, 6),
+            "single_daemon_req_per_s": round(single_rps, 6),
+            "throughput_gain": round(gain, 3),
+            "fleet_p99_s": round(fleet_p99, 6),
+            "tight_p99_s": round(tight_p99, 6),
+            "loose_p99_s": round(loose_p99, 6),
+            "deadline_miss_rate": miss_rate,
+            "respawns": 1,
+            "wall_s": round(time.monotonic() - t_all, 3),
+        }
+        print("fleet smoke OK: %s" % json.dumps(result))
+        return 0
+    finally:
+        if base_proc is not None and base_proc.poll() is None:
+            base_proc.kill()
+        if rserver is not None:
+            rserver.stop()
+        if router is not None:
+            try:
+                router.shutdown(timeout=30)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

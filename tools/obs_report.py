@@ -878,6 +878,89 @@ def summarize_service(events, snapshot=None):
     return "\n".join(lines)
 
 
+def summarize_fleet(events):
+    """The ``## fleet`` section: the router's view of its daemons
+    (docs/SERVICE.md "Fleet") — fleet size and readiness, the
+    bucket→daemon assignment trail, member churn (deaths, respawns,
+    rebalances), load-sheds and forward retries.  Only a router run
+    emits ``router_*`` events, so daemon/runner reports skip the
+    section entirely."""
+    evs = [e for e in events if e.get("kind") == "event"
+           and str(e.get("name", "")).startswith("router_")]
+    if not evs:
+        return None
+    by = {}
+    for e in evs:
+        by.setdefault(e["name"], []).append(e)
+    lines = []
+    started = by.get("router_started")
+    if started:
+        e = started[-1]
+        lines.append("fleet: %s daemon(s), %s ready at start-up"
+                     % (e.get("n_daemons", "?"), e.get("ready", "?")))
+    ready = by.get("router_daemon_ready") or []
+    respawn_ready = sum(1 for e in ready if e.get("respawn"))
+    if ready:
+        lines.append("daemon ready events: %d (%d from respawn)"
+                     % (len(ready), respawn_ready))
+    downs = by.get("router_daemon_down") or []
+    respawns = by.get("router_respawn") or []
+    if downs or respawns:
+        per = {}
+        for e in downs:
+            d = per.setdefault(str(e.get("daemon", "?")),
+                               {"down": 0, "respawn": 0,
+                                "reasons": []})
+            d["down"] += 1
+            d["reasons"].append(str(e.get("reason", "?")))
+        for e in respawns:
+            d = per.setdefault(str(e.get("daemon", "?")),
+                               {"down": 0, "respawn": 0,
+                                "reasons": []})
+            d["respawn"] += 1
+        rows = [[name, v["down"], v["respawn"],
+                 ", ".join(sorted(set(v["reasons"]))) or "-"]
+                for name, v in sorted(per.items())]
+        lines.append(_table(["daemon", "deaths", "respawns",
+                             "reasons"], rows))
+    assigns = by.get("router_assign") or []
+    rebalances = by.get("router_rebalance") or []
+    if assigns or rebalances:
+        trail = []
+        for e in assigns:
+            trail.append("%s->%s" % (e.get("bucket", "?"),
+                                     e.get("daemon", "?")))
+        for e in rebalances:
+            trail.append("%s:%s->%s (%s)"
+                         % (e.get("bucket", "?"), e.get("src", "?"),
+                            e.get("dst", "?"), e.get("cause", "?")))
+        lines.append("assignment: " + "  ".join(trail[:16]))
+        if len(trail) > 16:
+            lines.append("... %d more assignment change(s)"
+                         % (len(trail) - 16))
+    sheds = by.get("router_shed") or []
+    if sheds:
+        reasons = {}
+        for e in sheds:
+            r = str(e.get("reason", "?"))
+            reasons[r] = reasons.get(r, 0) + 1
+        lines.append("load-shed: %d rejection(s) (%s)"
+                     % (len(sheds),
+                        ", ".join("%s: %d" % kv
+                                  for kv in sorted(reasons.items()))))
+    retries = by.get("router_forward_retry") or []
+    if retries:
+        lines.append("forward retries: %d (connection lost to a "
+                     "dying daemon; retried after respawn)"
+                     % len(retries))
+    stopped = by.get("router_stopped")
+    if stopped:
+        e = stopped[-1]
+        lines.append("stopped: drained=%s total respawns=%s"
+                     % (e.get("drained", "?"), e.get("respawns", 0)))
+    return "\n".join(lines)
+
+
 def summarize(run_dir):
     """Full human-readable report for one run directory."""
     manifest, events = load_run(run_dir)
@@ -961,6 +1044,11 @@ def summarize(run_dir):
         out.append("")
         out.append("## service requests")
         out.append(svc)
+    fleet = summarize_fleet(events)
+    if fleet:
+        out.append("")
+        out.append("## fleet")
+        out.append(fleet)
     rob = summarize_robustness(events)
     if rob:
         out.append("")
