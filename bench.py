@@ -854,6 +854,16 @@ def _bench():
             if st is not None:
                 result["extra"]["peak_device_bytes"] = \
                     st.run_peak_bytes
+    # usage plane (obs/usage.py): metered work of the bench run so far
+    # — a survey stage that silently fits fewer archives (or burns more
+    # device time per fit) moves these, and obs_diff's --usage-rel gate
+    # catches it against the committed baseline
+    ufp = obs.usage.totals()
+    if ufp is not None:
+        result["extra"]["usage_records_total"] = ufp["records"]
+        result["extra"]["usage_device_seconds_total"] = round(
+            sum(float(t.get("device_s", 0) or 0)
+                for t in ufp["tenants"].values()), 6)
     # health plane (obs/health.py): a committed BENCH line that fired
     # alerts mid-bench documents it — obs_diff's new-alerts gate then
     # catches a candidate that alerts where the baseline did not

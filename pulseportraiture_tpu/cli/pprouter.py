@@ -75,6 +75,11 @@ def build_parser():
                     help="Fleet admission: shed when this many "
                          "requests are already open across the fleet "
                          "(0 = unlimited).")
+    st.add_argument("--quotas", default=None, metavar="JSON",
+                    help="Per-tenant usage budgets enforced at fleet "
+                         "admission AND propagated to every spawned "
+                         "daemon (docs/OBSERVABILITY.md; default "
+                         "$PPTPU_QUOTAS).")
     st.add_argument("--health-interval", type=float, default=1.0,
                     metavar="S", dest="health_interval_s",
                     help="Supervisor health-poll period [s].")
@@ -133,6 +138,7 @@ def _cmd_start(args):
         batch_window_s=args.batch_window_s, batch_max=args.batch_max,
         solo_window_s=args.solo_window_s,
         mem_budget_bytes=args.mem_budget_bytes,
+        quotas=args.quotas,
         fleet_max_open=args.fleet_max_open,
         health_interval_s=args.health_interval_s,
         rebalance_delta=args.rebalance_delta,

@@ -97,6 +97,12 @@ def build_parser():
                     help="Byte budget for retained request runs "
                          "(default $PPTPU_SERVE_MAX_RUN_BYTES; 0 = "
                          "count budget only).")
+    st.add_argument("--quotas", default=None, metavar="JSON",
+                    help="Per-tenant usage budgets, e.g. "
+                         "'{\"acme\": {\"device_seconds\": 30}}' "
+                         "(docs/OBSERVABILITY.md; default "
+                         "$PPTPU_QUOTAS).  Breaching tenants get "
+                         "replayable 'quota' rejections.")
     st.add_argument("--narrowband", action="store_true",
                     help="Serve per-channel (narrowband) TOAs.")
     st.add_argument("--tscrunch", "-T", action="store_true")
@@ -198,6 +204,7 @@ def _cmd_start(args):
         prefetch=args.prefetch,
         run_dirs_max=args.run_dirs_max,
         run_bytes_max=args.run_bytes_max,
+        quotas=args.quotas,
         get_toas_kw=fit_kw, quiet=args.quiet)
     svc.start()
     if args.warm and plan is not None:

@@ -151,6 +151,10 @@ def build_parser():
                             "<workdir>/traces) and ingest it into the "
                             "obs run's devtime events + device-"
                             "utilization gauges (docs/RUNNER.md).")
+        r.add_argument("--tenant", default=None, metavar="NAME",
+                       help="Tenant the run's usage ledger bills "
+                            "archives to (obs/usage.py; default: "
+                            "'_local').")
         r.add_argument("--tscrunch", "-T", action="store_true")
         r.add_argument("--fit_scat", action="store_true")
         r.add_argument("--no_bary", dest="bary", action="store_false")
@@ -321,7 +325,7 @@ def _cmd_run(args):
         workload=workload, prefetch=args.prefetch,
         warm=args.warm, compile_cache=_cache_dir(args),
         workload_opts=_parse_workload_opts(args.workload_opts),
-        quiet=args.quiet, **fit_kw)
+        tenant=args.tenant, quiet=args.quiet, **fit_kw)
     out = {"workload": summary.get("workload", workload),
            "counts": summary["counts"],
            "quarantined": summary["quarantined"],

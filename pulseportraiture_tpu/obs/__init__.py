@@ -39,6 +39,12 @@ Layout:
   (``alert_firing`` / ``alert_resolved`` events, the
   ``pps_alerts_firing`` / ``pps_alerts_total`` series), evaluated on
   the exporter cadence and each claim cycle
+* :mod:`.usage`    — per-tenant usage metering: every unit of work
+  (service request, fleet forward, survey archive) becomes one
+  ``usage.jsonl`` record with (tenant, bucket, workload) attribution
+  and additive measures (wall/device seconds, bytes, archives), plus
+  quota enforcement (``PPTPU_QUOTAS``) and the ``pps_usage_*`` /
+  ``pps_quota_*`` series the fleet merges per tenant
 * :mod:`.flight`   — flight recorder: always-on bounded in-memory
   ring of recent events that freezes into postmortem bundles
   (``<run>/postmortem/``) on OOM/watchdog/quarantine/alert triggers
@@ -57,7 +63,7 @@ additionally passes tracers through untouched at runtime).
 """
 
 from . import (devtime, flight, health, memory, metrics,  # noqa: F401
-               monitor, quality, tracing)
+               monitor, quality, tracing, usage)
 from .core import (Recorder, configure, counter, current, enabled,
                    event, fit_telemetry, gauge, list_event_files,
                    obs_dir, obs_max_bytes, phases, run, scoped_run,
@@ -70,4 +76,4 @@ __all__ = ["Recorder", "configure", "counter", "current", "devtime",
            "health", "list_event_files", "memory", "merge_obs_shards",
            "metrics", "obs_dir", "obs_max_bytes", "phases", "quality",
            "run", "scoped_run", "span", "trace_capture", "trace_dir",
-           "monitor", "tracing"]
+           "monitor", "tracing", "usage"]

@@ -200,6 +200,9 @@ class Recorder:
         # first health evaluation (runner claim cycle, service health
         # verb), same gating as the states above
         self._health = None
+        # usage-accounting plane (obs/usage.py): created lazily on the
+        # first metered unit — a run that serves nothing bills nothing
+        self._usage = None
         self._closed = False
 
     def metrics_registry(self):
@@ -253,6 +256,26 @@ class Recorder:
                 except Exception:
                     return None
             return self._quality
+
+    def usage_state(self):
+        """The run's usage-accounting plane (obs/usage.py), created on
+        first use; None when creation failed — never fatal."""
+        st = self._usage
+        if st is not None:
+            return st
+        from .usage import UsageState
+
+        # materialize the registry first: UsageState.__init__ reads
+        # it, and self._lock is not reentrant
+        self.metrics_registry()
+        with self._lock:
+            if self._usage is None and not self._closed:
+                try:
+                    # registry materialized above: no re-entry (jaxlint J007)
+                    self._usage = UsageState(self)  # jaxlint: disable=J007
+                except Exception:
+                    return None
+            return self._usage
 
     def health_state(self):
         """The run's alert-rule engine (obs/health.py), created on
@@ -450,6 +473,13 @@ class Recorder:
             # snapshot
             try:
                 self._health.stop()
+            except Exception:
+                pass
+        if self._usage is not None:
+            # same ordering: the run-total usage gauges must make the
+            # manifest written below (bench/obs_diff read them back)
+            try:
+                self._usage.stop()
             except Exception:
                 pass
         if self._metrics_exporter is not None:

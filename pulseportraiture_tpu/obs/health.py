@@ -110,6 +110,15 @@ BUILTIN_RULES = (
      "op": ">=", "threshold": 2, "window_s": 300.0, "for_s": 0.0,
      "summary": "fleet daemons respawning repeatedly (crash-looping "
                 "replica or poisoned bucket)"},
+    # the quota plane (obs/usage.py) publishes pps_quota_burn as the
+    # UNLABELED max used/limit fraction across budgeted tenants (the
+    # per-tenant fractions live under a different name on purpose:
+    # a threshold rule sums label variants); absent = no quotas, quiet
+    {"name": "quota_burn", "kind": "threshold", "severity": "warning",
+     "gauge": "pps_quota_burn",
+     "op": ">=", "threshold": 0.85, "window_s": 60.0, "for_s": 0.0,
+     "summary": "a tenant burned 85% of its usage quota: hard shed "
+                "is imminent"},
 )
 
 _OPS = {

@@ -20,7 +20,10 @@ metric-aggregation item):
   their ``region`` prefixed ``p<proc>/`` like span paths; the phase
   and scope aggregations still sum across hosts by name;
 * manifest counters/gauges are summed (numeric) or kept per-process,
-  ``wall_s`` is the max (processes run concurrently), configs merged.
+  ``wall_s`` is the max (processes run concurrently), configs merged;
+* usage ledgers (``usage.<proc>.jsonl``, obs/usage.py) concatenate —
+  records are self-contained and rollups are pure sums, so the merged
+  per-tenant totals are exact and order-independent.
 """
 
 import json
@@ -61,7 +64,8 @@ def _advance_shard(shards_dir, proc):
     base = max_rot + 1
     os.replace(live, live + ".%d" % base)
     for name in ("manifest.%d.json" % proc,
-                 "metrics.%d.jsonl" % proc):
+                 "metrics.%d.jsonl" % proc,
+                 "usage.%d.jsonl" % proc):
         src = os.path.join(shards_dir, name)
         if os.path.isfile(src):
             os.replace(src, src + ".%d" % base)
@@ -122,6 +126,19 @@ def write_shard(run_dir, shards_dir, proc):
             with open(src, "rb") as sf, open(dst, "wb") as df:
                 df.write(sf.read())
             written.append(dst)
+    # the usage ledger (obs/usage.py): records are order-independent,
+    # so the run's rotated chain concatenates into ONE shard file —
+    # no rotation-index bookkeeping to collide with the event set
+    from .usage import usage_files
+
+    srcs = usage_files(run_dir)
+    if srcs:
+        dst = os.path.join(shards_dir, "usage.%d.jsonl" % proc)
+        with open(dst, "wb") as df:
+            for src in srcs:
+                with open(src, "rb") as sf:
+                    df.write(sf.read())
+        written.append(dst)
     return written
 
 
@@ -293,6 +310,24 @@ def merge_obs_shards(shards_dir, out_dir):
         with open(os.path.join(out_dir, "metrics.jsonl"), "w",
                   encoding="utf-8") as fh:
             fh.write(json.dumps(merged_snap) + "\n")
+
+    # usage ledgers (obs/usage.py): records are self-contained and
+    # rollups are pure sums, so the merge is concatenation — tagged
+    # with ``proc`` and time-sorted for readability, exact either way
+    usage = []
+    for proc in sorted(shards):
+        for upath in _rotated_paths(shards_dir,
+                                    "usage.%d.jsonl" % proc):
+            for rec in _read_events(upath):
+                if isinstance(rec, dict):
+                    rec["proc"] = proc
+                    usage.append(rec)
+    if usage:
+        usage.sort(key=lambda r: r.get("t", 0.0))
+        with open(os.path.join(out_dir, "usage.jsonl"), "w",
+                  encoding="utf-8") as fh:
+            for rec in usage:
+                fh.write(json.dumps(rec) + "\n")
 
     manifests = {}
     for proc in sorted(shards):

@@ -929,6 +929,48 @@ def _alerts_row(snap):
     return "alerts: none firing  %d fired total" % fired
 
 
+def _usage_row(snap, prev=None, dt=None):
+    """The ``--watch`` per-tenant usage line (obs/usage.py): metered
+    device-seconds and request counts from the exact, tenant-labeled
+    ``pps_usage_*_total`` counters (summed across any ``p<proc>/``
+    merge prefixes — counters, never gauges), with a per-second
+    request rate when ``prev``/``dt`` are available; None when the
+    snapshot carries no usage series (pre-usage runs keep their
+    original frame)."""
+    def _fold(s):
+        by_tenant = {}
+        for key, v in (s.get("counters") or {}).items():
+            name, labels = parse_series(key.rsplit("/", 1)[-1])
+            if name not in ("pps_usage_records_total",
+                            "pps_usage_device_seconds_total"):
+                continue
+            tenant = labels.get("tenant", "-")
+            cur = by_tenant.setdefault(tenant, [0, 0.0])
+            try:
+                if name == "pps_usage_records_total":
+                    cur[0] += int(v)
+                else:
+                    cur[1] += float(v)
+            except (TypeError, ValueError):
+                continue
+        return by_tenant
+
+    by_tenant = _fold(snap)
+    if not by_tenant:
+        return None
+    prev_t = _fold(prev) if prev else {}
+    parts = []
+    for tenant in sorted(by_tenant):
+        recs, dev = by_tenant[tenant]
+        rate = ""
+        if dt:
+            rate = " (+%.2f/s)" % ((recs - prev_t.get(tenant,
+                                                      [0, 0.0])[0]) / dt)
+        parts.append("%s=%d rec%s %.2f dev-s" % (tenant, recs, rate,
+                                                 dev))
+    return "usage: " + "  ".join(parts)
+
+
 def render_watch(snap, prev=None, title=""):
     """A terminal dashboard frame from one snapshot (pptop-style).
 
@@ -1030,6 +1072,11 @@ def render_watch(snap, prev=None, title=""):
         if not mem and not qual and not cache:
             lines.append("")
         lines.append(alerts)
+    used = _usage_row(snap, prev, dt)
+    if used:
+        if not mem and not qual and not cache and not alerts:
+            lines.append("")
+        lines.append(used)
     if gauges:
         lines.append("")
         lines.append("gauges: " + "  ".join(

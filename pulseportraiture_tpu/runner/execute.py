@@ -61,7 +61,8 @@ import time
 import numpy as np
 
 from .. import obs
-from ..obs import flight, health, memory, metrics, quality, tracing
+from ..obs import flight, health, memory, metrics, quality, tracing, \
+    usage
 from ..obs.merge import merge_obs_shards, write_shard
 from ..obs.metrics import PHASE_HISTOGRAM
 from ..pipelines.toas import _PRELOAD_MISS, GetTOAs, \
@@ -697,7 +698,7 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                trace_bucket=False, watchdog_s=None,
                barrier_timeout_s=600.0, lease_s=600.0,
                narrowband=False, workload=None, workload_opts=None,
-               warm=None, compile_cache=None,
+               tenant=None, warm=None, compile_cache=None,
                prefetch=0, quiet=True, **get_toas_kw):
     """Execute (or resume) one process's share of a survey plan.
 
@@ -773,6 +774,13 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
     with its reduce once the union ledger shows every archive settled
     — the reduce is idempotent, so any process of any topology may
     perform it.  ``**get_toas_kw`` is accepted only for ``toas``.
+
+    ``tenant`` attributes the survey's usage records (obs/usage.py):
+    every fitted archive is metered under it — per-archive wall and
+    fit-phase device seconds, decoded bytes — into the run's
+    ``usage.jsonl`` ledger; ``None`` bills the local pseudo-tenant
+    ``_local``.  The summary gains a ``usage`` rollup when anything
+    was metered.
 
     ``prefetch`` (``ppsurvey run --prefetch N``) enables the streaming
     host pipeline (runner/prefetch.py, docs/RUNNER.md "Host
@@ -1045,6 +1053,7 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                         padded = (info.nchan, info.nbin) != bucket.key
                         hold = hb.hold(info.path) if hb is not None \
                             else contextlib.nullcontext()
+                        tfit = time.perf_counter()
                         with hold:
                             with metrics.timed(
                                     PHASE_HISTOGRAM, phase="fit",
@@ -1058,10 +1067,24 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                                     wl, states[bucket.key], queue,
                                     info, checkpoint, padded, quiet,
                                     watchdog_s)
+                        fit_s = time.perf_counter() - tfit
                         arch_s = time.perf_counter() - item.t0
                         metrics.observe(PHASE_HISTOGRAM, arch_s,
                                         phase="archive", bucket=blabel,
                                         workload=wlabel)
+                        # meter the archive (obs/usage.py) under the
+                        # submitting tenant (or _local): the survey's
+                        # cost attribution in the same ledger currency
+                        # the service daemon bills requests in
+                        try:
+                            nbytes = os.path.getsize(info.path)
+                        except OSError:
+                            nbytes = 0
+                        usage.meter("archive", tenant=tenant,
+                                    bucket=blabel, workload=wlabel,
+                                    wall_s=arch_s, device_s=fit_s,
+                                    archives=1, bytes_decoded=nbytes,
+                                    archive=info.path, owner=owner)
                         # the root span of this archive's trace:
                         # children (claim/prefetch_load/fit/...)
                         # reference its pre-allocated id
@@ -1348,6 +1371,12 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
                 obs.event("quality_summary", process=pid,
                           workload=wl.name, fingerprint=qfp,
                           groups=qgroups)
+            # per-process usage rollup (obs/usage.py): what this
+            # worker billed, in summary form
+            ufp = usage.totals()
+            if ufp is not None:
+                obs.event("usage_summary", process=pid,
+                          workload=wl.name, **ufp)
             obs.event("runner_summary", process=pid, owner=owner,
                       workload=wl.name, **queue.counts())
             run_dir = rec.dir if rec is not None else None
@@ -1398,6 +1427,8 @@ def run_survey(plan, workdir, modelfile=None, process_index=None,
             extra["quality"] = qfp
             if qgroups:
                 extra["quality_groups"] = qgroups
+        if ufp is not None:
+            extra["usage"] = ufp
         if drain["sig"]:
             extra["drained"] = drain["sig"]
         if barrier_timeout:

@@ -63,7 +63,7 @@ import time
 
 from .. import obs
 from ..io.timfile import format_toa_line
-from ..obs import flight, memory, metrics, quality, tracing
+from ..obs import flight, memory, metrics, quality, tracing, usage
 from ..obs import health as obs_health
 from ..obs.metrics import PHASE_HISTOGRAM
 from ..obs.core import Recorder
@@ -123,7 +123,8 @@ class Request:
                  "n_toas", "toa_lines", "quality", "t_submit", "t_done",
                  "done_evt", "recorder", "recovered", "batch_id",
                  "trace_id", "parent_span_id", "span_id", "ticket",
-                 "priority", "deadline_s")
+                 "priority", "deadline_s", "fit_s", "fit_peak_bytes",
+                 "bytes_in")
 
     def __init__(self, req_id, tenant, path, key, config,
                  priority=0, deadline_s=None):
@@ -142,6 +143,12 @@ class Request:
         # fit-quality fingerprint of the request's archive
         # (obs/quality.py gt_fingerprint, stamped before checkin)
         self.quality = None
+        # usage accounting (obs/usage.py): fit-phase device seconds
+        # accumulate across attempts, peak fit footprint and decoded
+        # archive bytes bill at finalize
+        self.fit_s = 0.0
+        self.fit_peak_bytes = 0
+        self.bytes_in = 0
         # deadline class (docs/SERVICE.md): higher priority seeds
         # cycles first; ``deadline_s`` is a completion budget from
         # submit time — the dispatcher never parks the request past
@@ -289,8 +296,8 @@ class TOAService:
                  tenant_max_inflight=4, tenant_max_queue=64,
                  max_attempts=3, backoff_s=0.0, run_dirs_max=None,
                  run_bytes_max=None, mem_budget_bytes=None,
-                 return_toa_lines=True, get_toas_kw=None, prefetch=2,
-                 quiet=True):
+                 quotas=None, return_toa_lines=True, get_toas_kw=None,
+                 prefetch=2, quiet=True):
         self.modelfile = modelfile
         self.workdir = workdir
         if isinstance(plan, str):
@@ -318,6 +325,12 @@ class TOAService:
         # device budget is rejected at intake (0 = disabled)
         self.mem_budget_bytes = _env_int("PPTPU_SERVE_MEM_BUDGET", 0) \
             if mem_budget_bytes is None else int(mem_budget_bytes)
+        # per-tenant usage quotas (obs/usage.py): admission checks the
+        # metered totals against these budgets; {} = unlimited.  A
+        # malformed explicit spec raises at construction (a quota typo
+        # must not silently admit forever); the env fallback is lax.
+        self.quotas = usage.quotas_from_env() if quotas is None \
+            else usage.parse_quotas(quotas)
         self.return_toa_lines = bool(return_toa_lines)
         self.get_toas_kw = dict(get_toas_kw or {})
         # decode-at-intake (docs/SERVICE.md): up to ``prefetch``
@@ -377,7 +390,12 @@ class TOAService:
                     "run_dirs_max": self.run_dirs_max,
                     "run_bytes_max": self.run_bytes_max,
                     "mem_budget_bytes": self.mem_budget_bytes,
+                    "quotas": self.quotas or None,
                     "prefetch": self.prefetch}))
+        if self.quotas:
+            # install the budgets on the usage plane: metering keeps
+            # the pps_quota_burn gauge live for the quota_burn rule
+            usage.configure_quotas(self.quotas)
         if self.mem_budget_bytes:
             # the memory_watermark health rule prices device usage
             # against this budget gauge (obs/health.py)
@@ -631,7 +649,8 @@ class TOAService:
                 obs.counter("service_requests")
         if rq.bucket is None:
             if self._classify(rq):
-                rejection = self._memory_admission(rq)
+                rejection = self._memory_admission(rq) \
+                    or self._quota_admission(rq)
                 if rejection is not None:
                     return rejection
                 self._maybe_prefetch(rq)
@@ -672,6 +691,34 @@ class TOAService:
                 "archive": rq.path, "request_id": rq.id,
                 "est_bytes": est, "budget_bytes": budget}
 
+    def _quota_admission(self, rq):
+        """Quota admission (obs/usage.py): settle a freshly classified
+        request at intake when its tenant has exhausted a configured
+        budget against the locally metered usage.  Quarantine-at-
+        submit, like the memory shed: the rejection lands in the
+        tenant ledger, so a duplicate submit replays it without
+        burning another admission — and without re-metering.  Returns
+        the ``rejected_quota`` payload, or None when admitted."""
+        if not self.quotas:
+            return None
+        breach = usage.check(rq.tenant, self.quotas)
+        if breach is None:
+            return None
+        reason = ("quota: %s used %s of limit %s"
+                  % (breach["quota"], breach["used"], breach["limit"]))
+        with self._lock, tracing.activate(rq.ctx()):
+            t = self._tenants[rq.tenant]
+            t.queue.quarantine(rq.path, reason)
+            self._finalize_locked(rq, QUARANTINED, reason)
+        metrics.inc("pps_requests_total", tenant=rq.tenant,
+                    outcome="rejected_quota")
+        metrics.inc("pps_shed_total", reason="quota")
+        obs.event("service_quota_reject", tenant=rq.tenant,
+                  archive=rq.path, request=rq.id, **breach)
+        obs.counter("service_quota_rejections")
+        return {"ok": False, "error": "quota", "tenant": rq.tenant,
+                "archive": rq.path, "request_id": rq.id, **breach}
+
     def _classify(self, rq):
         """Header-scan the archive into its shape bucket; quarantine on
         failure.  Returns True when the request is fittable."""
@@ -693,6 +740,12 @@ class TOAService:
         with self._lock, tracing.activate(rq.ctx()):
             rq.nsub, rq.nchan, rq.nbin = info.nsub, info.nchan, info.nbin
             rq.bucket = canonical_shape(info.nchan, info.nbin)
+            try:
+                # the bytes-decoded usage measure (obs/usage.py): the
+                # archive the fit will decode, billed at finalize
+                rq.bytes_in = os.path.getsize(rq.path)
+            except OSError:
+                rq.bytes_in = 0
             t = self._tenants[rq.tenant]
             if t.queue.state(rq.key) is None:
                 t.queue.add([rq.path])
@@ -926,6 +979,14 @@ class TOAService:
         kw["addtnl_toa_flags"] = flags
         padded = (rq.nchan, rq.nbin) != tuple(bucket.key)
         state = None
+        # usage accounting (obs/usage.py): the fit phase is the
+        # device-seconds measure; its peak footprint rides the memory
+        # plane's watermark bracket.  Accumulated across attempts —
+        # a retried request burned every attempt's device time.
+        rec = obs.current()
+        mem = rec.memory_state() if rec is not None else None
+        mtok = mem.mark() if mem is not None else None
+        tfit = time.perf_counter()
         try:
             with metrics.timed(PHASE_HISTOGRAM, phase="fit",
                                tenant=rq.tenant, bucket=blabel), \
@@ -949,6 +1010,11 @@ class TOAService:
                 rec = t.queue.fail(rq.path, reason)
             state = rec["state"]
         finally:
+            rq.fit_s += time.perf_counter() - tfit
+            if mem is not None and mtok is not None:
+                pk = mem.peak(mtok)
+                if pk:
+                    rq.fit_peak_bytes = max(rq.fit_peak_bytes, pk)
             bucket.batcher.worker_done()
             n_toas = len(gt.TOA_list)
             lines = [format_toa_line(toa) for toa in gt.TOA_list] \
@@ -1036,6 +1102,17 @@ class TOAService:
         metrics.set_gauge("pps_queue_depth", len(t.fifo),
                           tenant=rq.tenant)
         metrics.set_gauge("pps_open_requests", len(self._requests))
+        # bill the request exactly once, at the terminal transition:
+        # a duplicate submit replays from the ledger and never gets
+        # here again (obs/usage.py exactly-once accounting).  Metered
+        # before the per-request recorder closes, and before waiters
+        # wake — a quota check racing this finalize sees the bill.
+        usage.meter("request", tenant=rq.tenant,
+                    bucket=_blabel(rq.bucket), wall_s=total_s,
+                    device_s=rq.fit_s, peak_bytes=rq.fit_peak_bytes,
+                    archives=1 if state == DONE else 0,
+                    bytes_decoded=rq.bytes_in, request=rq.id,
+                    state=state, attempts=rq.attempts)
         self._emit_request(rq, "terminal")
         if state != DONE:
             # quarantine forensics: the terminal service_request event

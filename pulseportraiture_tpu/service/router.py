@@ -54,7 +54,7 @@ import threading
 import time
 
 from .. import obs
-from ..obs import metrics
+from ..obs import metrics, usage
 from ..obs import health as obs_health
 from ..runner.plan import SurveyPlan, canonical_shape, \
     estimate_archive_bytes, scan_archive_header
@@ -118,7 +118,7 @@ class FleetRouter:
     def __init__(self, modelfile, workdir, n_daemons=3, plan=None,
                  compile_cache=None, warm=True, batch_window_s=0.25,
                  batch_max=8, solo_window_s=0.1, mem_budget_bytes=None,
-                 fleet_max_open=0, health_interval_s=1.0,
+                 quotas=None, fleet_max_open=0, health_interval_s=1.0,
                  unhealthy_after=2, rebalance_delta=8,
                  respawn_timeout_s=300.0, forward_attempts=3,
                  adopt_sockets=None, daemon_args=None, daemon_env=None,
@@ -131,6 +131,12 @@ class FleetRouter:
         self.batch_max = int(batch_max)
         self.solo_window_s = float(solo_window_s)
         self.mem_budget_bytes = int(mem_budget_bytes or 0)
+        # per-tenant usage quotas (obs/usage.py): enforced at the
+        # router's own admission over its metered forwards, AND
+        # propagated to every spawned daemon (--quotas), whose
+        # device-seconds metering is the authoritative enforcement
+        self.quotas = usage.quotas_from_env() if quotas is None \
+            else usage.parse_quotas(quotas)
         self.fleet_max_open = int(fleet_max_open or 0)
         self.health_interval_s = float(health_interval_s)
         self.unhealthy_after = max(1, int(unhealthy_after))
@@ -194,9 +200,12 @@ class FleetRouter:
                     "plan": self.plan_path,
                     "compile_cache": self.compile_cache,
                     "mem_budget_bytes": self.mem_budget_bytes,
+                    "quotas": self.quotas or None,
                     "fleet_max_open": self.fleet_max_open,
                     "batch_window_s": self.batch_window_s,
                     "batch_max": self.batch_max}))
+        if self.quotas:
+            usage.configure_quotas(self.quotas)
         obs_health.evaluate()
         for d in self._daemons:
             if d.adopted:
@@ -250,6 +259,10 @@ class FleetRouter:
                 cmd += ["--warm"]
         if self.compile_cache:
             cmd += ["--compile-cache", self.compile_cache]
+        if self.quotas:
+            # every daemon enforces the same budgets over its OWN
+            # metered usage (per-enforcement-point totals)
+            cmd += ["--quotas", json.dumps(self.quotas)]
         if self.quiet:
             cmd += ["--quiet"]
         cmd += self.daemon_args
@@ -467,8 +480,19 @@ class FleetRouter:
 
     def _admission(self, tenant, archive, est):
         """Fleet-level load-shed before any forward: the memory
-        estimate against the per-daemon device budget, and the fleet
-        open-request ceiling."""
+        estimate against the per-daemon device budget, the tenant's
+        usage quota against the router's metered forwards
+        (obs/usage.py), and the fleet open-request ceiling."""
+        if self.quotas:
+            breach = usage.check(tenant, self.quotas)
+            if breach is not None:
+                obs.counter("router_sheds")
+                metrics.inc("pps_shed_total", reason="quota")
+                obs.event("router_shed", tenant=tenant,
+                          archive=archive, reason="quota", **breach)
+                return {"ok": False, "error": "quota",
+                        "tenant": tenant, "archive": archive,
+                        **breach}
         if self.mem_budget_bytes and est is not None \
                 and est > self.mem_budget_bytes:
             obs.counter("router_sheds")
@@ -584,6 +608,17 @@ class FleetRouter:
                         self._bucket_routed.get(bucket, 0) + 1
             metrics.inc("pps_routed_total", bucket=_blabel(bucket),
                         daemon=d.name)
+            # meter the forward (obs/usage.py): the router's own
+            # usage view — request counts and, when the daemon
+            # answered with a terminal payload, its wall seconds.
+            # Device seconds stay on the daemon that burned them; the
+            # fleet-merged metrics verb sums both sides per tenant.
+            wall = resp.get("wall_s")
+            usage.meter("forward", tenant=payload.get("tenant"),
+                        bucket=_blabel(bucket),
+                        wall_s=wall if isinstance(
+                            wall, (int, float)) else 0.0,
+                        daemon=d.name, ok=bool(resp.get("ok")))
             if resp.get("request_id"):
                 resp["request_id"] = "%s:%s" % (d.name,
                                                 resp["request_id"])

@@ -1,0 +1,74 @@
+"""J002 fixtures: usage-metering API misuse inside jit.
+
+obs.usage (the usage-accounting and quota plane,
+docs/OBSERVABILITY.md "Usage & quotas") is host-side by contract: a
+``meter`` appends a ledger line under a lock and bumps tenant-labeled
+counters, a quota ``check`` reads the in-memory rollup, and
+``rollup`` / ``read_usage`` are ledger-file IO — none of that can
+exist in compiled code, and under jit a meter would bill the trace,
+exactly once, at trace time.  This corpus proves the ``usage.*`` /
+``obs.usage.*`` surface is unreachable inside a jit trace without the
+linter firing.
+"""
+
+import jax
+
+from pulseportraiture_tpu import obs
+from pulseportraiture_tpu.obs import usage
+
+
+@jax.jit
+def bad_meter_in_jit(x):
+    usage.meter("archive", tenant="acme", device_s=0.1)  # EXPECT: J002
+    return x * 2.0
+
+
+@jax.jit
+def bad_check_in_jit(x):
+    breach = usage.check("acme")  # EXPECT: J002
+    return x if breach is None else x * 0.0
+
+
+@jax.jit
+def bad_totals_in_jit(x):
+    usage.totals()  # EXPECT: J002
+    return x + 1.0
+
+
+@jax.jit
+def bad_qualified_in_jit(x):
+    obs.usage.quota_burn_fraction()  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def bad_rollup_in_jit(x, records):
+    usage.rollup(records)  # EXPECT: J002
+    return x
+
+
+@jax.jit
+def ok_suppressed(x):
+    usage.meter("archive", tenant="acme")  # jaxlint: disable=J002
+    return x
+
+
+def ok_host_side(x, run_dir):
+    # outside jit: exactly how the runner/daemon meter — after the
+    # dispatch returns, wall/device seconds already measured on host
+    usage.meter("archive", tenant="acme", wall_s=1.0, device_s=0.5)
+    return usage.rollup(usage.read_usage(run_dir))
+
+
+@jax.jit
+def ok_unrelated_names(x, meter, rollup):
+    # traced values merely NAMED like the API must not trip the rule
+    return x + meter.sum() + rollup.mean()
+
+
+def ok_after_boundary(y):
+    # the documented pattern: meter after block_until_ready, with
+    # host-side timings
+    jax.block_until_ready(y)
+    usage.meter("request", tenant="acme", device_s=0.2)
+    return y

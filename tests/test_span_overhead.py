@@ -24,6 +24,7 @@ from tools.span_overhead import (BUDGET_FRACTION, CALLS_PER_ARCHIVE,
                                  MEMORY_CALLS_PER_ARCHIVE,
                                  METRICS_CALLS_PER_ARCHIVE,
                                  TRACING_CALLS_PER_ARCHIVE,
+                                 USAGE_CALLS_PER_ARCHIVE,
                                  measure)  # noqa: E402
 
 
@@ -34,7 +35,8 @@ def test_probe_schema_and_sanity():
                  "metrics_gauge", "tracing_current",
                  "tracing_activate", "span_traced", "observe_traced",
                  "memory_watermarks", "memory_last",
-                 "health_evaluate", "flight_dump"):
+                 "health_evaluate", "flight_dump",
+                 "usage_meter", "usage_check"):
         assert out["%s_off_s" % name] > 0.0
         assert out["%s_on_s" % name] > 0.0
     assert out["archive_off_s"] == pytest.approx(
@@ -56,6 +58,11 @@ def test_probe_schema_and_sanity():
         out["health_evaluate_off_s"] + out["flight_dump_off_s"])
     assert out["hot_fit_health_off_s"] == pytest.approx(
         out["hot_fit_memory_off_s"] + out["health_archive_off_s"])
+    assert USAGE_CALLS_PER_ARCHIVE == 2
+    assert out["usage_archive_off_s"] == pytest.approx(
+        out["usage_meter_off_s"] + out["usage_check_off_s"])
+    assert out["hot_fit_usage_off_s"] == pytest.approx(
+        out["hot_fit_health_off_s"] + out["usage_archive_off_s"])
     # disabled primitives are nanosecond-scale dict lookups; even a
     # very loaded CI box keeps them under 50 us/call
     assert out["span_off_s"] < 50e-6
@@ -76,6 +83,10 @@ def test_probe_schema_and_sanity():
     # evaluate or a flight dump is one module-global read + None check
     assert out["health_evaluate_off_s"] < 50e-6
     assert out["flight_dump_off_s"] < 50e-6
+    # disabled-usage guard: with no run active a meter or a quota
+    # admission check is one module-global read + None check
+    assert out["usage_meter_off_s"] < 50e-6
+    assert out["usage_check_off_s"] < 50e-6
 
 
 @pytest.mark.slow
@@ -146,3 +157,12 @@ def test_disabled_overhead_within_budget():
         (out["hot_fit_health_off_s"], fit_wall)
     assert out["health_archive_on_s"] < fit_wall, \
         (out["health_archive_on_s"], fit_wall)
+    # usage metering: the fully-instrumented disabled path — all of
+    # the above plus the terminal-state meter and the submit-time
+    # quota check — still fits the <2% budget, and even the ENABLED
+    # path (one ledger append + a rollup read) stays far below one
+    # archive's fit wall
+    assert out["hot_fit_usage_off_s"] < BUDGET_FRACTION * fit_wall, \
+        (out["hot_fit_usage_off_s"], fit_wall)
+    assert out["usage_archive_on_s"] < fit_wall, \
+        (out["usage_archive_on_s"], fit_wall)

@@ -1,0 +1,306 @@
+"""Usage-accounting & quota plane tests (obs/usage.py).
+
+The acceptance scenarios: quota rejections settle in the tenant
+ledger so a duplicate submit replays exactly-once (no re-meter, no
+second admission burn); a SIGKILL mid-append loses at most the
+in-flight ledger record (torn-tail discipline); a full-disk ledger
+write drops the *record* but never the billing; and the read side
+(usage_files ordering, read_usage tolerance, rollup exactness) is
+order-independent.  The fleet-scale reconciliation proof is
+tools/usage_smoke.py; the <2% disabled-overhead budget is
+tests/test_span_overhead.py.
+"""
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu import obs
+from pulseportraiture_tpu.io.archive import make_fake_pulsar
+from pulseportraiture_tpu.io.gmodel import write_model
+from pulseportraiture_tpu.obs import usage
+from pulseportraiture_tpu.service import TOAService
+from pulseportraiture_tpu.testing import faults
+
+MODEL_PARAMS = np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5])
+
+
+# -- quota spec parsing -------------------------------------------------
+
+
+def test_parse_quotas_shorthand_and_errors():
+    # scalar budget is device_seconds shorthand
+    assert usage.parse_quotas({"acme": 30}) == \
+        {"acme": {"device_seconds": 30.0}}
+    assert usage.parse_quotas(
+        '{"a": {"requests": 5, "wall_seconds": 2.5}}') == \
+        {"a": {"requests": 5.0, "wall_seconds": 2.5}}
+    assert usage.parse_quotas(None) == {}
+    assert usage.parse_quotas("") == {}
+    # a typo must fail loudly at start, not silently admit forever
+    with pytest.raises(ValueError, match="unknown resource"):
+        usage.parse_quotas({"a": {"device_secnds": 1}})
+    with pytest.raises(ValueError, match="not valid JSON"):
+        usage.parse_quotas("{nope")
+    with pytest.raises(ValueError, match="budget"):
+        usage.parse_quotas({"a": [1, 2]})
+
+
+def test_quotas_from_env_never_fatal(monkeypatch):
+    monkeypatch.setenv("PPTPU_QUOTAS", '{"acme": {"requests": 3}}')
+    assert usage.quotas_from_env() == {"acme": {"requests": 3.0}}
+    # a broken env var must not kill a daemon that never opted in
+    monkeypatch.setenv("PPTPU_QUOTAS", "{broken")
+    assert usage.quotas_from_env() == {}
+
+
+# -- metering + read-back ----------------------------------------------
+
+
+def _meter_some(n=12, seed=5):
+    rng = random.Random(seed)
+    for i in range(n):
+        usage.meter("request" if i % 3 else "archive",
+                    tenant=["alice", "bob", None][i % 3],
+                    bucket="8x64", workload="toas",
+                    wall_s=rng.uniform(0.01, 0.5),
+                    device_s=rng.uniform(0.001, 0.1),
+                    archives=1, bytes_decoded=1024 * (i + 1))
+
+
+def test_ledger_reconciles_with_in_memory_rollup(tmp_path):
+    with obs.run("usage-unit", base_dir=str(tmp_path)) as rec:
+        _meter_some()
+        mem = usage.totals()
+        run_dir = rec.dir
+    records = usage.read_usage(run_dir)
+    rolled = usage.rollup(records)
+    assert rolled["records"] == mem["records"] == 12
+    assert mem["dropped_records"] == 0
+    for t, sums in rolled["tenants"].items():
+        for k in ("records", "requests", "archives", "bytes_decoded"):
+            assert sums[k] == mem["tenants"][t][k], (t, k)
+        for k in ("wall_s", "device_s"):
+            assert sums[k] == pytest.approx(mem["tenants"][t][k],
+                                            abs=1e-6), (t, k)
+    # un-attributed work bills the local tenant — totals are complete
+    assert usage.LOCAL_TENANT in rolled["tenants"]
+    # rollup is order-independent: shuffled records, same sums
+    shuffled = list(records)
+    random.Random(7).shuffle(shuffled)
+    assert usage.rollup(shuffled) == rolled
+
+
+def test_torn_tail_and_foreign_lines_skipped(tmp_path):
+    with obs.run("usage-torn", base_dir=str(tmp_path)) as rec:
+        _meter_some(n=6)
+        run_dir = rec.dir
+    before = usage.rollup(usage.read_usage(run_dir))
+    with open(os.path.join(run_dir, "usage.jsonl"), "a",
+              encoding="utf-8") as fh:
+        # a foreign JSON line (wrong schema) and the torn tail a
+        # SIGKILL mid-append leaves — both must be skipped silently
+        fh.write(json.dumps({"schema": "other", "tenant": "x"}) + "\n")
+        fh.write('{"t": 1.0, "schema": "%s", "kind": "requ'
+                 % usage.SCHEMA)
+    after = usage.rollup(usage.read_usage(run_dir))
+    assert after == before
+
+
+def test_usage_files_ordering_and_shard_merge(tmp_path):
+    d = str(tmp_path)
+
+    def _write(name, tenant, n):
+        with open(os.path.join(d, name), "w", encoding="utf-8") as fh:
+            for _ in range(n):
+                fh.write(json.dumps(
+                    {"schema": usage.SCHEMA, "kind": "archive",
+                     "tenant": tenant, "wall_s": 0.25,
+                     "device_s": 0.1, "archives": 1}) + "\n")
+
+    _write("usage.jsonl", "live", 1)
+    _write("usage.jsonl.2", "rot2", 2)
+    _write("usage.jsonl.1", "rot1", 3)
+    _write("usage.3.jsonl", "shard", 4)
+    _write("usage.3.jsonl.1", "shardrot", 5)
+    _write("usage.bogus", "ignored", 9)
+    files = [os.path.basename(p) for p in usage.usage_files(d)]
+    # per-run rotated chain oldest-first, then the live file, then the
+    # per-process shard chains; foreign names ignored
+    assert files == ["usage.jsonl.1", "usage.jsonl.2", "usage.jsonl",
+                     "usage.3.jsonl.1", "usage.3.jsonl"]
+    rolled = usage.rollup(usage.read_usage(d))
+    assert rolled["records"] == 15
+    assert {t: v["records"] for t, v in rolled["tenants"].items()} == \
+        {"live": 1, "rot1": 3, "rot2": 2, "shard": 4, "shardrot": 5}
+    # shard/rotation merge is exact: concatenation == sum of parts
+    assert rolled["device_s"] == pytest.approx(1.5)
+
+
+def test_ledger_write_failure_still_bills(tmp_path):
+    """The never-fatal contract: a full disk drops the ledger RECORD
+    but never the billing — quota enforcement keeps counting."""
+    faults.configure("site:obs_write@every=1")
+    try:
+        with obs.run("usage-disk", base_dir=str(tmp_path)) as rec:
+            usage.configure_quotas({"acme": {"requests": 2}})
+            for _ in range(3):
+                usage.meter("request", tenant="acme", wall_s=0.1)
+            mem = usage.totals()
+            assert usage.check("acme") == {"quota": "requests",
+                                           "limit": 2.0, "used": 3.0}
+            run_dir = rec.dir
+    finally:
+        faults.reset()
+    assert mem["records"] == 3
+    assert mem["dropped_records"] == 3
+    assert mem["tenants"]["acme"]["requests"] == 3
+    # every append was eaten by the injected fault
+    assert usage.read_usage(run_dir) == []
+
+
+# -- SIGKILL mid-append: torn-tail integrity ---------------------------
+
+
+_SIGKILL_CHILD = """
+import os, sys
+from pulseportraiture_tpu import obs
+from pulseportraiture_tpu.obs import usage
+
+with obs.run("usage-sigkill", base_dir=sys.argv[1]) as rec:
+    print(rec.dir, flush=True)
+    i = 0
+    while True:
+        i += 1
+        usage.meter("archive", tenant="t%d" % (i % 4), bucket="8x64",
+                    workload="toas", wall_s=0.125, device_s=0.0625,
+                    archives=1, bytes_decoded=4096,
+                    pad="x" * 2048)
+"""
+
+
+def test_sigkill_mid_append_loses_at_most_inflight(tmp_path):
+    """A SIGKILLed writer leaves at most one torn line; every
+    completed record survives and rolls up cleanly."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PPTPU_OBS_DIR="",
+               PPTPU_FAULTS="")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SIGKILL_CHILD, str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        text=True)
+    try:
+        run_dir = proc.stdout.readline().strip()
+        assert run_dir, "child never opened its obs run"
+        ledger = os.path.join(run_dir, "usage.jsonl")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                if os.path.getsize(ledger) > 64 * 1024:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.02)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    raw = open(ledger, encoding="utf-8").read()
+    lines = raw.split("\n")
+    complete = [ln for ln in lines[:-1] if ln.strip()]
+    records = usage.read_usage(run_dir)
+    # every COMPLETED line survives the kill; the reader loses at most
+    # the torn in-flight tail (lines[-1] when the kill mid-append)
+    assert len(records) == len(complete) > 0
+    rolled = usage.rollup(records)
+    assert rolled["records"] == len(complete)
+    assert rolled["archives"] == len(complete)
+    assert rolled["wall_s"] == pytest.approx(0.125 * len(complete))
+
+
+# -- quota rejections replay exactly-once (service) --------------------
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("usage_svc")
+    gm = str(tmp / "u.gmodel")
+    write_model(gm, "u", "000", 1500.0, MODEL_PARAMS,
+                np.ones(8, int), -4.0, 0, quiet=True)
+    par = str(tmp / "u.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    files = []
+    for i in range(3):
+        out = str(tmp / f"u{i}.fits")
+        make_fake_pulsar(gm, par, out, nsub=2, nchan=8, nbin=64,
+                         nu0=1500.0, bw=800.0, tsub=60.0,
+                         phase=0.02 * (i + 1), dDM=5e-4,
+                         noise_stds=0.01, dedispersed=False,
+                         seed=150 + i, quiet=True)
+        files.append(out)
+    return SimpleNamespace(tmp=tmp, gm=gm, files=files)
+
+
+def test_quota_rejection_replays_exactly_once(corpus, tmp_path):
+    svc = TOAService(corpus.gm, str(tmp_path / "wd"),
+                     batch_window_s=0.2, batch_max=4, backoff_s=0.0,
+                     get_toas_kw={"bary": False},
+                     quotas={"alice": {"requests": 1}},
+                     quiet=True).start()
+    try:
+        run_dir = obs.current().dir
+        r1 = svc.submit("alice", corpus.files[0], wait=True,
+                        timeout=300)
+        assert r1["state"] == "done", r1
+        assert len(usage.read_usage(run_dir)) == 1
+
+        # alice is at her request budget: the next submit sheds with
+        # a clean replayable rejection, quarantined at submit
+        r2 = svc.submit("alice", corpus.files[1])
+        assert r2 == {"ok": False, "error": "quota",
+                      "tenant": "alice", "archive": corpus.files[1],
+                      "request_id": r2["request_id"],
+                      "quota": "requests", "limit": 1.0, "used": 1.0}
+        # the rejection itself is metered (one request record, no
+        # archive fitted) and counts toward the budget
+        n_after_reject = len(usage.read_usage(run_dir))
+        assert n_after_reject == 2
+        assert usage.quota_burn_fraction() >= 1.0
+
+        # the blast radius is alice alone: bob has no budget row
+        r3 = svc.submit("bob", corpus.files[2], wait=True, timeout=300)
+        assert r3["state"] == "done", r3
+
+        # duplicate of the rejected submit: answered from the tenant
+        # ledger — same outcome, NO second admission, NO re-meter
+        r4 = svc.submit("alice", corpus.files[1])
+        assert r4.get("cached") and r4["state"] == "quarantined", r4
+        assert r4["reason"].startswith("quota:"), r4
+        # duplicate of the served submit replays done, also un-metered
+        r5 = svc.submit("alice", corpus.files[0])
+        assert r5.get("cached") and r5["state"] == "done", r5
+        assert len(usage.read_usage(run_dir)) == n_after_reject + 1
+
+        mem = usage.totals()
+        assert mem["tenants"]["alice"]["requests"] == 2
+        assert mem["tenants"]["alice"]["archives"] == 1
+    finally:
+        assert svc.shutdown(timeout=120)
+    # ledger read-back agrees after close: alice billed one fit plus
+    # one zero-work rejection, bob one fit
+    rolled = usage.rollup(usage.read_usage(run_dir))
+    assert rolled["tenants"]["alice"]["records"] == 2
+    assert rolled["tenants"]["alice"]["archives"] == 1
+    assert rolled["tenants"]["bob"]["records"] == 1
+    assert rolled["tenants"]["bob"]["device_s"] > 0.0

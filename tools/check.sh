@@ -109,7 +109,17 @@
 #                 errors, exactly-once pp_done blocks fleet-wide,
 #                 and a merged obs report with the "## fleet"
 #                 section (docs/SERVICE.md Fleet)
-#  17. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
+#  17. usage smoke — the usage-accounting plane end to end: a 2-tenant
+#                 mixed-bucket load through a 2-daemon fleet must
+#                 reconcile exactly (fleet-merged pps_usage_* counters
+#                 vs the on-disk usage.jsonl ledger rollup, per
+#                 tenant), then one tenant's request quota exhausts:
+#                 only that tenant sheds (clean replayable "quota"
+#                 rejections, sibling untouched, zero transport
+#                 errors), pps_quota_burn saturates, and the drained
+#                 router run renders the "## usage" report section
+#                 (docs/OBSERVABILITY.md "Usage & quotas")
+#  18. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
 #
 # Usage: tools/check.sh [--lint-only]
 #   --lint-only   run only the static stages (pplint + ruff + drift +
@@ -319,6 +329,17 @@ if [ $? -ne 0 ]; then
     fail=1
 else
     tail -1 /tmp/_fleet_smoke.log
+fi
+
+echo
+echo "== usage smoke (per-tenant metering + quota shed, docs/OBSERVABILITY.md) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu PPTPU_OBS_DIR="" PPTPU_FAULTS="" \
+    python -m tools.usage_smoke >/tmp/_usage_smoke.log 2>&1
+if [ $? -ne 0 ]; then
+    tail -40 /tmp/_usage_smoke.log
+    fail=1
+else
+    tail -1 /tmp/_usage_smoke.log
 fi
 
 echo

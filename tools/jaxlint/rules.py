@@ -132,6 +132,12 @@ _J002_FAMILY_MSG = {
                "under jit it would run once at trace time and its "
                "threading/file IO cannot exist in compiled code "
                "(docs/SERVICE.md)",
+    "usage": "obs.usage call inside a jitted function — usage "
+             "metering is host-side by contract: a meter() appends a "
+             "ledger line under a lock and a quota check reads "
+             "in-memory totals, none of which can exist in compiled "
+             "code (and under jit would bill the trace, once); meter "
+             "after the jit boundary (docs/OBSERVABILITY.md)",
 }
 _J002_GENERIC_MSG = (
     "host-side API call inside a jitted function — this name is part "

@@ -49,6 +49,11 @@ What is compared (run-vs-run mode):
   regression, and two identical healthy runs trivially pass.  Runs
   predating the health plane (or where neither side ever alerted)
   contribute no rows.
+* usage (obs/usage.py): per-tenant usage-record counts must match
+  exactly — a run that metered different work did different work —
+  while the metered wall/device seconds are informational unless
+  ``--usage-rel`` gates them.  Runs predating the usage plane
+  contribute no rows.
 
 Exit status: 0 = no regression, 1 = regression(s), 2 = usage/IO error.
 Wired into tools/check.sh as a smoke-vs-smoke self-diff stage (two
@@ -126,6 +131,20 @@ def alerts_slice(manifest, events):
                                             "postmortems_written"))}
 
 
+def usage_slice(manifest, run_dir):
+    """The comparable usage-accounting slice of one run
+    (obs/usage.py): the exact order-independent rollup of its
+    ``usage.jsonl`` ledgers (rotated chains and per-process shards
+    included).  None for a run that predates the usage plane or never
+    metered — its diffs carry no usage rows at all."""
+    from pulseportraiture_tpu.obs import usage as u
+
+    records = u.read_usage(run_dir)
+    if not records:
+        return None
+    return u.rollup(records)
+
+
 def tv_distance(ha, hb):
     """Total-variation distance between two histogram snapshots'
     normalized bucket distributions: 0.5 * sum |p_i - q_i| over the
@@ -195,6 +214,7 @@ def run_summary(run_dir):
         "counters": counters,
         "quality": quality_slice(manifest, run_dir),
         "alerts": alerts_slice(manifest, events),
+        "usage": usage_slice(manifest, run_dir),
     }
 
 
@@ -353,9 +373,36 @@ def _diff_alerts(d, aa, ab):
                    _fmt((ab or {}).get("postmortems")), "-", "info"))
 
 
+def _diff_usage(d, ua, ub, usage_rel, min_s):
+    """Usage rows of a run-vs-run diff (obs/usage.py): per-tenant
+    record counts are ALWAYS exact — two runs of the same pipeline
+    that metered different amounts of work did different work — while
+    the metered wall/device seconds are informational unless
+    ``--usage-rel`` gates them.  Absence on both sides contributes no
+    rows (pre-usage runs stay diffable)."""
+    if not ua and not ub:
+        return
+    ta = (ua or {}).get("tenants") or {}
+    tb = (ub or {}).get("tenants") or {}
+    for tenant in sorted(set(ta) | set(tb)):
+        d.exact("usage.%s.records" % tenant,
+                (ta.get(tenant) or {}).get("records", 0),
+                (tb.get(tenant) or {}).get("records", 0))
+        for key in ("wall_s", "device_s"):
+            metric = "usage.%s.%s" % (tenant, key)
+            va = (ta.get(tenant) or {}).get(key)
+            vb = (tb.get(tenant) or {}).get(key)
+            if usage_rel is None:
+                d.rows.append((metric, _fmt(va), _fmt(vb), "-",
+                               "info"))
+            else:
+                d.check(metric, va, vb, usage_rel, floor=min_s)
+
+
 def diff_runs(a, b, rel=0.3, min_s=0.05, compile_rel=None,
               bad_allow=0, mem_rel=None, mem_min_bytes=1 << 20,
-              quality_rel=None, quality_min_subints=8):
+              quality_rel=None, quality_min_subints=8,
+              usage_rel=None):
     """Diff two run summaries; returns a :class:`Diff`.
 
     ``mem_rel=None`` (the default) renders memory rows as
@@ -419,6 +466,7 @@ def diff_runs(a, b, rel=0.3, min_s=0.05, compile_rel=None,
     _diff_quality(d, a.get("quality"), b.get("quality"), quality_rel,
                   quality_min_subints)
     _diff_alerts(d, a.get("alerts"), b.get("alerts"))
+    _diff_usage(d, a.get("usage"), b.get("usage"), usage_rel, min_s)
     return d
 
 
@@ -496,6 +544,13 @@ def build_parser():
                    help="Quality gating needs at least this many "
                         "fitted subints on one side (default 8) — "
                         "medians of two subints are all jitter.")
+    p.add_argument("--usage-rel", type=float, default=None,
+                   dest="usage_rel",
+                   help="Gate per-tenant metered wall/device seconds "
+                        "(obs/usage.py) at this relative threshold; "
+                        "without it the seconds rows are "
+                        "informational.  Per-tenant record counts are "
+                        "always exact.")
     return p
 
 
@@ -522,7 +577,8 @@ def main(argv=None):
                       bad_allow=args.bad_allow, mem_rel=args.mem_rel,
                       mem_min_bytes=args.mem_min_bytes,
                       quality_rel=args.quality_rel,
-                      quality_min_subints=args.quality_min_subints)
+                      quality_min_subints=args.quality_min_subints,
+                      usage_rel=args.usage_rel)
         print("# obs diff: %s vs %s" % (side_a, side_b))
     print(d.table())
     if d.regressions:

@@ -626,6 +626,41 @@ def summarize_health(manifest, events, run_dir):
     return "\n".join(lines)
 
 
+def summarize_usage(manifest, run_dir):
+    """The ``## usage`` section: the exact per-tenant rollup of the
+    run's ``usage.jsonl`` ledgers (obs/usage.py) — cost attribution in
+    the same currency ``ppusage`` reports fleet-wide.  Absent —
+    returns None — for runs that predate the usage plane or never
+    metered: absence is not breakage."""
+    from pulseportraiture_tpu.obs import usage as u
+
+    records = u.read_usage(run_dir)
+    if not records:
+        return None
+    rolled = u.rollup(records)
+    lines = ["%d record(s)  %.3f wall-s  %.3f device-s  %d fit(s)  "
+             "%s decoded" % (rolled["records"], rolled["wall_s"],
+                             rolled["device_s"], rolled["archives"],
+                             _fmt_bytes(rolled["bytes_decoded"]))]
+    rows = []
+    for tenant in sorted(rolled["tenants"]):
+        v = rolled["tenants"][tenant]
+        per_fit = ("%.3f" % (v["device_s"] / v["archives"])
+                   if v["archives"] else "-")
+        rows.append((tenant, v["records"], v["requests"],
+                     v["archives"], "%.3f" % v["wall_s"],
+                     "%.3f" % v["device_s"], per_fit,
+                     _fmt_bytes(v["bytes_decoded"])))
+    lines.append(_table(("tenant", "records", "requests", "fits",
+                         "wall-s", "device-s", "dev-s/fit",
+                         "bytes-in"), rows))
+    counters = manifest.get("counters") or {}
+    rejects = merged_gauge(counters, "service_quota_rejections")
+    if rejects:
+        lines.append("quota rejections: %d" % int(rejects))
+    return "\n".join(lines)
+
+
 _LATENCY_PHASE_ORDER = ["queue_wait", "checkout", "park", "dispatch",
                         "fit", "checkpoint", "total", "claim",
                         "archive"]
@@ -1059,6 +1094,11 @@ def summarize(run_dir):
         out.append("")
         out.append("## health (alerts & postmortems)")
         out.append(health)
+    used = summarize_usage(manifest, run_dir)
+    if used:
+        out.append("")
+        out.append("## usage")
+        out.append(used)
     counters = manifest.get("counters") or {}
     gauges = manifest.get("gauges") or {}
     caches = manifest.get("jit_cache_sizes") or {}

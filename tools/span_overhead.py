@@ -43,6 +43,9 @@ MEMORY_CALLS_PER_ARCHIVE = 10
 # check on the (rare) quarantine branch; the ring append itself rides
 # inside every emit and is therefore priced by the event/span probes
 HEALTH_CALLS_PER_ARCHIVE = 2
+# usage-metering touch points per archive (obs/usage.py): one meter at
+# the terminal state plus one quota-admission check at submit
+USAGE_CALLS_PER_ARCHIVE = 2
 BUDGET_FRACTION = 0.02
 
 
@@ -60,7 +63,7 @@ def measure(n=2000):
     and enabled."""
     from pulseportraiture_tpu import obs
     from pulseportraiture_tpu.obs import (flight, health, memory,
-                                          metrics, tracing)
+                                          metrics, tracing, usage)
 
     fit_result = {"nfeval": np.full(8, 12),
                   "red_chi2": np.ones(8),
@@ -141,6 +144,18 @@ def measure(n=2000):
         # enabled, past the PPTPU_FLIGHT_MAX_DUMPS cap, one seq check
         flight.dump("probe")
 
+    def one_usage_meter():
+        # the disabled-usage contract (docs/OBSERVABILITY.md "Usage &
+        # quotas"): with no run active a meter is one module-global
+        # read + None check; enabled it appends one ledger line
+        usage.meter("archive", tenant="probe", wall_s=0.01,
+                    device_s=0.005)
+
+    def one_usage_check():
+        # the quota-admission fast path: no run (or no quotas) admits
+        # for one global read + None check
+        usage.check("probe")
+
     probes = {"span": one_span, "phases": one_phases,
               "event": one_event, "fit_telemetry": one_fit_telemetry,
               "metrics_observe": one_metrics_observe,
@@ -154,7 +169,9 @@ def measure(n=2000):
               "memory_watermarks": one_memory_watermarks,
               "memory_last": one_memory_last,
               "health_evaluate": one_health_evaluate,
-              "flight_dump": one_flight_dump}
+              "flight_dump": one_flight_dump,
+              "usage_meter": one_usage_meter,
+              "usage_check": one_usage_check}
 
     out = {}
     saved = os.environ.pop("PPTPU_OBS_DIR", None)
@@ -219,6 +236,16 @@ def measure(n=2000):
         out["health_evaluate_on_s"] + out["flight_dump_on_s"])
     out["hot_fit_health_off_s"] = out["hot_fit_memory_off_s"] \
         + out["health_archive_off_s"]
+    # usage metering (docs/OBSERVABILITY.md "Usage & quotas"):
+    # disabled = the no-run fast paths of the terminal-state meter and
+    # the submit-time quota check; enabled = one ledger append + the
+    # in-memory rollup read, per archive
+    out["usage_archive_off_s"] = (
+        out["usage_meter_off_s"] + out["usage_check_off_s"])
+    out["usage_archive_on_s"] = (
+        out["usage_meter_on_s"] + out["usage_check_on_s"])
+    out["hot_fit_usage_off_s"] = out["hot_fit_health_off_s"] \
+        + out["usage_archive_off_s"]
     return out
 
 

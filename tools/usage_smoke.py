@@ -1,0 +1,254 @@
+"""Usage-accounting smoke gate: per-tenant metering must reconcile
+exactly across the fleet, and quota exhaustion must shed ONLY the
+breaching tenant (wired into tools/check.sh).
+
+The scenario (docs/OBSERVABILITY.md "Usage & quotas"):
+
+* a 2-bucket corpus, two tenants — ``alice`` on one bucket, ``bob``
+  on the other — through a 2-daemon :class:`FleetRouter` whose
+  ``quotas`` budget alice at a fixed request count.
+* **phase A (accounting integrity)**: a mixed load everyone survives.
+  The fleet-merged metrics snapshot's tenant-labeled
+  ``pps_usage_*_total`` counters must reconcile with the rollup of
+  the on-disk ``usage.jsonl`` ledgers (router forwards + daemon
+  requests) — same records, same seconds, two independent paths.
+  Per-tenant device-seconds must stay inside the summed request wall
+  spans (a fit cannot bill more device time than its request spent).
+* **phase B (quota shed)**: a serialized burst that walks alice over
+  her request budget.  Exactly the over-budget submissions shed with
+  clean replayable ``{"ok": false, "error": "quota"}`` rejections —
+  bob's traffic is untouched, zero transport errors anywhere, the
+  router's ``pps_shed_total{reason="quota"}`` counts the sheds, and
+  the ``pps_quota_burn`` gauge saturates (the ``quota_burn`` health
+  rule's input).
+* the drained router's obs run renders the "## usage" section
+  (tools/obs_report.py) and ``ppusage`` rolls the whole fleet tree up
+  to the same totals.
+
+Run:  env JAX_PLATFORMS=cpu python -m tools.usage_smoke
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+N_PHASE_A = 6              # 3 alice + 3 bob, all admitted
+N_PHASE_B = 8              # 4 alice + 4 bob, serialized
+ALICE_REQUESTS = 5         # alice's budget: 3 (A) + 2 (B) forwards
+
+
+def _merged_counter(snap, name):
+    """Sum of a counter across ``p<proc>/`` merge prefixes, keyed by
+    its tenant label."""
+    from pulseportraiture_tpu.obs.metrics import parse_series
+
+    out = {}
+    for key, v in (snap.get("counters") or {}).items():
+        base, labels = parse_series(key.rsplit("/", 1)[-1])
+        if base == name:
+            tenant = labels.get("tenant", labels.get("reason", "-"))
+            out[tenant] = out.get(tenant, 0.0) + float(v)
+    return out
+
+
+def main():
+    workroot = tempfile.mkdtemp(prefix="pptpu_usage_smoke_")
+    router = None
+    rserver = None
+    try:
+        from pulseportraiture_tpu.cli.pploadgen import (build_requests,
+                                                        run_load)
+        from pulseportraiture_tpu.cli.ppusage import collect_records
+        from pulseportraiture_tpu.io.archive import make_fake_pulsar
+        from pulseportraiture_tpu.io.gmodel import write_model
+        from pulseportraiture_tpu.obs import usage
+        from pulseportraiture_tpu.runner.plan import plan_survey
+        from pulseportraiture_tpu.service import (
+            DEFAULT_ROUTER_SOCKET_NAME, FleetRouter, ServiceServer)
+
+        t_all = time.monotonic()
+        gm = os.path.join(workroot, "usage.gmodel")
+        write_model(gm, "usage", "000", 1500.0,
+                    np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0,
+                              -0.5]),
+                    np.ones(8, int), -4.0, 0, quiet=True)
+        par = os.path.join(workroot, "usage.par")
+        with open(par, "w") as f:
+            f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                    "PEPOCH 56000.0\nDM 30.0\n")
+        # two shape buckets — alice's traffic on one, bob's on the
+        # other, so each daemon meters one tenant's fits
+        shapes = [("a0", 8, 64), ("b1", 16, 64)]
+        archives = []
+        for i, (tag, nchan, nbin) in enumerate(shapes):
+            fits = os.path.join(workroot, tag + ".fits")
+            make_fake_pulsar(gm, par, fits, nsub=2, nchan=nchan,
+                             nbin=nbin, nu0=1500.0, bw=800.0,
+                             tsub=60.0, phase=0.02 * (i + 1),
+                             dDM=5e-4, noise_stds=0.01,
+                             dedispersed=False, seed=71 + i,
+                             quiet=True)
+            archives.append(fits)
+        plan = plan_survey(archives, modelfile=gm)
+        assert len(plan.buckets) == 2, plan.to_dict()
+        plan_path = os.path.join(workroot, "plan.json")
+        plan.save(plan_path)
+        tenants = ["alice", "bob"]
+
+        fleet_wd = os.path.join(workroot, "fleet")
+        router = FleetRouter(
+            gm, fleet_wd, n_daemons=2, plan=plan_path,
+            compile_cache=os.path.join(workroot, "compile_cache"),
+            warm=True, batch_window_s=0.2, batch_max=4,
+            quotas={"alice": {"requests": ALICE_REQUESTS}},
+            health_interval_s=0.5,
+            daemon_args=["--no_bary", "--backoff", "0"], quiet=True)
+        router.start(ready_timeout=420)
+        assert all(d.ready.is_set() for d in router._daemons), \
+            router.status()
+        rsock = os.path.join(fleet_wd, DEFAULT_ROUTER_SOCKET_NAME)
+        rserver = ServiceServer(router, rsock).start()
+        print("usage smoke: 2-daemon fleet warm after %.1fs"
+              % (time.monotonic() - t_all))
+
+        # -- phase A: everyone under budget --------------------------
+        reqs_a = build_requests(archives, N_PHASE_A, tenants,
+                                os.path.join(workroot, "spool_a"),
+                                seed=1)
+        results_a, _wall_a = run_load(rsock, reqs_a, mode="closed",
+                                      concurrency=4, timeout=300.0)
+        assert all(r.ok for r in results_a), \
+            [(r.tenant, r.error) for r in results_a if not r.ok]
+
+        # two independent accounting paths must agree: the on-disk
+        # ledgers (daemon request records + router forward records)
+        # vs the fleet-merged in-memory counters
+        recs, _n = collect_records([workroot])
+        rolled = usage.rollup(recs)
+        merged = router.metrics_snapshot()
+        mrec = _merged_counter(merged, "pps_usage_records_total")
+        mdev = _merged_counter(merged,
+                               "pps_usage_device_seconds_total")
+        by_kind = {}
+        for r in recs:
+            by_kind.setdefault(r["kind"], []).append(r)
+        n_client = {t: sum(1 for r in results_a if r.tenant == t)
+                    for t in tenants}
+        for t in tenants:
+            fwd = [r for r in by_kind.get("forward", [])
+                   if r["tenant"] == t]
+            req = [r for r in by_kind.get("request", [])
+                   if r["tenant"] == t]
+            assert len(fwd) == len(req) == n_client[t], \
+                (t, len(fwd), len(req), n_client)
+            assert int(mrec[t]) == rolled["tenants"][t]["records"], \
+                (t, mrec, rolled["tenants"])
+            dev_ledger = rolled["tenants"][t]["device_s"]
+            assert abs(mdev.get(t, 0.0) - dev_ledger) < 1e-3, \
+                (t, mdev, dev_ledger)
+            assert dev_ledger > 0.0, (t, rolled["tenants"])
+            # a request cannot bill more device time than it spent
+            wall = sum(r["wall_s"] for r in req)
+            assert dev_ledger <= wall + 1e-6, (t, dev_ledger, wall)
+        print("usage smoke: phase A reconciled — %s"
+              % {t: "%d rec / %.3f dev-s"
+                 % (rolled["tenants"][t]["records"],
+                    rolled["tenants"][t]["device_s"])
+                 for t in tenants})
+
+        # -- phase B: alice exhausts her request budget --------------
+        # serialized (concurrency=1) so the admission boundary is
+        # deterministic: alice's forwards 4..5 admit, 6..7 shed
+        reqs_b = build_requests(archives, N_PHASE_B, tenants,
+                                os.path.join(workroot, "spool_b"),
+                                seed=2)
+        results_b, _wall_b = run_load(rsock, reqs_b, mode="closed",
+                                      concurrency=1, timeout=300.0)
+        alice = [r for r in results_b if r.tenant == "alice"]
+        bob = [r for r in results_b if r.tenant == "bob"]
+        assert all(r.ok for r in bob), \
+            [(r.archive, r.error) for r in bob if not r.ok]
+        shed = [r for r in alice if not r.ok]
+        served = [r for r in alice if r.ok]
+        assert [r.error for r in shed] == ["quota"] * len(shed), \
+            [(r.archive, r.error) for r in shed]
+        assert len(served) == ALICE_REQUESTS - n_client["alice"], \
+            (len(served), len(shed))
+        # clean rejections, not transport errors: every result has a
+        # latency (the socket answered) and bob saw zero errors
+        assert all(r.latency_s is not None for r in results_b)
+        merged = router.metrics_snapshot()
+        sheds = _merged_counter(merged, "pps_shed_total")
+        assert int(sheds.get("quota", 0)) == len(shed), sheds
+        burn = [float(v) for k, v in
+                (merged.get("gauges") or {}).items()
+                if k.rsplit("/", 1)[-1].startswith("pps_quota_burn")]
+        assert burn and max(burn) >= 0.85, burn
+        print("usage smoke: phase B — alice shed %d/%d at quota "
+              "(burn %.2f), bob untouched (%d ok)"
+              % (len(shed), len(alice), max(burn), len(bob)))
+
+        ok = router.shutdown(timeout=180)
+        assert ok, "fleet drain timed out"
+        rserver.stop()
+        rserver = None
+        router = None
+
+        # -- read side: report section + fleet-wide ppusage ----------
+        from tools.obs_report import summarize
+
+        obs_base = os.path.join(fleet_wd, "obs")
+        runs = sorted(os.path.join(obs_base, d)
+                      for d in os.listdir(obs_base))
+        assert runs, "no router obs run recorded"
+        text = summarize(runs[-1])
+        assert "## usage" in text, text
+        assert "alice" in text.split("## usage", 1)[1], text
+
+        all_recs, n_files = collect_records([workroot])
+        final = usage.rollup(all_recs)
+        n_served = sum(1 for r in results_a + results_b if r.ok)
+        assert final["tenants"]["alice"]["archives"] \
+            + final["tenants"]["bob"]["archives"] == n_served, \
+            (final["tenants"], n_served)
+
+        # torn-tail integrity: the half-written line a SIGKILL tears
+        # mid-append must be skipped, every completed record billed —
+        # the fleet rollup is unchanged by the corruption
+        torn = next(os.path.join(dp, "usage.jsonl")
+                    for dp, _dn, names in os.walk(workroot)
+                    if "usage.jsonl" in names)
+        with open(torn, "a", encoding="utf-8") as fh:
+            fh.write('{"t": 1.0, "schema": "%s", "kind": "requ'
+                     % usage.SCHEMA)
+        re_recs, _ = collect_records([workroot])
+        assert usage.rollup(re_recs) == final, "torn tail broke rollup"
+
+        result = {
+            "tenants": {t: final["tenants"][t]["records"]
+                        for t in tenants},
+            "device_s": final["device_s"],
+            "quota_sheds": len(shed),
+            "ledger_files": n_files,
+            "wall_s": round(time.monotonic() - t_all, 3),
+        }
+        print("usage smoke OK: %s" % json.dumps(result))
+        return 0
+    finally:
+        if rserver is not None:
+            rserver.stop()
+        if router is not None:
+            try:
+                router.shutdown(timeout=30)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
