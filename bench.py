@@ -492,6 +492,93 @@ def _fleet_slo_stage():
         shutil.rmtree(wdir, ignore_errors=True)
 
 
+def _supervise_elastic_stage():
+    """Self-healing autoscaling (docs/RUNNER.md "Autoscaling"): an
+    in-process Supervisor owns a small zap survey whose workers are
+    slowed by an injected archive-read latency, one scaled-up worker
+    is SIGKILLed mid-run, and the stage measures the two numbers the
+    robustness claim rests on — how long the control loop takes to
+    put a replacement in the dead slot, and how long one
+    observe+decide reconciliation tick costs on the live union
+    ledger.  Returns (time_to_replace_s, decision_latency_s,
+    respawns)."""
+    import shutil
+    import signal as _signal
+    import tempfile
+    import threading
+
+    from pulseportraiture_tpu.io.archive import make_fake_pulsar
+    from pulseportraiture_tpu.runner.plan import plan_survey
+    from pulseportraiture_tpu.runner.respawn import RespawnPolicy
+    from pulseportraiture_tpu.runner.supervisor import (Supervisor,
+                                                        decide)
+
+    wdir = tempfile.mkdtemp(prefix="pp_bench_supervise_")
+    try:
+        gm, par = _bench_source(wdir)
+        archives = []
+        for i in range(8):
+            out = os.path.join(wdir, "s%03d.fits" % i)
+            make_fake_pulsar(gm, par, out, nsub=2, nchan=8, nbin=64,
+                             nu0=1500.0, bw=800.0, tsub=60.0,
+                             phase=0.02 * (i + 1), dDM=5e-4,
+                             noise_stds=0.01, dedispersed=False,
+                             seed=910 + i, quiet=True)
+            archives.append(out)
+        wd = os.path.join(wdir, "wd")
+        os.makedirs(wd)
+        plan_survey(archives, modelfile=gm).save(
+            os.path.join(wd, "plan.json"))
+
+        _stage('supervise elastic: 3-slot supervisor, sigkill one '
+               'scaled-up worker')
+        slow = {"PPTPU_FAULTS": "site:archive_read@1.0,latency=0.3"}
+        sup = Supervisor(
+            wd, min_workers=1, max_workers=3, backlog_per_worker=2.0,
+            interval_s=0.1, lease_s=30.0, workload="zap",
+            respawn_policy=RespawnPolicy(backoff_s=0.05, flap_count=5,
+                                         flap_window_s=60.0),
+            worker_env={i: dict(slow) for i in range(3)}, quiet=True)
+        summary = {}
+        th = threading.Thread(
+            target=lambda: summary.update(sup.run()), daemon=True)
+        th.start()
+        deadline = time.time() + 300.0
+        while time.time() < deadline and sup.slots[1].pid is None:
+            time.sleep(0.02)
+        victim = sup.slots[1].pid
+        if not victim:
+            raise RuntimeError("supervisor never scaled up to slot 1")
+        t_kill = time.time()
+        os.kill(victim, _signal.SIGKILL)
+        while time.time() < deadline \
+                and sup.slots[1].spawn_count < 2:
+            time.sleep(0.02)
+        if sup.slots[1].spawn_count < 2:
+            raise RuntimeError("killed worker was never replaced")
+        time_to_replace = time.time() - t_kill
+        th.join(timeout=300.0)
+        if th.is_alive() or summary.get("stopped_by") != "complete":
+            raise RuntimeError("supervised survey did not complete: "
+                               "%s" % summary)
+
+        # one reconciliation tick on the real (settled) union ledger:
+        # a readonly replay + the pure policy — the latency every
+        # scale decision pays
+        lats = []
+        for _ in range(10):
+            t0 = time.time()
+            decide(sup.observe_survey())
+            lats.append(time.time() - t0)
+        decision_latency = sorted(lats)[len(lats) // 2]
+        _stage('supervise elastic: replaced in %.2fs, decision tick '
+               '%.3fs' % (time_to_replace, decision_latency))
+        return (time_to_replace, decision_latency,
+                summary["workers"]["respawns"])
+    finally:
+        shutil.rmtree(wdir, ignore_errors=True)
+
+
 def main():
     """Open the bench obs run and print the BENCH line from it.
 
@@ -758,6 +845,11 @@ def _bench():
         single_rps, fleet_rps, fleet_p99, fleet_miss_rate = \
             _fleet_slo_stage()
 
+    # ---- self-healing autoscaling: replace a sigkilled worker ---------
+    with obs.span("supervise_elastic"):
+        sup_replace_s, sup_decision_s, sup_respawns = \
+            _supervise_elastic_stage()
+
     # ---- rough sustained FLOP/s for the main config -------------------
     # per subint: rFFT (5 N log2 N per channel) + ~n_iter fused moment
     # passes of ~40 flops per (channel, harmonic)
@@ -823,6 +915,10 @@ def _bench():
             "fleet_p99_s": None if fleet_p99 is None
             else round(fleet_p99, 4),
             "deadline_miss_rate": round(fleet_miss_rate, 4),
+            "supervise_time_to_replace_s": round(sup_replace_s, 3),
+            "supervise_scale_decision_latency_s": round(
+                sup_decision_s, 4),
+            "supervise_respawns": sup_respawns,
             "gflops_approx": round(float(gflops), 1),
             "backend_fallback": ns.backend_fallback,
         },

@@ -10,6 +10,8 @@ report state + the merged observability run.
         --compile-cache /shared/ppcache
     python -m pulseportraiture_tpu.cli.ppsurvey run    -w workdir
     python -m pulseportraiture_tpu.cli.ppsurvey resume -w workdir
+    python -m pulseportraiture_tpu.cli.ppsurvey supervise -w workdir \\
+        --max-workers 4
     python -m pulseportraiture_tpu.cli.ppsurvey status -w workdir
     python -m pulseportraiture_tpu.cli.ppsurvey report -w workdir
 
@@ -195,6 +197,87 @@ def build_parser():
     wm.add_argument("--no_bary", dest="bary", action="store_false")
     wm.add_argument("--quiet", action="store_true")
 
+    sv = sub.add_parser(
+        "supervise",
+        help="Own the survey end-to-end: spawn worker subprocesses, "
+             "autoscale on backlog, replace crashed/wedged workers, "
+             "drain at completion (docs/RUNNER.md Autoscaling).")
+    sv.add_argument("-w", "--workdir", required=True)
+    sv.add_argument("-m", "--modelfile", default=None, metavar="model",
+                    help="Override the plan's model file (forwarded "
+                         "to every worker).")
+    sv.add_argument("--min-workers", type=int, default=1,
+                    dest="min_workers",
+                    help="Worker-count floor while work remains.")
+    sv.add_argument("--max-workers", type=int, default=4,
+                    dest="max_workers",
+                    help="Worker-count ceiling; also the workers' "
+                         "--processes partition width, so every slot "
+                         "keeps a stable ledger/checkpoint identity "
+                         "across replacements.")
+    sv.add_argument("--backlog-per-worker", type=float, default=2.0,
+                    dest="backlog_per_worker", metavar="N",
+                    help="Scale up while ready work per live worker "
+                         "exceeds N (and memory headroom allows).")
+    sv.add_argument("--interval", type=float, default=1.0,
+                    dest="interval_s", metavar="S",
+                    help="Reconcile-loop tick [s].")
+    sv.add_argument("--lease", type=float, default=600.0,
+                    dest="lease_s", metavar="S",
+                    help="Worker work-ownership lease [s] (forwarded); "
+                         "a wedged worker is replaced once its leases "
+                         "expire.")
+    sv.add_argument("--mem-budget-bytes", type=int, default=0,
+                    dest="mem_budget_bytes", metavar="B",
+                    help="Host admission budget: never scale past "
+                         "B // est-worker-bytes live workers "
+                         "(0 = unconstrained).")
+    sv.add_argument("--est-worker-bytes", type=int, default=None,
+                    dest="est_worker_bytes", metavar="B",
+                    help="Per-worker working-set estimate (default: "
+                         "the plan's largest bucket est_bytes).")
+    sv.add_argument("--workload", default=None, metavar="NAME",
+                    help="Workload the workers run (default toas).")
+    sv.add_argument("--warm", nargs="?", const="always",
+                    choices=["always", "auto"], default=None,
+                    help="Forwarded to every worker (ppsurvey run "
+                         "--warm).")
+    sv.add_argument("--compile-cache", default=None, metavar="DIR",
+                    dest="compile_cache",
+                    help="Forwarded to every worker (share one dir so "
+                         "replacements deserialize instead of "
+                         "recompiling).")
+    sv.add_argument("--flap-count", type=int, default=3,
+                    dest="flap_count", metavar="K",
+                    help="Park a slot that dies K times inside the "
+                         "flap window instead of respawning forever.")
+    sv.add_argument("--flap-window", type=float, default=60.0,
+                    dest="flap_window_s", metavar="W",
+                    help="Flap-detection window [s].")
+    sv.add_argument("--respawn-backoff", type=float, default=1.0,
+                    dest="respawn_backoff_s", metavar="S",
+                    help="Base crash-loop backoff [s] (doubles per "
+                         "consecutive fast death, jittered).")
+    sv.add_argument("--drain-grace", type=float, default=60.0,
+                    dest="drain_grace_s", metavar="S",
+                    help="Wait this long for draining workers at "
+                         "shutdown before leaving them standalone.")
+    sv.add_argument("--max-ticks", type=int, default=None,
+                    dest="max_ticks",
+                    help="Stop supervising after N reconcile ticks "
+                         "(smoke/test bound; workers drain).")
+    sv.add_argument("--worker-arg", action="append", default=[],
+                    dest="worker_args", metavar="ARG",
+                    help="Extra argv appended to every worker's "
+                         "'ppsurvey run' (repeatable), e.g. "
+                         "--worker-arg=--no_bary.")
+    sv.add_argument("--worker-env", action="append", default=[],
+                    dest="worker_env", metavar="SLOT:KEY=VALUE",
+                    help="Extra env for the FIRST spawn of one slot "
+                         "(repeatable; the chaos hook — respawns "
+                         "scrub PPTPU_FAULTS).")
+    sv.add_argument("--quiet", action="store_true")
+
     st = sub.add_parser("status", help="Aggregate ledger state.")
     st.add_argument("-w", "--workdir", required=True)
     st.add_argument("--watch", action="store_true",
@@ -351,6 +434,52 @@ def _cmd_run(args):
     return rc
 
 
+def _parse_worker_env(pairs):
+    """--worker-env SLOT:KEY=VALUE list -> {slot: {KEY: VALUE}}."""
+    out = {}
+    for pair in pairs or []:
+        slot, sep, kv = pair.partition(":")
+        key, sep2, value = kv.partition("=")
+        if not sep or not sep2 or not key or not slot.isdigit():
+            raise SystemExit(
+                "ppsurvey: --worker-env wants SLOT:KEY=VALUE, got %r"
+                % pair)
+        out.setdefault(int(slot), {})[key] = value
+    return out
+
+
+def _cmd_supervise(args):
+    from ..runner.queue import DEFAULT_WORKLOAD
+    from ..runner.respawn import RespawnPolicy
+    from ..runner.supervisor import Supervisor
+
+    try:
+        sup = Supervisor(
+            args.workdir, modelfile=args.modelfile,
+            min_workers=args.min_workers,
+            max_workers=args.max_workers,
+            backlog_per_worker=args.backlog_per_worker,
+            interval_s=args.interval_s, lease_s=args.lease_s,
+            mem_budget_bytes=args.mem_budget_bytes,
+            est_worker_bytes=args.est_worker_bytes,
+            workload=args.workload or DEFAULT_WORKLOAD,
+            warm=args.warm, compile_cache=_cache_dir(args),
+            respawn_policy=RespawnPolicy(
+                backoff_s=args.respawn_backoff_s,
+                flap_count=args.flap_count,
+                flap_window_s=args.flap_window_s),
+            worker_args=args.worker_args,
+            worker_env=_parse_worker_env(args.worker_env),
+            drain_grace_s=args.drain_grace_s,
+            max_ticks=args.max_ticks, quiet=args.quiet)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"ppsurvey: {e}", file=sys.stderr)
+        return 1
+    summary = sup.run()
+    print(json.dumps(summary))
+    return 0 if summary["outstanding"] == 0 else 1
+
+
 def _cmd_status(args):
     from ..runner.execute import survey_status
 
@@ -366,7 +495,11 @@ def _cmd_status(args):
 
         def fetch():
             run_dir = metrics.latest_run_dir(base)
-            return metrics.last_snapshot(run_dir) if run_dir else None
+            snap = metrics.last_snapshot(run_dir) if run_dir else None
+            # supervised surveys: the newest run dir is a worker's,
+            # not the supervisor's — fold the supervisor's gauges in
+            # (absent-not-broken on unsupervised runs)
+            return metrics.overlay_supervisor(snap, base)
 
         return watch_loop(fetch, args.interval, args.ticks,
                           title="ppsurvey %s" % args.workdir)
@@ -445,6 +578,7 @@ def main(argv=None):
         return 1
     return {"plan": _cmd_plan, "run": _cmd_run, "resume": _cmd_run,
             "warm": _cmd_warm, "status": _cmd_status,
+            "supervise": _cmd_supervise,
             "report": _cmd_report}[args.command](args)
 
 

@@ -110,6 +110,11 @@ BUILTIN_RULES = (
      "op": ">=", "threshold": 2, "window_s": 300.0, "for_s": 0.0,
      "summary": "fleet daemons respawning repeatedly (crash-looping "
                 "replica or poisoned bucket)"},
+    {"name": "worker_churn", "kind": "rate", "severity": "warning",
+     "signal": ("pps_supervisor_respawns_total",),
+     "op": ">=", "threshold": 3, "window_s": 300.0, "for_s": 0.0,
+     "summary": "survey workers respawning repeatedly under the "
+                "supervisor (respawn storm; flapping slots park)"},
     # the quota plane (obs/usage.py) publishes pps_quota_burn as the
     # UNLABELED max used/limit fraction across budgeted tenants (the
     # per-tenant fractions live under a different name on purpose:

@@ -654,20 +654,18 @@ def last_snapshot(run_dir):
     return snaps[-1] if snaps else None
 
 
-def latest_run_dir(base):
-    """Newest run directory under an obs base dir (mtime order), or
-    ``base`` itself when it already is a run dir; None when nothing
-    qualifies.  The ``--watch`` views poll this instead of replaying
-    ledgers."""
+def _run_dirs(base):
+    """Every run directory under an obs base dir (or ``base`` itself
+    when it already is one); [] when nothing qualifies."""
     if not base:
-        return None
+        return []
     for probe in ("metrics.jsonl", "events.jsonl", "manifest.json"):
         if os.path.isfile(os.path.join(base, probe)):
-            return base
+            return [base]
     try:
         names = os.listdir(base)
     except OSError:
-        return None
+        return []
     runs = []
     for name in names:
         d = os.path.join(base, name)
@@ -675,7 +673,62 @@ def latest_run_dir(base):
                for p in ("metrics.jsonl", "events.jsonl",
                          "manifest.json")):
             runs.append(d)
+    return runs
+
+
+def latest_run_dir(base):
+    """Newest run directory under an obs base dir (mtime order), or
+    ``base`` itself when it already is a run dir; None when nothing
+    qualifies.  The ``--watch`` views poll this instead of replaying
+    ledgers."""
+    runs = _run_dirs(base)
     return max(runs, key=os.path.getmtime) if runs else None
+
+
+def overlay_supervisor(snap, base):
+    """Fold the supervisor's ``pps_supervisor_*`` series into a watch
+    snapshot.
+
+    ``ppsurvey status --watch`` tails the *newest* run dir under the
+    workdir's obs base — on a supervised survey that is almost always
+    a worker's run (workers start after the supervisor, so their dirs
+    are newer), which would make the supervisor's gauges invisible
+    exactly when they matter.  This scans the run dirs newest-first
+    for the supervisor's own series and copies them in.  Absent, not
+    broken: an unsupervised run has no such series anywhere, and the
+    snapshot is returned untouched (bit-identical frame)."""
+    def _sup_series(s):
+        out = {}
+        for kind in ("gauges", "counters"):
+            for key, v in (s.get(kind) or {}).items():
+                if key.rsplit("/", 1)[-1].startswith(
+                        "pps_supervisor_"):
+                    out.setdefault(kind, {})[key] = v
+        return out
+
+    if snap and _sup_series(snap):
+        return snap
+    try:
+        runs = sorted(_run_dirs(base), key=os.path.getmtime,
+                      reverse=True)
+    except OSError:
+        runs = []
+    for run_dir in runs:
+        other = last_snapshot(run_dir)
+        if not other:
+            continue
+        sup = _sup_series(other)
+        if not sup:
+            continue
+        if snap is None:
+            return other
+        snap = dict(snap)
+        for kind, series in sup.items():
+            merged = dict(snap.get(kind) or {})
+            merged.update(series)
+            snap[kind] = merged
+        return snap
+    return snap
 
 
 def merge_snapshots(snaps):
@@ -971,6 +1024,58 @@ def _usage_row(snap, prev=None, dt=None):
     return "usage: " + "  ".join(parts)
 
 
+def _supervisor_row(snap):
+    """The ``--watch`` autoscaling-supervisor line
+    (runner/supervisor.py): desired/live/parked worker counts from the
+    state-labeled ``pps_supervisor_workers`` gauges (per-state values,
+    never summed across ``p<proc>/`` merge prefixes — only the one
+    supervisor process publishes them), respawn/scale totals from the
+    ``pps_supervisor_*_total`` counters (summed across prefixes), and
+    the last scale action from the ``pps_supervisor_last_scale``
+    timestamp gauges; None when the snapshot carries no supervisor
+    series (unsupervised runs keep their original frame)."""
+    workers = {}
+    last = None  # (t, action)
+    for key, v in (snap.get("gauges") or {}).items():
+        name, labels = parse_series(key.rsplit("/", 1)[-1])
+        try:
+            if name == "pps_supervisor_workers":
+                workers[labels.get("state", "?")] = int(float(v))
+            elif name == "pps_supervisor_last_scale":
+                t = float(v)
+                if last is None or t > last[0]:
+                    last = (t, labels.get("action", "?"))
+        except (TypeError, ValueError):
+            continue
+    if not workers:
+        return None
+    respawns = scales = 0
+    for key, v in (snap.get("counters") or {}).items():
+        name, _labels = parse_series(key.rsplit("/", 1)[-1])
+        try:
+            if name == "pps_supervisor_respawns_total":
+                respawns += int(v)
+            elif name == "pps_supervisor_scale_events_total":
+                scales += int(v)
+        except (TypeError, ValueError):
+            continue
+    scale_txt = "-"
+    if last is not None:
+        ago = ""
+        try:
+            dt = float(snap.get("t", 0.0)) - last[0]
+            if dt >= 0:
+                ago = " (%.0fs ago)" % dt
+        except (TypeError, ValueError):
+            pass
+        scale_txt = "%s%s" % (last[1], ago)
+    return ("supervisor: desired %d  live %d  parked %d  "
+            "respawns %d  scale-events %d  last scale %s" % (
+                workers.get("desired", 0), workers.get("live", 0),
+                workers.get("parked", 0), respawns, scales,
+                scale_txt))
+
+
 def render_watch(snap, prev=None, title=""):
     """A terminal dashboard frame from one snapshot (pptop-style).
 
@@ -1077,6 +1182,12 @@ def render_watch(snap, prev=None, title=""):
         if not mem and not qual and not cache and not alerts:
             lines.append("")
         lines.append(used)
+    sup = _supervisor_row(snap)
+    if sup:
+        if not mem and not qual and not cache and not alerts \
+                and not used:
+            lines.append("")
+        lines.append(sup)
     if gauges:
         lines.append("")
         lines.append("gauges: " + "  ".join(

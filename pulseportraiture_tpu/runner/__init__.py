@@ -23,6 +23,8 @@ from .plan import (ArchiveInfo, ShapeBucket, SurveyPlan, canonical_shape,
 from .queue import DEFAULT_WORKLOAD, WorkQueue
 from .execute import run_survey, survey_status
 from .prefetch import HostPrefetcher, PrefetchTicket
+from .respawn import RespawnPolicy, RespawnTracker
+from .supervisor import Supervisor, decide, supervise
 from .warm import (WarmSpec, enable_persistent_cache, program_specs,
                    synth_databunch, warm_plan)
 from .workloads import (AlignWorkload, ModelFitWorkload, ToasWorkload,
@@ -38,4 +40,6 @@ __all__ = ["ArchiveInfo", "ShapeBucket", "SurveyPlan", "canonical_shape",
            "AlignWorkload", "ModelFitWorkload", "register_workload",
            "get_workload", "workload_names", "resolve_workload",
            "WarmSpec", "program_specs", "warm_plan",
-           "enable_persistent_cache", "synth_databunch"]
+           "enable_persistent_cache", "synth_databunch",
+           "RespawnPolicy", "RespawnTracker", "Supervisor", "decide",
+           "supervise"]

@@ -58,6 +58,7 @@ from ..obs import metrics, usage
 from ..obs import health as obs_health
 from ..runner.plan import SurveyPlan, canonical_shape, \
     estimate_archive_bytes, scan_archive_header
+from ..runner.respawn import PARK, RespawnPolicy, RespawnTracker
 from .server import DEFAULT_SOCKET_NAME, client_request
 
 __all__ = ["FleetRouter", "DEFAULT_ROUTER_SOCKET_NAME"]
@@ -122,7 +123,7 @@ class FleetRouter:
                  unhealthy_after=2, rebalance_delta=8,
                  respawn_timeout_s=300.0, forward_attempts=3,
                  adopt_sockets=None, daemon_args=None, daemon_env=None,
-                 quiet=True):
+                 flap_count=5, flap_window_s=60.0, quiet=True):
         self.modelfile = modelfile
         self.workdir = workdir
         self.compile_cache = compile_cache
@@ -172,6 +173,15 @@ class FleetRouter:
                             os.path.join(wd, DEFAULT_SOCKET_NAME))
                 self._daemons.append(d)
         self._by_name = {d.name: d for d in self._daemons}
+        # crash-loop guard (runner/respawn.py): zero backoff keeps the
+        # below-threshold path exactly the old immediate in-place
+        # respawn; a daemon that dies flap_count times inside
+        # flap_window_s is parked (router_flap) instead of burning CPU
+        policy = RespawnPolicy(backoff_s=0.0,
+                               flap_count=max(1, int(flap_count)),
+                               flap_window_s=float(flap_window_s))
+        self._flap = {d.name: RespawnTracker(policy, key=d.name)
+                      for d in self._daemons}
 
         self._lock = threading.Lock()
         self._assign = {}          # bucket -> _Daemon
@@ -400,6 +410,17 @@ class FleetRouter:
             with contextlib.suppress(Exception):
                 d.proc.wait(timeout=10.0)
         if d.adopted or self._draining:
+            return
+        verdict = self._flap[d.name].record_death(time.time())
+        if verdict["action"] == PARK:
+            # crash-looping daemon: park it instead of respawning
+            # forever — the fleet degrades onto the survivors (its
+            # buckets were just re-routed above)
+            obs.event("router_flap", daemon=d.name,
+                      deaths=verdict.get("deaths"),
+                      window_s=verdict.get("window_s"),
+                      respawns=d.respawns)
+            self._publish_gauges()
             return
         d.respawns += 1
         obs.counter("router_respawns")
@@ -661,6 +682,7 @@ class FleetRouter:
                     "open_requests": d.open_requests,
                     "routed": d.n_routed,
                     "respawns": d.respawns,
+                    "parked": self._flap[d.name].parked,
                     "buckets": sorted(_blabel(b)
                                       for b in d.buckets)}
             assignment = {_blabel(b): d.name

@@ -38,6 +38,11 @@ site               where it fires
 ``compile_cache``  ``runner/warm.enable_persistent_cache`` (persistent
                    compile-cache enable; a failure degrades to normal
                    first-use JIT compiles — warm is never fatal)
+``supervisor_spawn``  ``runner/supervisor.Supervisor._spawn`` just
+                   before the worker Popen (key ``w<slot>``); an
+                   injected failure counts as an instant worker death,
+                   so the crash-loop backoff and flap-park paths are
+                   testable without burning real subprocesses
 =================  ====================================================
 
 Spec grammar (``PPTPU_FAULTS`` or :func:`configure`)::
@@ -115,7 +120,8 @@ __all__ = ["InjectedFault", "SITES", "check", "active", "configure",
 
 SITES = ("archive_read", "header_scan", "archive_pad", "dispatch",
          "ledger_append", "ledger_scan", "lease_renew",
-         "checkpoint_flush", "obs_write", "barrier", "compile_cache")
+         "checkpoint_flush", "obs_write", "barrier", "compile_cache",
+         "supervisor_spawn")
 
 _SIGNALS = {"sigterm": _signal.SIGTERM, "sigint": _signal.SIGINT,
             "sigkill": _signal.SIGKILL}

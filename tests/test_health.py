@@ -239,6 +239,38 @@ def test_threshold_rule_budget_derived_limit(tmp_path, monkeypatch):
         assert rec.counters["alerts_resolved"] == 1
 
 
+def test_worker_churn_builtin_fires_on_respawn_storm(tmp_path,
+                                                     monkeypatch):
+    """The supervisor's respawn counter feeds a builtin rate rule:
+    cause-labeled series aggregate, and a respawn storm past the
+    threshold fires ``worker_churn`` (flapping slots park, so a
+    healthy supervised survey resolves it on its own)."""
+    rule = next(r for r in health.BUILTIN_RULES
+                if r["name"] == "worker_churn")
+    assert rule["kind"] == "rate" and rule["for_s"] == 0.0
+    assert rule["signal"] == ("pps_supervisor_respawns_total",)
+    monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
+    with obs.run("churn") as rec:
+        reg = rec.metrics_registry()
+        hs = health.HealthState(rec, rules=[dict(rule)])
+        rec._health = hs
+        assert hs.evaluate(now=1000.0) == []
+        # below threshold: two respawns in the window stay quiet
+        reg.inc("pps_supervisor_respawns_total", cause="exit")
+        reg.inc("pps_supervisor_respawns_total", cause="lease_expired")
+        assert hs.evaluate(now=1001.0) == []
+        # the storm: one more respawn reaches the threshold — the
+        # cause-labeled series must aggregate into one measured delta
+        reg.inc("pps_supervisor_respawns_total", cause="exit")
+        firing = hs.evaluate(now=1002.0)
+        assert [a["rule"] for a in firing] == ["worker_churn"]
+        assert firing[0]["severity"] == "warning"
+        assert firing[0]["measured"]["delta"] == 3
+        # the window slides past the storm: resolved
+        assert hs.evaluate(now=1000.0 + rule["window_s"] + 5.0) == []
+        assert hs.states()["worker_churn"]["state"] == "ok"
+
+
 def test_broken_and_unknown_rules_read_healthy(tmp_path, monkeypatch):
     monkeypatch.setenv("PPTPU_OBS_DIR", str(tmp_path))
     with obs.run("broken") as rec:

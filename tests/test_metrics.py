@@ -385,3 +385,93 @@ def test_render_watch_alerts_row_merged_absent_torn(tmp_path):
     reg.set_gauge("pps_alerts_firing", 0, rule="quarantine_spike")
     frame = M.render_watch(reg.snapshot(), title="t")
     assert "alerts: none firing" in frame, frame
+
+
+def test_render_watch_supervisor_row_absent_not_broken():
+    """The --watch supervisor row (runner/supervisor.py's series):
+    per-state worker gauges never summed, counters summed across
+    merge prefixes, absent entirely for unsupervised snapshots."""
+    reg = M.MetricsRegistry()
+    reg.inc("pps_requests_total", tenant="a", outcome="done")
+    # unsupervised snapshot: no supervisor series -> no row at all
+    frame = M.render_watch(reg.snapshot(), title="t")
+    assert "supervisor:" not in frame
+    reg.set_gauge("pps_supervisor_workers", 3, state="desired")
+    reg.set_gauge("pps_supervisor_workers", 2, state="live")
+    reg.set_gauge("pps_supervisor_workers", 1, state="parked")
+    reg.inc("pps_supervisor_respawns_total", value=2,
+            cause="lease_expired")
+    reg.inc("pps_supervisor_scale_events_total", direction="up")
+    snap = reg.snapshot()
+    snap["gauges"]['pps_supervisor_last_scale{action="up"}'] = \
+        snap["t"] - 12.0
+    frame = M.render_watch(snap, title="t")
+    assert ("supervisor: desired 3  live 2  parked 1  "
+            "respawns 2  scale-events 1  last scale up (12s ago)"
+            in frame), frame
+    # merged snapshot: p<proc>/ prefixes; a newer scale action wins
+    merged = dict(snap)
+    merged["gauges"] = {"p9/%s" % k: v
+                        for k, v in snap["gauges"].items()}
+    merged["gauges"]['p9/pps_supervisor_last_scale{action="down"}'] \
+        = snap["t"] - 2.0
+    merged["counters"] = {"p9/%s" % k: v
+                          for k, v in snap["counters"].items()}
+    merged["counters"][
+        'p0/pps_supervisor_respawns_total{cause="exit"}'] = 1
+    frame = M.render_watch(merged, title="t")
+    assert "respawns 3" in frame, frame
+    assert "last scale down (2s ago)" in frame, frame
+    # no last-scale gauge yet: the row renders with "-"
+    bare = M.MetricsRegistry()
+    bare.set_gauge("pps_supervisor_workers", 1, state="live")
+    assert "last scale -" in M.render_watch(bare.snapshot(),
+                                            title="t")
+
+
+def test_overlay_supervisor_folds_series_from_older_run(tmp_path):
+    """--watch on a supervised survey tails the newest (worker) run
+    dir; overlay_supervisor pulls the supervisor's own gauges in from
+    its older run dir — and leaves unsupervised frames untouched."""
+    base = tmp_path / "obs"
+    sup_run = base / "sup"
+    wrk_run = base / "wrk"
+    sup_run.mkdir(parents=True)
+    wrk_run.mkdir()
+
+    def _write(run, reg):
+        snap = dict(reg.snapshot())
+        snap["schema"] = M.SNAPSHOT_SCHEMA
+        with open(run / "metrics.jsonl", "w") as fh:
+            fh.write(json.dumps(snap) + "\n")
+
+    sup_reg = M.MetricsRegistry()
+    sup_reg.set_gauge("pps_supervisor_workers", 2, state="live")
+    sup_reg.inc("pps_supervisor_respawns_total", cause="exit")
+    _write(sup_run, sup_reg)
+    wrk_reg = M.MetricsRegistry()
+    wrk_reg.inc("pps_requests_total", tenant="a", outcome="done")
+    _write(wrk_run, wrk_reg)
+    # the worker run dir is newer: latest_run_dir would miss the
+    # supervisor entirely
+    os.utime(sup_run, (1.0, 1.0))
+    assert M.latest_run_dir(str(base)) == str(wrk_run)
+
+    snap = M.last_snapshot(str(wrk_run))
+    out = M.overlay_supervisor(snap, str(base))
+    assert out["gauges"][
+        'pps_supervisor_workers{state="live"}'] == 2
+    assert out["counters"][
+        'pps_supervisor_respawns_total{cause="exit"}'] == 1
+    # the worker's own series survived the overlay
+    assert out["counters"][
+        'pps_requests_total{outcome="done",tenant="a"}'] == 1
+    # a snapshot already carrying supervisor series is returned as-is
+    assert M.overlay_supervisor(out, str(base)) is out
+    # no snapshot at all: the supervisor's frame is served whole
+    assert M.overlay_supervisor(None, str(base))["gauges"][
+        'pps_supervisor_workers{state="live"}'] == 2
+    # unsupervised base: bit-identical frame back
+    os.remove(sup_run / "metrics.jsonl")
+    assert M.overlay_supervisor(snap, str(base)) is snap
+    assert M.overlay_supervisor(None, str(base)) is None

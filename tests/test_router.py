@@ -228,6 +228,55 @@ def test_router_over_adopted_daemons_end_to_end(corpus, tmp_path):
             svc.shutdown(timeout=60)
 
 
+# -- unit: crash-loop flap quarantine (runner/respawn.py reuse) --------
+
+
+def test_crash_looping_daemon_parks_after_flap_threshold(
+        corpus, tmp_path):
+    """Below the flap threshold the router's respawn behavior is the
+    historical immediate in-place respawn; at the threshold the slot
+    parks (``router_flap``) and the fleet degrades onto survivors."""
+    r = FleetRouter(corpus.gm, str(tmp_path / "rt"), n_daemons=3,
+                    flap_count=2, flap_window_s=60.0)
+    for d in r._daemons:
+        d.ready.set()
+    d0 = r._daemons[0]
+    spawns = []
+    r._spawn = lambda d, first: spawns.append((d.name, first))
+    with obs.run("rt-flap", base_dir=str(tmp_path / "obs")) as rec:
+        # first death: plain immediate respawn, exactly as before
+        r._daemon_down(d0, "test_kill")
+        assert spawns == [("d0", False)]
+        assert d0.respawns == 1
+        assert r.status()["daemons"]["d0"]["parked"] is False
+        # second death inside the window: parked, never respawned
+        d0.ready.set()
+        r._daemon_down(d0, "test_kill")
+        assert spawns == [("d0", False)]     # no second spawn
+        assert d0.respawns == 1
+        assert r.status()["daemons"]["d0"]["parked"] is True
+        run_dir = rec.dir
+    names = []
+    for path in obs.list_event_files(run_dir):
+        with open(path, encoding="utf-8") as fh:
+            names += [json.loads(ln).get("name")
+                      for ln in fh if ln.strip()]
+    assert names.count("router_respawn") == 1
+    assert names.count("router_flap") == 1
+
+
+def test_adopted_daemon_death_never_feeds_flap_tracker(
+        corpus, tmp_path):
+    r = _bare_router(corpus, tmp_path / "rt", flap_count=1)
+    d0 = r._daemons[0]
+    for _ in range(3):
+        d0.ready.set()
+        r._daemon_down(d0, "test_kill")
+    # adopted daemons are not ours to restart — or to park
+    assert r.status()["daemons"]["d0"]["parked"] is False
+    assert d0.respawns == 0
+
+
 # -- chaos: SIGKILL mid-dispatch -> respawn, re-route, exactly-once ----
 
 

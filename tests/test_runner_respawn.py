@@ -1,0 +1,109 @@
+"""runner/respawn.py unit tests: the one crash-loop policy shared by
+the fleet router's daemon respawn and the survey supervisor's worker
+respawn.
+
+Contracts: exponential backoff with deterministic jitter (capped),
+``backoff_s=0`` keeps the router's historical immediate-respawn
+behavior, K deaths inside the sliding window park the slot forever,
+and deaths spread wider than the window never escalate.
+"""
+
+import pytest
+
+from pulseportraiture_tpu.runner.respawn import (PARK, RESPAWN,
+                                                 RespawnPolicy,
+                                                 RespawnTracker)
+
+
+def test_backoff_grows_exponentially_with_jitter_and_cap():
+    pol = RespawnPolicy(backoff_s=1.0, backoff_max_s=8.0,
+                        flap_count=100, flap_window_s=1e9)
+    t = RespawnTracker(pol, key="w0")
+    delays = []
+    for i in range(6):
+        v = t.record_death(now=float(i) * 1000.0)
+        # huge window: every death counts as a strike, none park
+        assert v["action"] == RESPAWN and v["strikes"] == i + 1
+        delays.append(v["delay_s"])
+    for i, d in enumerate(delays):
+        raw = min(1.0 * 2.0 ** i, 8.0)
+        # deterministic jitter in [0.5, 1.0) of the raw backoff
+        assert raw * 0.5 <= d < raw
+    # capped: strike 5 and 6 share the same raw ceiling
+    assert delays[4] < 8.0 and delays[5] < 8.0
+    # deterministic: an identical tracker replays identical delays
+    t2 = RespawnTracker(pol, key="w0")
+    assert [t2.record_death(float(i) * 1000.0)["delay_s"]
+            for i in range(6)] == delays
+
+
+def test_zero_backoff_is_immediate_and_identical_below_threshold():
+    pol = RespawnPolicy(backoff_s=0.0, flap_count=5, flap_window_s=60.0)
+    t = RespawnTracker(pol, key="d1")
+    for i in range(4):
+        v = t.record_death(now=10.0 * i)
+        assert v["action"] == RESPAWN
+        assert v["delay_s"] == 0.0
+        assert t.due(now=10.0 * i)  # no waiting: the old router path
+
+
+def test_flap_parks_at_k_deaths_in_window():
+    pol = RespawnPolicy(backoff_s=0.0, flap_count=3, flap_window_s=30.0)
+    t = RespawnTracker(pol, key="w2")
+    assert t.record_death(0.0)["action"] == RESPAWN
+    assert t.record_death(1.0)["action"] == RESPAWN
+    v = t.record_death(2.0)
+    assert v["action"] == PARK
+    assert v["deaths"] == 3 and v["window_s"] == 30.0
+    assert t.parked
+    # parked is forever: later deaths never un-park
+    assert t.record_death(500.0)["action"] == PARK
+    assert not t.due(now=1e9)
+
+
+def test_slow_deaths_outside_window_never_park():
+    pol = RespawnPolicy(backoff_s=1.0, flap_count=3, flap_window_s=10.0)
+    t = RespawnTracker(pol, key="w3")
+    for i in range(20):
+        v = t.record_death(now=100.0 * i)  # one death per 100s
+        assert v["action"] == RESPAWN
+        # the window pruned every older death: strikes never escalate
+        assert v["strikes"] == 1
+    assert not t.parked
+    assert t.total_deaths == 20
+
+
+def test_strikes_reset_after_quiet_period():
+    pol = RespawnPolicy(backoff_s=1.0, flap_count=4, flap_window_s=10.0)
+    t = RespawnTracker(pol, key="w4")
+    assert t.record_death(0.0)["strikes"] == 1
+    assert t.record_death(1.0)["strikes"] == 2
+    assert t.record_death(2.0)["strikes"] == 3
+    # child then stayed up well past the window: back to strike 1
+    assert t.record_death(50.0)["strikes"] == 1
+    assert not t.parked
+
+
+def test_due_respects_not_before():
+    pol = RespawnPolicy(backoff_s=4.0, backoff_max_s=60.0,
+                        flap_count=10, flap_window_s=5.0)
+    t = RespawnTracker(pol, key="w5")
+    v = t.record_death(now=100.0)
+    assert v["not_before"] == 100.0 + v["delay_s"]
+    assert not t.due(now=100.0)
+    assert t.due(now=v["not_before"])
+
+
+def test_policy_validates_flap_count():
+    with pytest.raises(ValueError):
+        RespawnPolicy(flap_count=0)
+
+
+def test_state_snapshot_is_json_ready():
+    pol = RespawnPolicy(backoff_s=0.0, flap_count=2, flap_window_s=9.0)
+    t = RespawnTracker(pol, key="w6")
+    t.record_death(1.0)
+    t.record_death(2.0)
+    st = t.state()
+    assert st == {"key": "w6", "parked": True, "strikes": 1,
+                  "deaths": 2, "not_before": 1.0}

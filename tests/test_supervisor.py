@@ -1,0 +1,238 @@
+"""Autoscaling-supervisor tests (the ISSUE 20 contracts).
+
+Unit level: the pure ``decide(observed) -> actions`` policy over a
+parametrized table of (backlog, live set, lease expiries, memory
+headroom, flap state) observations.  Integration level: an in-process
+supervisor whose spawns are failed by the ``supervisor_spawn`` chaos
+site until every slot parks (crash-loop → flap quarantine, zero real
+subprocesses).  Chaos level: a real supervised zap survey where a
+scaled-up worker is SIGKILLed mid-run — the supervisor replaces it in
+its slot and the survey completes exactly-once (one done record + one
+checkpoint block per archive).  The full elastic scale-up/down gate
+with TOA fits is tools/supervisor_smoke.py.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pulseportraiture_tpu.io.archive import make_fake_pulsar
+from pulseportraiture_tpu.io.gmodel import write_model
+from pulseportraiture_tpu.runner.plan import plan_survey
+from pulseportraiture_tpu.runner.queue import WorkQueue
+from pulseportraiture_tpu.runner.respawn import RespawnPolicy
+from pulseportraiture_tpu.runner.supervisor import Supervisor, decide
+from pulseportraiture_tpu.testing import faults
+
+MODEL_PARAMS = np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0, -0.5])
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("supervisor")
+    gm = str(tmp / "s.gmodel")
+    write_model(gm, "s", "000", 1500.0, MODEL_PARAMS,
+                np.ones(8, int), -4.0, 0, quiet=True)
+    par = str(tmp / "s.par")
+    with open(par, "w") as f:
+        f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                "PEPOCH 56000.0\nDM 30.0\n")
+    files = []
+    for i in range(8):
+        out = str(tmp / f"s{i}.fits")
+        make_fake_pulsar(gm, par, out, nsub=2, nchan=8, nbin=64,
+                         nu0=1500.0, bw=800.0, tsub=60.0,
+                         phase=0.02 * (i + 1), dDM=5e-4,
+                         noise_stds=0.01, dedispersed=False,
+                         seed=90 + i, quiet=True)
+        files.append(out)
+    return SimpleNamespace(tmp=tmp, gm=gm, par=par, files=files)
+
+
+def _workdir(corpus, tmp_path):
+    wd = str(tmp_path / "wd")
+    os.makedirs(wd, exist_ok=True)
+    plan = plan_survey(corpus.files, modelfile=corpus.gm)
+    plan.save(os.path.join(wd, "plan.json"))
+    return wd
+
+
+# -- unit: the pure decide() policy table ------------------------------
+
+
+BASE = {"min_workers": 1, "max_workers": 3, "backlog_per_worker": 2.0}
+
+
+@pytest.mark.parametrize("observed,expected", [
+    # cold start: big backlog, nothing live -> fill to max_workers
+    (dict(BASE, ready=8, outstanding=8, live=[], empty=[0, 1, 2]),
+     [{"op": "spawn", "slot": 0, "cause": "scale_up"},
+      {"op": "spawn", "slot": 1, "cause": "scale_up"},
+      {"op": "spawn", "slot": 2, "cause": "scale_up"}]),
+    # backlog per worker exceeds the threshold -> scale 1 -> 3
+    (dict(BASE, ready=8, outstanding=8, live=[0], empty=[1, 2]),
+     [{"op": "spawn", "slot": 1, "cause": "scale_up"},
+      {"op": "spawn", "slot": 2, "cause": "scale_up"}]),
+    # backlog at (not past) the threshold -> no scale
+    (dict(BASE, ready=2, outstanding=4, live=[0], empty=[1, 2]), []),
+    # all remaining work is leased (ready 0) -> never scale up
+    (dict(BASE, ready=0, outstanding=4, live=[0], empty=[1, 2]), []),
+    # memory admission caps the fleet: budget fits only 2 workers
+    (dict(BASE, ready=8, outstanding=8, live=[0], empty=[1, 2],
+          mem_budget_bytes=200, est_worker_bytes=100),
+     [{"op": "spawn", "slot": 1, "cause": "scale_up"}]),
+    # a firing memory_watermark alert vetoes scale-up entirely
+    (dict(BASE, ready=8, outstanding=8, live=[0], empty=[1, 2],
+          alerts=["memory_watermark"]), []),
+    # an unrelated alert does not veto
+    (dict(BASE, ready=8, outstanding=8, live=[0], empty=[1],
+          alerts=["quota_burn"]),
+     [{"op": "spawn", "slot": 1, "cause": "scale_up"}]),
+    # live set outnumbers remaining work -> drain highest slots first
+    (dict(BASE, ready=1, outstanding=1, live=[0, 1, 2]),
+     [{"op": "drain", "slot": 2, "cause": "scale_down"},
+      {"op": "drain", "slot": 1, "cause": "scale_down"}]),
+    # scale-down respects min_workers while work remains
+    (dict(BASE, ready=0, outstanding=1, live=[0, 1],
+          min_workers=2), []),
+    # survey complete -> drain everything, min_workers included
+    (dict(BASE, ready=0, outstanding=0, live=[0, 1]),
+     [{"op": "drain", "slot": 0, "cause": "complete"},
+      {"op": "drain", "slot": 1, "cause": "complete"}]),
+    # already-draining slots are not re-drained
+    (dict(BASE, ready=0, outstanding=0, live=[0, 1], draining=[1]),
+     [{"op": "drain", "slot": 0, "cause": "complete"}]),
+    # dead slot with its backoff elapsed -> replace in place
+    (dict(BASE, ready=4, outstanding=4, live=[0], empty=[],
+          dead=[{"slot": 1, "action": "respawn", "due": True}]),
+     [{"op": "spawn", "slot": 1, "cause": "replace"}]),
+    # dead slot still inside its backoff -> wait, no action
+    (dict(BASE, ready=4, outstanding=4, live=[0], empty=[],
+          dead=[{"slot": 1, "action": "respawn", "due": False}]), []),
+    # no work left -> a dead slot is NOT replaced
+    (dict(BASE, ready=0, outstanding=0, live=[],
+          dead=[{"slot": 1, "action": "respawn", "due": True}]), []),
+    # flapped slot -> park, and its index is never refilled
+    (dict(BASE, ready=8, outstanding=8, live=[0], empty=[2],
+          dead=[{"slot": 1, "action": "park", "due": True}]),
+     [{"op": "park", "slot": 1, "cause": "flap"},
+      {"op": "spawn", "slot": 2, "cause": "scale_up"}]),
+    # lease expiry on a live slot -> kill + respawn that worker
+    (dict(BASE, ready=0, outstanding=3, live=[0, 1], expired=[1]),
+     [{"op": "respawn", "slot": 1, "cause": "lease_expired"}]),
+    # lease expiry on a draining slot is left to the drain
+    (dict(BASE, ready=0, outstanding=3, live=[0, 1], draining=[1],
+          expired=[1]), []),
+    # replacement counts toward the target: want=2 is met by one
+    # live + one replacing, so the spare empty slot is NOT filled
+    (dict(BASE, ready=4, outstanding=4, live=[0], empty=[2],
+          dead=[{"slot": 1, "action": "respawn", "due": True}]),
+     [{"op": "spawn", "slot": 1, "cause": "replace"}]),
+])
+def test_decide_policy_table(observed, expected):
+    assert decide(observed) == expected
+
+
+def test_decide_is_pure_and_input_preserving():
+    observed = dict(BASE, ready=8, outstanding=8, live=[0],
+                    empty=[1, 2], alerts=["quota_burn"])
+    before = json.dumps(observed, sort_keys=True)
+    a1 = decide(observed)
+    a2 = decide(observed)
+    assert a1 == a2
+    assert json.dumps(observed, sort_keys=True) == before
+
+
+# -- integration: crash-loop -> flap park (no real subprocesses) -------
+
+
+def test_spawn_crash_loop_parks_all_slots(corpus, tmp_path):
+    wd = _workdir(corpus, tmp_path)
+    faults.configure("site:supervisor_spawn@1.0")
+    try:
+        sup = Supervisor(
+            wd, min_workers=1, max_workers=2, backlog_per_worker=2.0,
+            interval_s=0.02, respawn_policy=RespawnPolicy(
+                backoff_s=0.0, flap_count=2, flap_window_s=60.0),
+            quiet=True)
+        summary = sup.run()
+    finally:
+        faults.reset()
+    assert summary["stopped_by"] == "all_parked"
+    assert summary["outstanding"] == 8       # nothing ever ran
+    assert summary["parked_slots"] == [0, 1]
+    assert summary["workers"]["parked"] == 2
+    assert summary["workers"]["spawned"] == 0
+    # the audit trail made it into the merged obs run
+    merged = os.path.join(wd, "obs_merged")
+    names = []
+    with open(os.path.join(merged, "events.jsonl"),
+              encoding="utf-8") as fh:
+        for ln in fh:
+            if ln.strip():
+                names.append(json.loads(ln).get("name"))
+    assert names.count("supervisor_flap") == 2
+    assert "supervisor_started" in names
+    assert "supervisor_stopped" in names
+
+
+# -- chaos: SIGKILL a scaled-up worker, replaced, exactly-once ---------
+
+
+def test_sigkilled_worker_replaced_and_survey_exactly_once(
+        corpus, tmp_path):
+    wd = _workdir(corpus, tmp_path)
+    # slow every first-spawn worker's archive reads so work is still
+    # outstanding when the victim dies (respawns come back clean: the
+    # supervisor scrubs PPTPU_FAULTS on replacement spawns)
+    slow = {"PPTPU_FAULTS": "site:archive_read@1.0,latency=0.25"}
+    sup = Supervisor(
+        wd, min_workers=1, max_workers=3, backlog_per_worker=2.0,
+        interval_s=0.2, lease_s=30.0, workload="zap",
+        respawn_policy=RespawnPolicy(backoff_s=0.05, flap_count=5,
+                                     flap_window_s=60.0),
+        worker_env={i: dict(slow) for i in range(3)}, quiet=True)
+    result = {}
+    th = threading.Thread(
+        target=lambda: result.update(sup.run()), daemon=True)
+    th.start()
+    # the backlog (8 ready / 1 per-worker threshold 2) forces a
+    # scale-up past slot 0; SIGKILL the scaled-up victim
+    deadline = time.time() + 120.0
+    while time.time() < deadline and sup.slots[1].pid is None:
+        time.sleep(0.05)
+    victim = sup.slots[1].pid
+    assert victim, "supervisor never scaled up to slot 1"
+    os.kill(victim, signal.SIGKILL)
+    th.join(timeout=300.0)
+    assert not th.is_alive(), "supervised survey did not finish"
+
+    assert result["stopped_by"] == "complete"
+    assert result["outstanding"] == 0
+    assert result["counts"]["done"] == 8
+    assert result["parked_slots"] == []
+    # the victim was replaced in its slot (>= 1 respawn, same index)
+    assert result["workers"]["respawns"] >= 1
+    assert sup.slots[1].spawn_count >= 2
+    # exactly-once: one done ledger record and one checkpoint block
+    # per archive, across every per-process shard
+    q = WorkQueue(None, readonly=True, union_dir=wd, workload="zap")
+    planned = {WorkQueue.key_for(p) for p in corpus.files}
+    states = {k: r["state"] for k, r in q.entries.items()}
+    assert set(states) == planned
+    assert set(states.values()) == {"done"}
+    blocks = []
+    for name in os.listdir(wd):
+        if name.startswith("zap.") and name.endswith(".jsonl"):
+            with open(os.path.join(wd, name), encoding="utf-8") as fh:
+                for ln in fh:
+                    if ln.strip():
+                        blocks.append(json.loads(ln)["archive"])
+    assert sorted(blocks) == sorted(planned), \
+        "checkpoint blocks must cover every archive exactly once"

@@ -119,7 +119,20 @@
 #                 errors), pps_quota_burn saturates, and the drained
 #                 router run renders the "## usage" report section
 #                 (docs/OBSERVABILITY.md "Usage & quotas")
-#  18. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
+#  18. supervisor smoke — the self-healing autoscaling supervisor
+#                 end to end: one ``ppsurvey supervise`` call owns an
+#                 8-archive survey (one archive payload-truncated on
+#                 disk -> deterministic quarantine) with worker slot 1
+#                 carrying a one-shot sigkill chaos clause — the
+#                 backlog must scale the fleet to all 3 slots, the
+#                 killed worker must be replaced in place (fault
+#                 scrubbed), the survey must settle to 7 done + 1
+#                 quarantined exactly-once (one done record + one
+#                 pp_done block per archive), the fleet must drain to
+#                 zero, and the merged report must carry the
+#                 supervisor_* audit trail
+#                 (docs/RUNNER.md "Autoscaling")
+#  19. tier-1 tests — the fast CPU pytest lane from ROADMAP.md
 #
 # Usage: tools/check.sh [--lint-only]
 #   --lint-only   run only the static stages (pplint + ruff + drift +
@@ -340,6 +353,17 @@ if [ $? -ne 0 ]; then
     fail=1
 else
     tail -1 /tmp/_usage_smoke.log
+fi
+
+echo
+echo "== supervisor smoke (self-healing autoscaling, docs/RUNNER.md Autoscaling) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu PPTPU_OBS_DIR="" PPTPU_FAULTS="" \
+    python -m tools.supervisor_smoke >/tmp/_supervisor_smoke.log 2>&1
+if [ $? -ne 0 ]; then
+    tail -40 /tmp/_supervisor_smoke.log
+    fail=1
+else
+    tail -1 /tmp/_supervisor_smoke.log
 fi
 
 echo

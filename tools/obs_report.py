@@ -996,6 +996,81 @@ def summarize_fleet(events):
     return "\n".join(lines)
 
 
+def summarize_supervisor(events):
+    """The ``## supervisor`` section: the autoscaling supervisor's
+    decision trail (docs/RUNNER.md "Autoscaling") — per-slot spawn/
+    death/park history, the scale-event timeline and the final
+    settle.  Only ``ppsurvey supervise`` emits ``supervisor_*``
+    events, so unsupervised survey reports skip the section."""
+    evs = [e for e in events if e.get("kind") == "event"
+           and str(e.get("name", "")).startswith("supervisor_")]
+    if not evs:
+        return None
+    by = {}
+    for e in evs:
+        by.setdefault(e["name"], []).append(e)
+    lines = []
+    started = by.get("supervisor_started")
+    if started:
+        e = started[-1]
+        lines.append("supervised survey: %s archive(s), %s..%s "
+                     "worker(s)" % (e.get("planned", "?"),
+                                    e.get("min_workers", "?"),
+                                    e.get("max_workers", "?")))
+    per = {}
+    for e in by.get("supervisor_spawn") or []:
+        s = per.setdefault(e.get("slot", "?"),
+                           {"spawns": 0, "deaths": 0, "parked": False})
+        s["spawns"] += 1
+    for e in by.get("supervisor_worker_exit") or []:
+        if e.get("reason") != "clean":
+            s = per.setdefault(e.get("slot", "?"),
+                               {"spawns": 0, "deaths": 0,
+                                "parked": False})
+            s["deaths"] += 1
+    for e in by.get("supervisor_flap") or []:
+        s = per.setdefault(e.get("slot", "?"),
+                           {"spawns": 0, "deaths": 0, "parked": False})
+        s["parked"] = True
+    if per:
+        rows = [[slot, v["spawns"], v["deaths"],
+                 "yes" if v["parked"] else "-"]
+                for slot, v in sorted(per.items(), key=str)]
+        lines.append(_table(["slot", "spawns", "dirty deaths",
+                             "parked"], rows))
+    trail = []
+    for e in evs:
+        if e["name"] == "supervisor_scale_up":
+            trail.append("+%s (ready %s)" % (e.get("n", "?"),
+                                             e.get("ready", "?")))
+        elif e["name"] == "supervisor_scale_down":
+            trail.append("-%s (outstanding %s)"
+                         % (e.get("n", "?"), e.get("outstanding", "?")))
+    if trail:
+        lines.append("scale events: " + "  ".join(trail[:16]))
+        if len(trail) > 16:
+            lines.append("... %d more scale event(s)"
+                         % (len(trail) - 16))
+    drains = by.get("supervisor_drain") or []
+    if drains:
+        causes = {}
+        for e in drains:
+            c = str(e.get("cause", "?"))
+            causes[c] = causes.get(c, 0) + 1
+        lines.append("drains: " + "  ".join(
+            "%s: %d" % (k, v) for k, v in sorted(causes.items())))
+    stopped = by.get("supervisor_stopped")
+    if stopped:
+        e = stopped[-1]
+        lines.append("stopped: %s  outstanding=%s  spawned=%s  "
+                     "respawns=%s  parked=%s"
+                     % (e.get("stopped_by", "?"),
+                        e.get("outstanding", "?"),
+                        e.get("spawned", 0), e.get("respawns", 0),
+                        e.get("parked", 0)))
+    return "\n".join(lines)
+
+
 def summarize(run_dir):
     """Full human-readable report for one run directory."""
     manifest, events = load_run(run_dir)
@@ -1084,6 +1159,11 @@ def summarize(run_dir):
         out.append("")
         out.append("## fleet")
         out.append(fleet)
+    sup = summarize_supervisor(events)
+    if sup:
+        out.append("")
+        out.append("## supervisor")
+        out.append(sup)
     rob = summarize_robustness(events)
     if rob:
         out.append("")

@@ -1,0 +1,208 @@
+"""Supervisor smoke gate: the self-healing autoscaling supervisor
+must own a faulted survey end-to-end (wired into tools/check.sh).
+
+Builds 8 archives in one shape bucket — 7 good plus 1 whose payload is
+truncated on disk (the header scans clean, so the plan admits it; the
+load then fails deterministically no matter which worker reads it) —
+and hands the survey to one ``ppsurvey supervise`` subprocess::
+
+    ppsurvey supervise -w WD --min-workers 1 --max-workers 3 \
+        --worker-env "1:PPTPU_FAULTS=sigkill@after=2"
+
+The asserted contract (docs/RUNNER.md "Autoscaling"):
+
+* the backlog (8 ready vs ``--backlog-per-worker 2``) makes the
+  supervisor scale the fleet up to all 3 slots (``supervisor_scale_up``
+  on the record, 3 distinct slots spawned);
+* worker slot 1 carries a one-shot ``sigkill`` chaos clause that hard
+  kills it at its 2nd dispatch — no drain, no flush, a stranded
+  ``running`` lease.  The supervisor must respawn the slot in place
+  (scrubbing ``PPTPU_FAULTS``: a replacement comes back clean), and the
+  replacement — same ``--process`` index, same ledger shard — recovers
+  the stranded claim;
+* the truncated archive exhausts its retries and is quarantined; the
+  survey still completes: 7 done + 1 quarantined, the supervise call
+  exits 0 with ``stopped_by == "complete"`` and zero parked slots;
+* exactly-once across the whole fleet and every death: one ``done``
+  ledger record and one ``pp_done`` checkpoint block per good archive;
+* the fleet scales back to zero (no worker outlives the work) and the
+  merged obs report carries the ``supervisor_*`` audit trail next to
+  the fits.
+
+Run:  env JAX_PLATFORMS=cpu python -m tools.supervisor_smoke
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+VICTIM_FAULT_SPEC = "sigkill@after=2"   # hard kill at the 2nd dispatch
+
+
+def _union_ledger(workdir):
+    recs = []
+    for name in sorted(os.listdir(workdir)):
+        if name.startswith("ledger.") and name.endswith(".jsonl"):
+            with open(os.path.join(workdir, name)) as fh:
+                for ln in fh:
+                    ln = ln.strip()
+                    if ln:
+                        recs.append(json.loads(ln))
+    return recs
+
+
+def _tim_markers(workdir):
+    """{archive: n_pp_done_markers} across ALL toas.*.tim files."""
+    markers = {}
+    for name in sorted(os.listdir(workdir)):
+        if not (name.startswith("toas.") and name.endswith(".tim")):
+            continue
+        for ln in open(os.path.join(workdir, name)):
+            tok = ln.split()
+            if tok[:2] == ["C", "pp_done"]:
+                markers[tok[2]] = markers.get(tok[2], 0) + 1
+    return markers
+
+
+def main():
+    workroot = tempfile.mkdtemp(prefix="pptpu_supervisor_smoke_")
+    try:
+        from pulseportraiture_tpu.io.archive import make_fake_pulsar
+        from pulseportraiture_tpu.io.gmodel import write_model
+        from pulseportraiture_tpu.obs import list_event_files
+        from pulseportraiture_tpu.runner import plan_survey
+
+        gm = os.path.join(workroot, "sup.gmodel")
+        write_model(gm, "sup", "000", 1500.0,
+                    np.array([0.0, 0.0, 0.4, 0.0, 0.05, 0.0, 1.0,
+                              -0.5]),
+                    np.ones(8, int), -4.0, 0, quiet=True)
+        par = os.path.join(workroot, "sup.par")
+        with open(par, "w") as f:
+            f.write("PSR J0\nRAJ 00:00:00\nDECJ 00:00:00\nF0 200.0\n"
+                    "PEPOCH 56000.0\nDM 30.0\n")
+        files = []
+        for i in range(8):
+            fits = os.path.join(workroot, "arch%d.fits" % i)
+            make_fake_pulsar(gm, par, fits, nsub=2, nchan=8, nbin=64,
+                             nu0=1500.0, bw=800.0, tsub=60.0,
+                             phase=0.02 * (i + 1), dDM=5e-4,
+                             noise_stds=0.01, dedispersed=False,
+                             seed=51 + i, quiet=True)
+            files.append(fits)
+        # read-fault one archive ON DISK: the header stays scannable
+        # (the plan admits it) but every load fails, on any worker —
+        # deterministic even though respawned workers run fault-free
+        bad = files[3]
+        with open(bad, "r+b") as f:
+            f.truncate(os.path.getsize(bad) - 2880)
+        good = [f for f in files if f != bad]
+
+        wd = os.path.join(workroot, "wd")
+        os.makedirs(wd)
+        plan = plan_survey(files, modelfile=gm)
+        assert plan.n_archives == 8 and len(plan.buckets) == 1, \
+            plan.to_dict()
+        plan.save(os.path.join(wd, "plan.json"))
+
+        # -- one supervise call owns the survey end-to-end ------------
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PPTPU_FAULTS", None)   # only worker 1 gets the kill
+        proc = subprocess.run(
+            [sys.executable, "-m", "pulseportraiture_tpu.cli.ppsurvey",
+             "supervise", "-w", wd,
+             "--min-workers", "1", "--max-workers", "3",
+             "--backlog-per-worker", "2", "--interval", "0.2",
+             "--lease", "30", "--respawn-backoff", "0.1",
+             "--drain-grace", "60", "--quiet",
+             "--worker-env", "1:PPTPU_FAULTS=%s" % VICTIM_FAULT_SPEC,
+             "--worker-arg=--no_bary", "--worker-arg=--backoff",
+             "--worker-arg=0"],
+            env=env, cwd=os.getcwd(), timeout=540,
+            capture_output=True, text=True)
+        assert proc.returncode == 0, (proc.returncode,
+                                      proc.stdout[-2000:],
+                                      proc.stderr[-2000:])
+        summary = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert summary["stopped_by"] == "complete", summary
+        assert summary["outstanding"] == 0, summary
+        assert summary["counts"]["done"] == 7, summary
+        assert summary["counts"]["quarantined"] == 1, summary
+        assert summary["parked_slots"] == [], summary
+        w = summary["workers"]
+        # the sigkilled slot was replaced (>=1 respawn), the backlog
+        # scaled the fleet up, nothing crash-looped into a park
+        assert w["respawns"] >= 1, w
+        assert w["scale_ups"] >= 1, w
+        assert w["parked"] == 0, w
+        assert w["spawned"] >= 4, w   # 3 slots + >=1 replacement
+
+        # -- exactly-once across the deaths ---------------------------
+        done, quar = {}, {}
+        for rec in _union_ledger(wd):
+            if rec["state"] == "done":
+                done[rec["archive"]] = done.get(rec["archive"], 0) + 1
+            elif rec["state"] == "quarantined":
+                quar[rec["archive"]] = quar.get(rec["archive"], 0) + 1
+        assert done == {os.path.realpath(f): 1 for f in good}, done
+        assert quar == {os.path.realpath(bad): 1}, quar
+        markers = _tim_markers(wd)
+        assert markers == {os.path.realpath(f): 1 for f in good}, \
+            markers
+
+        # -- the audit trail: scale-up, kill, replacement, drain ------
+        evs = []
+        merged = os.path.join(wd, "obs_merged")
+        for path in list_event_files(merged):
+            with open(path, encoding="utf-8") as fh:
+                evs.extend(json.loads(ln) for ln in fh if ln.strip())
+        names = [e.get("name") for e in evs]
+        for must in ("supervisor_started", "supervisor_spawn",
+                     "supervisor_scale_up", "supervisor_worker_exit",
+                     "supervisor_stopped"):
+            assert must in names, (must, sorted(set(names)))
+        spawned_slots = {e.get("slot") for e in evs
+                         if e.get("name") == "supervisor_spawn"}
+        assert spawned_slots == {0, 1, 2}, spawned_slots
+        # slot 1 died dirty (the injected sigkill) and came back
+        dirty = [e for e in evs
+                 if e.get("name") == "supervisor_worker_exit"
+                 and e.get("slot") == 1 and e.get("reason") != "clean"]
+        assert dirty, [e for e in evs
+                       if e.get("name") == "supervisor_worker_exit"]
+        replacements = [e for e in evs
+                        if e.get("name") == "supervisor_spawn"
+                        and e.get("slot") == 1
+                        and e.get("spawn_count", 1) > 1]
+        assert replacements, "slot 1 was never respawned"
+        # scaled back to zero: the supervisor outlived every worker
+        stop = [e for e in evs
+                if e.get("name") == "supervisor_stopped"][-1]
+        assert stop.get("stopped_by") == "complete", stop
+        # ... and the report renders the trail next to the fits
+        from tools.obs_report import summarize
+
+        text = summarize(merged)
+        assert "## supervisor" in text, text
+        assert "scale events:" in text, text
+        assert "stopped: complete" in text, text
+
+        print("supervisor smoke OK: supervise owned 8 archives "
+              "(1 read-faulted) end-to-end — scaled 3 slots up, "
+              "sigkilled worker 1 replaced in place (%d respawns), "
+              "7 done + 1 quarantined exactly-once, fleet drained "
+              "to zero, supervisor_* audit trail in the merged "
+              "report" % w["respawns"])
+        return 0
+    finally:
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
